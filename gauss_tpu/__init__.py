@@ -1,0 +1,29 @@
+"""gauss-tpu: a TPU-native framework for parallel dense Gaussian elimination and
+matrix multiplication.
+
+Re-implements, TPU-first (JAX / XLA / Pallas / pjit), the capabilities of the
+reference repo svdeepak99/Gaussian_Elimination-CUDA-OpenMP-MPI-Pthreads: the
+reference ships 12 standalone C/CUDA programs that each duplicate one ~230-line
+algorithmic skeleton (see reference Pthreads/Version-1/gauss_internal_input.c)
+with a different parallel engine spliced into ``computeGauss``. This package
+de-duplicates that into one algorithmic core with pluggable execution backends:
+
+- ``gauss_tpu.io``      — .dat coordinate-format I/O + synthetic initializers
+                          (reference matrices_dense/matrix_gen.cc:13-22 format)
+- ``gauss_tpu.core``    — pure-JAX oracle implementations (sequential-C analog)
+- ``gauss_tpu.kernels`` — Pallas TPU kernels (CUDA Version-1/2 analog)
+- ``gauss_tpu.dist``    — shard_map/pjit multi-chip engines (MPI gauss_mpi analog)
+- ``gauss_tpu.native``  — C++ host-side runtime: matrix generator, fast .dat
+                          parser, seq/OpenMP/std::thread CPU baseline engines
+- ``gauss_tpu.cli``     — drivers with reference-parity flags and output
+- ``gauss_tpu.verify``  — manufactured-solution / residual / cross-backend checks
+"""
+
+__version__ = "0.1.0"
+
+from gauss_tpu.core.gauss import (  # noqa: F401
+    eliminate,
+    back_substitute,
+    gauss_solve,
+)
+from gauss_tpu.core.matmul import matmul  # noqa: F401

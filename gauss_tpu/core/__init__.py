@@ -1,0 +1,16 @@
+"""Pure-JAX algorithmic core — the oracle layer.
+
+Equivalent in role to the sequential C skeleton that the reference duplicates
+into all 10 gauss programs (reference Pthreads/Version-1/gauss_internal_input.c:29-227):
+allocate/init/pivot/eliminate/back-substitute, plus dense matmul
+(reference CUDA_and_OpenMP/Version-1/cuda_matmul.cu:28-39). Everything here is
+jittable, static-shaped, and dtype-polymorphic (f32 on TPU, f64 for oracle tests).
+"""
+
+from gauss_tpu.core.gauss import (  # noqa: F401
+    EliminationResult,
+    eliminate,
+    back_substitute,
+    gauss_solve,
+)
+from gauss_tpu.core.matmul import matmul  # noqa: F401

@@ -1,0 +1,166 @@
+"""Gaussian elimination core: pivot, eliminate, back-substitute.
+
+TPU-first re-expression of the reference's sequential skeleton
+(reference Pthreads/Version-1/gauss_internal_input.c:75-227 for the internal
+flavor; Pthreads/Version-1/gauss_external_input.c:125-278 for the external
+flavor). XLA requires static shapes, so instead of the C code's shrinking
+``j = i+1..n`` loop bounds, every pivot step performs a full-width masked
+rank-1 update under a single compiled ``lax.fori_loop`` — the whole O(n^3)
+elimination is one XLA program, not n kernel launches.
+
+Pivoting policies (both reference behaviors are reproduced):
+
+- ``"partial"``       — max-|column| partial pivoting, as in the external-input
+                        programs (gauss_external_input.c:125-150).
+- ``"first_nonzero"`` — swap only when the diagonal is exactly zero, taking the
+                        first nonzero row below, as in the internal-input
+                        programs (gauss_internal_input.c:75-121). Unlike the
+                        reference (which tracks swaps in ``swap[]`` but forgets
+                        to apply them to the RHS / back-substitution — a
+                        documented defect, SURVEY.md §2), we swap the RHS
+                        consistently.
+- ``"none"``          — no pivoting (useful for oracle comparisons).
+
+The pivot row is scaled to unit diagonal before elimination, matching the
+reference (getPivot scales in the internal flavor, computeGauss in the
+external flavor — gauss_internal_input.c:109-120, gauss_external_input.c:219-227),
+so the returned U has 1.0 on the diagonal.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PIVOT_POLICIES = ("partial", "first_nonzero", "none")
+
+
+class EliminationResult(NamedTuple):
+    """Outcome of forward elimination on the augmented system [A | b].
+
+    u:    (n, n) upper-triangular with unit diagonal (pivot rows scaled).
+    y:    (n,) transformed RHS (same row operations applied).
+    perm: (n,) row permutation actually applied; ``perm[k]`` is the original
+          index of the row now in position k (the reference's ``swap[]``,
+          gauss_internal_input.c:105-108, but recorded consistently).
+    min_abs_pivot: scalar; min over steps of |pivot| before scaling. Zero means
+          the matrix is singular (the reference aborts in that case —
+          gauss_internal_input.c:95-98; we surface it as data so the check can
+          live outside the jitted region).
+    """
+
+    u: jax.Array
+    y: jax.Array
+    perm: jax.Array
+    min_abs_pivot: jax.Array
+
+
+def _select_pivot(col: jax.Array, i: jax.Array, idx: jax.Array, policy: str) -> jax.Array:
+    """Choose the pivot row index for step i given the current column i."""
+    if policy == "partial":
+        cand = jnp.where(idx >= i, jnp.abs(col), -jnp.inf)
+        return jnp.argmax(cand)
+    if policy == "first_nonzero":
+        eligible = (col != 0) & (idx >= i)
+        # argmax of a boolean array returns the first True.
+        first = jnp.argmax(eligible)
+        has_any = jnp.any(eligible)
+        diag_ok = col[i] != 0
+        return jnp.where(diag_ok, i, jnp.where(has_any, first, i))
+    if policy == "none":
+        return i
+    raise ValueError(f"unknown pivoting policy {policy!r}; expected one of {PIVOT_POLICIES}")
+
+
+@partial(jax.jit, static_argnames=("pivoting",))
+def eliminate(a: jax.Array, b: jax.Array, pivoting: str = "partial") -> EliminationResult:
+    """Forward elimination of the dense system ``a @ x = b``.
+
+    One fused ``fori_loop`` over n pivot steps; each step is (pivot select,
+    two-row swap, pivot-row scale, masked rank-1 update). The rank-1 update
+    touches the full n x n array — columns left of the pivot are exactly zero
+    already, so the redundant FLOPs are nops numerically and the static shape
+    lets XLA tile the update onto the VPU without re-compilation per step.
+    """
+    a = jnp.asarray(a)
+    b = jnp.asarray(b, dtype=a.dtype)
+    n = a.shape[0]
+    if a.shape != (n, n) or b.shape != (n,):
+        raise ValueError(f"expected square a and matching b; got {a.shape} and {b.shape}")
+    idx = jnp.arange(n)
+    big = jnp.asarray(jnp.inf, dtype=a.dtype)
+
+    def step(i, carry):
+        A, rhs, perm, min_piv = carry
+        col = A[:, i]
+        p = _select_pivot(col, i, idx, pivoting)
+
+        # Swap rows i and p (a no-op gather when p == i).
+        row_i, row_p = A[i], A[p]
+        A = A.at[i].set(row_p).at[p].set(row_i)
+        bi, bp = rhs[i], rhs[p]
+        rhs = rhs.at[i].set(bp).at[p].set(bi)
+        si, sp = perm[i], perm[p]
+        perm = perm.at[i].set(sp).at[p].set(si)
+
+        piv = A[i, i]
+        min_piv = jnp.minimum(min_piv, jnp.abs(piv))
+
+        # Scale the pivot row to unit diagonal (reference getPivot semantics).
+        # XLA may rewrite the division as reciprocal-multiply, so pin the
+        # pivot element to exactly 1 — which in turn makes the eliminated
+        # subdiagonal exactly zero.
+        prow = (A[i] / piv).at[i].set(jnp.asarray(1.0, a.dtype))
+        yi = rhs[i] / piv
+        A = A.at[i].set(prow)
+        rhs = rhs.at[i].set(yi)
+
+        # Masked rank-1 elimination of every row below the pivot.
+        factors = jnp.where(idx > i, A[:, i], jnp.zeros((), a.dtype))
+        A = A - factors[:, None] * prow[None, :]
+        rhs = rhs - factors * yi
+        return A, rhs, perm, min_piv
+
+    u, y, perm, min_piv = lax.fori_loop(0, n, step, (a, b, idx, big))
+    return EliminationResult(u=u, y=y, perm=perm, min_abs_pivot=min_piv)
+
+
+@jax.jit
+def back_substitute(u: jax.Array, y: jax.Array) -> jax.Array:
+    """Solve ``u @ x = y`` for upper-triangular u (general diagonal).
+
+    The reference's ``solveGauss`` (gauss_internal_input.c:212-227) walks rows
+    bottom-up accumulating the dot of the already-solved suffix; here each step
+    is a full-row masked dot so the loop is a single compiled scan over n steps.
+    Rows produced by :func:`eliminate` have exact zeros below the diagonal, so
+    the unmasked part of the dot contributes nothing.
+    """
+    u = jnp.asarray(u)
+    y = jnp.asarray(y, dtype=u.dtype)
+    n = u.shape[0]
+
+    def step(k, x):
+        i = n - 1 - k
+        # x[j] is zero for j <= i (not yet solved), so a full-row dot picks up
+        # exactly the solved suffix sum_{j>i} u[i,j] * x[j].
+        acc = u[i] @ x
+        xi = (y[i] - acc) / u[i, i]
+        return x.at[i].set(xi)
+
+    return lax.fori_loop(0, n, step, jnp.zeros_like(y))
+
+
+@partial(jax.jit, static_argnames=("pivoting",))
+def gauss_solve(a: jax.Array, b: jax.Array, pivoting: str = "partial") -> jax.Array:
+    """Dense solve via forward elimination + back-substitution (oracle path).
+
+    Equivalent end-to-end behavior to the reference's
+    ``computeGauss`` + ``solveGauss`` pipeline (gauss_external_input.c:204-278).
+    For the fast blocked/MXU path see :mod:`gauss_tpu.core.blocked`.
+    """
+    res = eliminate(a, b, pivoting=pivoting)
+    return back_substitute(res.u, res.y)

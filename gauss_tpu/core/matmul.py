@@ -1,0 +1,32 @@
+"""Dense matrix multiplication core.
+
+The reference implements matmul three ways in one binary — ``seq_matmul``,
+``omp_matmul``, and the CUDA ``gpu_matmul`` kernels (reference
+CUDA_and_OpenMP/Version-1/cuda_matmul.cu:28-103). On TPU the idiomatic
+equivalent of all three is a single ``jnp.dot`` under jit: XLA tiles it onto
+the 128x128 MXU systolic array, which is precisely the role the CUDA grids
+play on the GTX 1080. A hand-written Pallas tile kernel (the CUDA Version-2
+analog) lives in :mod:`gauss_tpu.kernels.matmul_pallas`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_PRECISIONS = {
+    # float32 inputs on MXU: "highest" runs the 6-pass f32 emulation, "default"
+    # allows bf16x3/bf16 passes. We default to highest: the reference computes
+    # in double (gauss) / float (matmul) and verifies at eps=1e-4
+    # (cuda_matmul.cu:13,61-72), which bf16 single-pass would not meet at n=2048.
+    "highest": jax.lax.Precision.HIGHEST,
+    "default": jax.lax.Precision.DEFAULT,
+}
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def matmul(a: jax.Array, b: jax.Array, precision: str = "highest") -> jax.Array:
+    """C = A @ B on the MXU. Shapes (m, k) x (k, n) -> (m, n)."""
+    return jnp.dot(a, b, precision=_PRECISIONS[precision])

@@ -1,0 +1,10 @@
+"""Matrix I/O: .dat coordinate-format files and synthetic initializers."""
+
+from gauss_tpu.io.datfile import read_dat, read_dat_dense, write_dat  # noqa: F401
+from gauss_tpu.io.synthetic import (  # noqa: F401
+    internal_matrix,
+    internal_rhs,
+    generator_matrix,
+    manufactured_solution,
+    manufactured_rhs,
+)

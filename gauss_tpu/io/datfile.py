@@ -1,0 +1,147 @@
+"""Reader/writer for the reference's ``.dat`` sparse-coordinate matrix format.
+
+Format (reference Pthreads/Version-1/matrices_dense/matrix_gen.cc:13-22 and the
+parser in gauss_external_input.c:34-86):
+
+    line 1: ``n n nnz``            (rows, cols, number of entries)
+    body:   ``row col value``     one entry per line, **1-indexed**
+    end:    ``0 0 0``             terminator row (optional in some files)
+
+Entries may appear in any order; duplicate coordinates take the last value
+(matching the reference's densifying loop, which overwrites). Matrices are
+densified to row-major n x n on load exactly as ``initMatrix`` does in the
+external-input programs.
+
+A faster C++ parser for large files is provided by :mod:`gauss_tpu.native`
+(``read_dat_dense(..., engine="native")`` uses it when built).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+from typing import TextIO, Tuple, Union
+
+import numpy as np
+
+PathOrFile = Union[str, os.PathLike, TextIO]
+
+
+def _open_maybe(path_or_file: PathOrFile, mode: str):
+    if hasattr(path_or_file, "read") or hasattr(path_or_file, "write"):
+        return path_or_file, False
+    return open(path_or_file, mode), True
+
+
+def read_dat(path_or_file: PathOrFile) -> Tuple[int, np.ndarray, np.ndarray, np.ndarray]:
+    """Parse a .dat file -> (n, rows, cols, vals) with 0-indexed coordinates."""
+    f, close = _open_maybe(path_or_file, "r")
+    try:
+        header = f.readline().split()
+        if len(header) < 3:
+            raise ValueError("malformed .dat header; expected 'n n nnz'")
+        n = int(header[0])
+        n2 = int(header[1])
+        nnz = int(header[2])
+        if n != n2:
+            raise ValueError(f"non-square matrix in .dat header: {n} x {n2}")
+        rows = np.empty(nnz, dtype=np.int64)
+        cols = np.empty(nnz, dtype=np.int64)
+        vals = np.empty(nnz, dtype=np.float64)
+        count = 0
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            r, c = int(parts[0]), int(parts[1])
+            if r == 0 and c == 0:  # `0 0 0` terminator
+                break
+            if count >= nnz:
+                raise ValueError(".dat body has more entries than header nnz")
+            rows[count] = r - 1
+            cols[count] = c - 1
+            vals[count] = float(parts[2])
+            count += 1
+        if count != nnz:
+            raise ValueError(f".dat body has {count} entries, header promised {nnz}")
+        return n, rows, cols, vals
+    finally:
+        if close:
+            f.close()
+
+
+def densify(n: int, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+            dtype=np.float64) -> np.ndarray:
+    """Scatter coordinate entries into a dense row-major n x n array."""
+    dense = np.zeros((n, n), dtype=dtype)
+    dense[rows, cols] = vals
+    return dense
+
+
+def read_dat_dense(path_or_file: PathOrFile, dtype=np.float64,
+                   engine: str = "auto") -> np.ndarray:
+    """Parse + densify in one step (the external-input programs' initMatrix).
+
+    engine: "python", "native" (C++ parser via ctypes), or "auto" (native when
+    available and the input is a real file path, else python).
+    """
+    is_path = not (hasattr(path_or_file, "read"))
+    if engine not in ("auto", "python", "native"):
+        raise ValueError(f"unknown engine {engine!r}")
+    if engine in ("auto", "native") and is_path:
+        try:
+            from gauss_tpu import native
+
+            if native.available() or engine == "native":
+                return native.read_dat_dense(os.fspath(path_or_file)).astype(dtype, copy=False)
+        except Exception:
+            if engine == "native":
+                raise
+    n, rows, cols, vals = read_dat(path_or_file)
+    return densify(n, rows, cols, vals, dtype=dtype)
+
+
+def write_dat(path_or_file: PathOrFile, matrix: np.ndarray = None, *,
+              n: int = None, rows=None, cols=None, vals=None,
+              column_major: bool = True, terminator: bool = True,
+              drop_zeros: bool = False) -> None:
+    """Write a matrix in .dat coordinate format (1-indexed, `0 0 0` terminator).
+
+    With a dense ``matrix``, every entry is emitted (optionally skipping exact
+    zeros) in column-major order by default — matching matrix_gen.cc's emission
+    order (matrix_gen.cc:15-19). Alternatively pass explicit coordinate arrays.
+    """
+    if matrix is not None:
+        matrix = np.asarray(matrix)
+        n = matrix.shape[0]
+        if matrix.shape != (n, n):
+            raise ValueError("write_dat expects a square matrix")
+        if column_major:
+            cc, rr = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+            rows, cols = rr.ravel(), cc.ravel()
+        else:
+            rr, cc = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+            rows, cols = rr.ravel(), cc.ravel()
+        vals = matrix[rows, cols]
+        if drop_zeros:
+            keep = vals != 0
+            rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    else:
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        vals = np.asarray(vals)
+        if n is None:
+            raise ValueError("n is required when writing coordinate arrays")
+
+    f, close = _open_maybe(path_or_file, "w")
+    try:
+        buf = _io.StringIO()
+        buf.write(f"{n} {n} {len(vals)}\n")
+        for r, c, v in zip(rows, cols, vals):
+            buf.write(f"{int(r) + 1} {int(c) + 1} {v:g}\n")
+        if terminator:
+            buf.write("0 0 0\n")
+        f.write(buf.getvalue())
+    finally:
+        if close:
+            f.close()

@@ -1,0 +1,63 @@
+"""Synthetic matrix / RHS initializers reproducing the reference's generators.
+
+Two generator families exist in the reference and both are reproduced here:
+
+- ``internal_matrix``: the in-memory benchmark init used by every
+  internal-input program — ``matrix[i][j] = j < i ? 2*(j+1) : 2*(i+1)`` with
+  ``B[i] = i`` (reference Pthreads/Version-1/gauss_internal_input.c:59-69).
+  That formula is ``2 * (min(i, j) + 1)`` — a symmetric positive-definite
+  "min matrix" whose solution against B is the closed form
+  (-0.5, 0, ..., 0, 0.5) (gauss_internal_input.c:54-57).
+
+- ``generator_matrix``: the standalone tool's emission,
+  ``value = row < col ? 2*row : 2*col`` over 1-indexed coordinates
+  (matrix_gen.cc:15-19) — i.e. ``2 * min(row, col)`` 1-indexed, which is the
+  same matrix as ``internal_matrix`` (min is symmetric; the survey's
+  "transposed convention" collapses for a symmetric formula).
+
+- ``manufactured_rhs``: the external-input programs' oracle: preset solution
+  ``X__[i] = i + 1`` and ``R = A @ X__`` so the max relative error of a
+  computed solution is checkable (gauss_external_input.c:88-108).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def internal_matrix(n: int, dtype=np.float64) -> np.ndarray:
+    """A[i, j] = 2 * (min(i, j) + 1), the internal-input benchmark matrix."""
+    i = np.arange(n)
+    return (2.0 * (np.minimum.outer(i, i) + 1)).astype(dtype)
+
+
+def internal_rhs(n: int, dtype=np.float64) -> np.ndarray:
+    """B[i] = i (gauss_internal_input.c:68)."""
+    return np.arange(n, dtype=dtype)
+
+
+def internal_expected_solution(n: int, dtype=np.float64) -> np.ndarray:
+    """Closed-form solution of the internal system: (-0.5, 0, ..., 0, 0.5)."""
+    x = np.zeros(n, dtype=dtype)
+    x[0] = -0.5
+    x[-1] = 0.5
+    return x
+
+
+def generator_matrix(n: int, dtype=np.float64) -> np.ndarray:
+    """The matrix matrix_gen.cc emits: value = 2 * min(row, col), 1-indexed."""
+    i = np.arange(1, n + 1)
+    return (2.0 * np.minimum.outer(i, i)).astype(dtype)
+
+
+def manufactured_solution(n: int, dtype=np.float64) -> np.ndarray:
+    """X__[i] = i + 1, the external-input preset solution."""
+    return np.arange(1, n + 1, dtype=dtype)
+
+
+def manufactured_rhs(a: np.ndarray, x_true: np.ndarray = None) -> np.ndarray:
+    """R = A @ X__ computed in float64 (the external-input initRHS)."""
+    a = np.asarray(a, dtype=np.float64)
+    if x_true is None:
+        x_true = manufactured_solution(a.shape[0])
+    return a @ np.asarray(x_true, dtype=np.float64)

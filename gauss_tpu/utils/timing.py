@@ -1,0 +1,57 @@
+"""Wall-clock timing spans with device-completion semantics.
+
+The reference times with ``gettimeofday`` around the compute phase
+(reference Pthreads/Version-1/gauss_internal_input.c:278-290) and
+``clock_gettime`` per engine in CUDA (cuda_matmul.cu:135-180). On TPU,
+dispatch is asynchronous, so an honest equivalent span must end with
+``jax.block_until_ready`` on the results — every timer here does.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List
+
+import jax
+
+
+@dataclass
+class Timer:
+    """Accumulates named wall-clock spans; used by the CLI and bench harness."""
+
+    spans: Dict[str, List[float]] = field(default_factory=dict)
+
+    @contextmanager
+    def span(self, name: str, block_on: Any = None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_on is not None:
+                jax.block_until_ready(block_on)
+            self.spans.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def total(self, name: str) -> float:
+        return sum(self.spans.get(name, []))
+
+    def best(self, name: str) -> float:
+        return min(self.spans[name])
+
+
+def timed(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
+    """Run ``fn`` with compile warmup; return (best_seconds, last_result).
+
+    ``block_until_ready`` bounds every span so the number is device wall-clock,
+    not dispatch time.
+    """
+    result = None
+    for _ in range(max(warmup, 0)):
+        result = jax.block_until_ready(fn(*args, **kwargs))
+    best = float("inf")
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        result = jax.block_until_ready(fn(*args, **kwargs))
+        best = min(best, time.perf_counter() - t0)
+    return best, result

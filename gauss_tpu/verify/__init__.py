@@ -1,0 +1,8 @@
+"""Verification machinery (reference component C12, SURVEY.md §2)."""
+
+from gauss_tpu.verify.checks import (  # noqa: F401
+    max_rel_error,
+    residual_norm,
+    elementwise_match,
+    internal_pattern_ok,
+)

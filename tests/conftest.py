@@ -1,0 +1,31 @@
+"""Test harness config: CPU JAX with 8 virtual devices, float64 enabled.
+
+The reference validated its distributed path only on a real 6-node cluster
+(SURVEY.md §4.5); we instead make multi-chip sharding unit-testable by forcing
+8 virtual host devices, as the build plan prescribes (SURVEY.md §4 implication).
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(258458)  # CSC 258/458, the reference's course
+
+
+@pytest.fixture(params=[16, 33, 64])
+def n_small(request):
+    return request.param

@@ -1,0 +1,108 @@
+"""Oracle-layer tests for gauss_tpu.core.gauss.
+
+Mirrors the reference's verification strategy (SURVEY.md §4): the internal
+VERIFY pattern, the external manufactured-solution oracle, plus modern
+cross-checks against numpy.linalg.solve that the reference lacked.
+"""
+
+import numpy as np
+import pytest
+
+from gauss_tpu.core.gauss import eliminate, back_substitute, gauss_solve
+from gauss_tpu.io import synthetic
+from gauss_tpu.verify import checks
+
+
+def test_internal_pattern(n_small):
+    """The internal benchmark system solves to (-0.5, 0, ..., 0, 0.5)."""
+    n = n_small
+    a = synthetic.internal_matrix(n)
+    b = synthetic.internal_rhs(n)
+    x = np.asarray(gauss_solve(a, b, pivoting="first_nonzero"))
+    assert checks.internal_pattern_ok(x, atol=1e-8)
+
+
+def test_partial_pivot_matches_numpy(rng, n_small):
+    n = n_small
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    x = np.asarray(gauss_solve(a, b, pivoting="partial"))
+    expected = np.linalg.solve(a, b)
+    np.testing.assert_allclose(x, expected, rtol=1e-9, atol=1e-9)
+
+
+def test_manufactured_solution_oracle(rng):
+    """External flavor: RHS manufactured from X__[i] = i+1; check max rel error."""
+    n = 64
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    x_true = synthetic.manufactured_solution(n)
+    b = synthetic.manufactured_rhs(a, x_true)
+    x = np.asarray(gauss_solve(a, b, pivoting="partial"))
+    assert checks.max_rel_error(x, x_true) < 1e-10
+
+
+def test_zero_diagonal_first_nonzero_policy():
+    """first_nonzero pivoting handles an exactly-zero diagonal via row swap."""
+    a = np.array([[0.0, 2.0, 1.0],
+                  [1.0, 0.0, 3.0],
+                  [2.0, 1.0, 0.0]])
+    b = np.array([1.0, 2.0, 3.0])
+    x = np.asarray(gauss_solve(a, b, pivoting="first_nonzero"))
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-12, atol=1e-12)
+
+
+def test_perm_tracks_swaps():
+    a = np.array([[0.0, 1.0], [1.0, 0.0]])
+    b = np.array([3.0, 4.0])
+    res = eliminate(a, b, pivoting="first_nonzero")
+    # Row 1 must have been swapped into position 0.
+    assert list(np.asarray(res.perm)) == [1, 0]
+    x = np.asarray(back_substitute(res.u, res.y))
+    np.testing.assert_allclose(x, [4.0, 3.0])
+
+
+def test_unit_diagonal_and_exact_lower_zeros(rng):
+    """Pivot rows are scaled (reference getPivot semantics) and the
+    subdiagonal is eliminated to exact zeros."""
+    n = 24
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    res = eliminate(a, b, pivoting="partial")
+    u = np.asarray(res.u)
+    np.testing.assert_allclose(np.diag(u), np.ones(n), rtol=0, atol=0)
+    assert np.all(np.tril(u, -1) == 0.0)
+
+
+def test_min_abs_pivot_flags_singularity():
+    a = np.array([[1.0, 2.0], [2.0, 4.0]])  # rank 1
+    b = np.array([1.0, 2.0])
+    res = eliminate(a, b, pivoting="partial")
+    assert float(res.min_abs_pivot) < 1e-12
+
+
+def test_residual_norm_acceptance(rng):
+    """BASELINE.json acceptance bar: residual below 1e-4 (f64 oracle easily)."""
+    n = 128
+    a = synthetic.internal_matrix(n)
+    b = synthetic.internal_rhs(n)
+    x = np.asarray(gauss_solve(a, b))
+    assert checks.residual_norm(a, x, b) < 1e-6
+
+
+def test_float32_path(rng):
+    """f32 inputs stay f32 (the TPU dtype) and still solve accurately."""
+    n = 48
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = gauss_solve(a, b)
+    assert x.dtype == np.float32
+    np.testing.assert_allclose(
+        np.asarray(x), np.linalg.solve(a.astype(np.float64), b.astype(np.float64)),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_bad_pivoting_name():
+    a = np.eye(2)
+    b = np.ones(2)
+    with pytest.raises(ValueError):
+        gauss_solve(a, b, pivoting="bogus")

@@ -1,0 +1,31 @@
+import numpy as np
+
+from gauss_tpu.core.matmul import matmul
+from gauss_tpu.verify import checks
+
+
+def test_matmul_matches_numpy(rng):
+    a = rng.standard_normal((64, 48))
+    b = rng.standard_normal((48, 32))
+    c = np.asarray(matmul(a, b))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-10)
+
+
+def test_matmul_f32_epsilon(rng):
+    """The CUDA verify() bar: agree with the f64 product within eps=1e-4."""
+    n = 256
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    c = np.asarray(matmul(a, b))
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    assert checks.elementwise_match(c, ref, epsilon=checks.EPSILON * np.abs(ref).max())
+
+
+def test_cuda_input_pattern():
+    """Reference inputs A[idx]=idx+1, B[idx]=1/(idx+1) (cuda_matmul.cu:128-134)."""
+    n = 32
+    idx = np.arange(n * n, dtype=np.float64)
+    a = (idx + 1).reshape(n, n)
+    b = (1.0 / (idx + 1)).reshape(n, n)
+    c = np.asarray(matmul(a, b))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-12)

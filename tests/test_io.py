@@ -1,0 +1,72 @@
+"""Tests for .dat I/O and synthetic generators (reference C7/C8 parity)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from gauss_tpu.io import datfile, synthetic
+
+
+MATRIX_3 = "3 3 4\n1 1 2\n2 2 5\n3 1 7\n1 3 -1.5\n0 0 0\n"
+
+
+def test_read_dat_coordinates():
+    n, rows, cols, vals = datfile.read_dat(io.StringIO(MATRIX_3))
+    assert n == 3
+    assert list(rows) == [0, 1, 2, 0]
+    assert list(cols) == [0, 1, 0, 2]
+    assert list(vals) == [2.0, 5.0, 7.0, -1.5]
+
+
+def test_read_dat_dense():
+    dense = datfile.read_dat_dense(io.StringIO(MATRIX_3))
+    expected = np.zeros((3, 3))
+    expected[0, 0], expected[1, 1], expected[2, 0], expected[0, 2] = 2, 5, 7, -1.5
+    np.testing.assert_array_equal(dense, expected)
+
+
+def test_missing_terminator_ok():
+    dense = datfile.read_dat_dense(io.StringIO("2 2 1\n1 2 4\n"))
+    assert dense[0, 1] == 4.0
+
+
+def test_truncated_body_raises():
+    with pytest.raises(ValueError):
+        datfile.read_dat(io.StringIO("2 2 3\n1 1 1\n0 0 0\n"))
+
+
+def test_roundtrip(tmp_path, rng):
+    a = rng.standard_normal((7, 7))
+    p = tmp_path / "m.dat"
+    datfile.write_dat(p, a)
+    back = datfile.read_dat_dense(p, engine="python")
+    np.testing.assert_allclose(back, a, rtol=1e-5)
+
+
+def test_write_matches_generator_format(tmp_path):
+    """write_dat on generator_matrix reproduces matrix_gen's file shape:
+    header n n n*n, column-major body, 0 0 0 terminator."""
+    n = 4
+    a = synthetic.generator_matrix(n)
+    buf = io.StringIO()
+    datfile.write_dat(buf, a)
+    lines = buf.getvalue().strip().split("\n")
+    assert lines[0] == f"{n} {n} {n * n}"
+    assert lines[-1] == "0 0 0"
+    # column-major: first n entries are column 1
+    first = [line.split() for line in lines[1:1 + n]]
+    assert [f[1] for f in first] == ["1"] * n
+    # value = 2*min(row, col) 1-indexed: (1,1)->2, (2,1)->2, (3,1)->2
+    assert first[0][2] == "2"
+
+
+def test_internal_equals_generator():
+    """The two synthetic families produce the same symmetric min-matrix."""
+    np.testing.assert_array_equal(
+        synthetic.internal_matrix(6), synthetic.generator_matrix(6))
+
+
+def test_duplicate_coordinates_last_wins():
+    dense = datfile.read_dat_dense(io.StringIO("2 2 2\n1 1 3\n1 1 9\n0 0 0\n"))
+    assert dense[0, 0] == 9.0
