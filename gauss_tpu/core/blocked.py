@@ -1,0 +1,204 @@
+"""Blocked (panel) Gaussian elimination — the MXU performance path.
+
+The reference's engines all perform n dependent rank-1 eliminations over the
+full matrix (reference Pthreads/Version-1/gauss_internal_input.c:170-206 and
+every sibling); that formulation is bandwidth-bound on any hardware. The
+TPU-first redesign is a right-looking blocked factorization: the O(n^3) work
+lands in panel-wide GEMMs that XLA tiles onto the 128x128 MXU, and only the
+O(n^2 * panel) panel factorization remains rank-1/VPU work. This is the same
+transformation the reference's Version-2 "row-wise blocking" gestures at with
+its block_size=16 cache tiling (Pthreads/Version-2/gauss_internal_input.c:18,
+162-173) — taken to its logical conclusion for a systolic-array machine.
+
+Everything runs under one ``lax.fori_loop`` over panels with static shapes:
+the active trailing submatrix never shrinks; instead row/column masks zero out
+the finished region, trading ~2x redundant-but-free MXU FLOPs for a single
+compiled program (SURVEY.md §7 "hard parts" (a)/(b)).
+
+Pivoting is partial (max-|column|), the reference external-input policy —
+upgraded to be the default everywhere per SURVEY.md §7 hard part (c). Row
+permutations are tracked and returned; the factor stores L's multipliers in
+the strictly-lower triangle and U on/above the diagonal (LAPACK getrf layout),
+so one factorization serves many right-hand sides and iterative refinement.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
+
+
+class BlockedLU(NamedTuple):
+    """P @ A = L @ U factorization state (padded to a panel multiple).
+
+    m:    (npad, npad) array; strictly-lower = L multipliers, upper = U.
+    perm: (npad,) gather indices; row k of ``m`` is original row ``perm[k]``.
+    min_abs_pivot: min over steps of |pivot|; 0 means singular input.
+    """
+
+    m: jax.Array
+    perm: jax.Array
+    min_abs_pivot: jax.Array
+
+
+def _pad_to_panel(a: jax.Array, panel: int) -> jax.Array:
+    """Embed a in the top-left of an identity-padded panel-multiple array.
+
+    The identity pad keeps the factorization well-posed: padded columns have a
+    1 on their own diagonal and zeros elsewhere, padded rows can never win a
+    partial-pivot contest in a real column, and the padded block stays exactly
+    the identity through every update.
+    """
+    n = a.shape[0]
+    npad = -(-n // panel) * panel
+    if npad == n:
+        return a
+    out = jnp.zeros((npad, npad), dtype=a.dtype)
+    out = out.at[:n, :n].set(a)
+    return out.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(jnp.asarray(1.0, a.dtype))
+
+
+@partial(jax.jit, static_argnames=("panel",))
+def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL) -> BlockedLU:
+    """Blocked LU with partial pivoting; one fori_loop over column panels."""
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    m = _pad_to_panel(a, panel)
+    npad = m.shape[0]
+    nb = npad // panel
+    rows = jnp.arange(npad)
+    cols = jnp.arange(npad)
+    pcols = jnp.arange(panel)
+    dtype = m.dtype
+    one = jnp.asarray(1.0, dtype)
+
+    def panel_factor(kb, p):
+        """Unblocked partial-pivot elimination of one n x panel column block.
+
+        The rank-1 inner loop over the panel's columns — the analog of the
+        reference's subtractElim hot loop (gauss_internal_input.c:155-162) —
+        restricted to a VMEM-friendly panel width.
+        """
+
+        def step(j, carry):
+            p, ipiv, min_piv = carry
+            c = kb + j  # global row of this panel column's diagonal
+            col = p[:, j]
+            cand = jnp.where(rows >= c, jnp.abs(col), -jnp.inf)
+            piv_row = jnp.argmax(cand)
+            ipiv = ipiv.at[j].set(piv_row)
+            # Swap rows c <-> piv_row of the panel.
+            rc, rp = p[c], p[piv_row]
+            p = p.at[c].set(rp).at[piv_row].set(rc)
+            piv = p[c, j]
+            # A NaN pivot means a zero pivot already poisoned the trailing
+            # rows; report it as singular (0), not NaN.
+            apiv = jnp.abs(piv)
+            min_piv = jnp.minimum(min_piv, jnp.where(jnp.isnan(apiv), 0.0, apiv))
+            # Multipliers below the diagonal, stored in place (getrf layout).
+            mult = jnp.where(rows > c, p[:, j] / piv, jnp.zeros((), dtype))
+            p = p.at[:, j].set(jnp.where(rows > c, mult, p[:, j]))
+            # Rank-1 update of the panel columns right of j.
+            urow = jnp.where(pcols > j, p[c], jnp.zeros((), dtype))
+            p = p - mult[:, None] * urow[None, :]
+            return p, ipiv, min_piv
+
+        ipiv0 = jnp.zeros((panel,), dtype=jnp.int32)
+        return lax.fori_loop(0, panel, step, (p, ipiv0, jnp.asarray(jnp.inf, dtype)))
+
+    def outer(k, carry):
+        m, perm, min_piv = carry
+        kb = k * panel
+        p = lax.dynamic_slice(m, (0, kb), (npad, panel))
+        p, ipiv, mp = panel_factor(kb, p)
+        min_piv = jnp.minimum(min_piv, mp)
+
+        # Fold the panel's pivot swaps into one permutation and apply it to
+        # the rest of the matrix in a single gather (the panel already has
+        # them applied internally).
+        def fold(j, pl):
+            x, y = pl[kb + j], pl[ipiv[j]]
+            return pl.at[kb + j].set(y).at[ipiv[j]].set(x)
+
+        perm_local = lax.fori_loop(0, panel, fold, jnp.arange(npad))
+        m = m[perm_local]
+        perm = perm[perm_local]
+        m = lax.dynamic_update_slice(m, p, (0, kb))
+
+        # Block row of U: U12 = L11^{-1} A12, masked so finished columns
+        # (multipliers left of the panel, the panel itself) stay untouched.
+        l11 = jnp.tril(lax.dynamic_slice(m, (kb, kb), (panel, panel)), -1) + jnp.eye(
+            panel, dtype=dtype)
+        block_row = lax.dynamic_slice(m, (kb, 0), (panel, npad))
+        solved = lax.linalg.triangular_solve(
+            l11, block_row, left_side=True, lower=True, unit_diagonal=True)
+        right = cols >= kb + panel
+        block_row = jnp.where(right[None, :], solved, block_row)
+        m = lax.dynamic_update_slice(m, block_row, (kb, 0))
+
+        # Trailing GEMM on the MXU: A22 -= L21 @ U12. Full-size matmul with
+        # masked operands — rows above the trailing block and columns left of
+        # it multiply by zero, so the finished region is bit-identical.
+        l21 = jnp.where((rows >= kb + panel)[:, None],
+                        lax.dynamic_slice(m, (0, kb), (npad, panel)),
+                        jnp.zeros((), dtype))
+        u12 = jnp.where(right[None, :], block_row, jnp.zeros((), dtype))
+        m = m - jnp.dot(l21, u12, precision=lax.Precision.HIGHEST)
+        return m, perm, min_piv
+
+    m, perm, min_piv = lax.fori_loop(
+        0, nb, outer, (m, jnp.arange(npad), jnp.asarray(jnp.inf, dtype)))
+    return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv)
+
+
+@jax.jit
+def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
+    """Solve A x = b given a BlockedLU of A: permute, L-solve, U-solve."""
+    m, perm = factors.m, factors.perm
+    npad = m.shape[0]
+    b = jnp.asarray(b, dtype=m.dtype)
+    n = b.shape[0]
+    bp = jnp.zeros((npad,), dtype=m.dtype).at[:n].set(b)[perm]
+    y = lax.linalg.triangular_solve(
+        m, bp[:, None], left_side=True, lower=True, unit_diagonal=True)
+    x = lax.linalg.triangular_solve(
+        m, y, left_side=True, lower=False, unit_diagonal=False)
+    return x[:n, 0]
+
+
+@partial(jax.jit, static_argnames=("panel",))
+def gauss_solve_blocked(a: jax.Array, b: jax.Array,
+                        panel: int = DEFAULT_PANEL) -> jax.Array:
+    """Factor + solve in one jitted program (the fast single-chip solver)."""
+    return lu_solve(lu_factor_blocked(a, panel=panel), b)
+
+
+def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
+                  iters: int = 2, dtype=jnp.float32):
+    """Mixed-precision solve: f32 blocked factorization + f64 residual refinement.
+
+    TPUs are f32-native; the reference's gauss programs compute in f64. To meet
+    the BASELINE.json residual bar (||Ax - b|| < 1e-4) at n=2048 with an f32
+    factorization, we run classical iterative refinement: residuals in f64 on
+    host (one O(n^2) matvec per iteration — microseconds against the O(n^3)
+    factorization), corrections through the already-computed f32 factors.
+    Returns (x, factors) with x float64.
+    """
+    a64 = np.asarray(a, dtype=np.float64)
+    b64 = np.asarray(b, dtype=np.float64)
+    fac = lu_factor_blocked(jnp.asarray(a64, dtype=dtype), panel=panel)
+    x = np.asarray(lu_solve(fac, jnp.asarray(b64, dtype=dtype)), dtype=np.float64)
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        d = np.asarray(lu_solve(fac, jnp.asarray(r, dtype=dtype)), dtype=np.float64)
+        x = x + d
+    return x, fac

@@ -108,7 +108,10 @@ def eliminate(a: jax.Array, b: jax.Array, pivoting: str = "partial") -> Eliminat
         perm = perm.at[i].set(sp).at[p].set(si)
 
         piv = A[i, i]
-        min_piv = jnp.minimum(min_piv, jnp.abs(piv))
+        # A NaN pivot means an earlier zero pivot already poisoned the
+        # trailing rows; report it as singular (0), not NaN.
+        apiv = jnp.abs(piv)
+        min_piv = jnp.minimum(min_piv, jnp.where(jnp.isnan(apiv), jnp.zeros((), a.dtype), apiv))
 
         # Scale the pivot row to unit diagonal (reference getPivot semantics).
         # XLA may rewrite the division as reciprocal-multiply, so pin the

@@ -58,6 +58,9 @@ def read_dat(path_or_file: PathOrFile) -> Tuple[int, np.ndarray, np.ndarray, np.
                 break
             if count >= nnz:
                 raise ValueError(".dat body has more entries than header nnz")
+            if not (1 <= r <= n and 1 <= c <= n):
+                raise ValueError(
+                    f".dat entry ({r}, {c}) out of bounds for 1-indexed {n} x {n} matrix")
             rows[count] = r - 1
             cols[count] = c - 1
             vals[count] = float(parts[2])
@@ -88,6 +91,8 @@ def read_dat_dense(path_or_file: PathOrFile, dtype=np.float64,
     is_path = not (hasattr(path_or_file, "read"))
     if engine not in ("auto", "python", "native"):
         raise ValueError(f"unknown engine {engine!r}")
+    if engine == "native" and not is_path:
+        raise ValueError("engine='native' requires a file path, not a file object")
     if engine in ("auto", "native") and is_path:
         try:
             from gauss_tpu import native
@@ -138,7 +143,8 @@ def write_dat(path_or_file: PathOrFile, matrix: np.ndarray = None, *,
         buf = _io.StringIO()
         buf.write(f"{n} {n} {len(vals)}\n")
         for r, c, v in zip(rows, cols, vals):
-            buf.write(f"{int(r) + 1} {int(c) + 1} {v:g}\n")
+            # 17 significant digits: exact float64 round trip.
+            buf.write(f"{int(r) + 1} {int(c) + 1} {v:.17g}\n")
         if terminator:
             buf.write("0 0 0\n")
         f.write(buf.getvalue())

@@ -18,19 +18,35 @@ import jax
 
 
 @dataclass
+class _Span:
+    """Handle yielded by Timer.span; the body registers what to block on."""
+
+    block: Any = None
+
+
+@dataclass
 class Timer:
     """Accumulates named wall-clock spans; used by the CLI and bench harness."""
 
     spans: Dict[str, List[float]] = field(default_factory=dict)
 
     @contextmanager
-    def span(self, name: str, block_on: Any = None):
+    def span(self, name: str):
+        """Usage::
+
+            with timer.span("solve") as s:
+                s.block = gauss_solve(a, b)   # blocked on at span exit
+
+        The handle is mutable so the value to block on can be produced inside
+        the span body (a plain argument would be bound before the body runs).
+        """
+        handle = _Span()
         t0 = time.perf_counter()
         try:
-            yield
+            yield handle
         finally:
-            if block_on is not None:
-                jax.block_until_ready(block_on)
+            if handle.block is not None:
+                jax.block_until_ready(handle.block)
             self.spans.setdefault(name, []).append(time.perf_counter() - t0)
 
     def total(self, name: str) -> float:
@@ -44,7 +60,9 @@ def timed(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
     """Run ``fn`` with compile warmup; return (best_seconds, last_result).
 
     ``block_until_ready`` bounds every span so the number is device wall-clock,
-    not dispatch time.
+    not dispatch time. Caveat: on tunneled device platforms (e.g. 'axon')
+    block_until_ready has been observed to return early — use
+    :func:`timed_fetch` there, which forces a device-to-host transfer.
     """
     result = None
     for _ in range(max(warmup, 0)):
@@ -53,5 +71,24 @@ def timed(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
     for _ in range(max(iters, 1)):
         t0 = time.perf_counter()
         result = jax.block_until_ready(fn(*args, **kwargs))
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
+def timed_fetch(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
+    """Like :func:`timed`, but bounds each span with an actual host fetch of
+    the result (``np.asarray``), which is the only completion signal that
+    cannot lie. Prefer for benchmarks; the fetched bytes should be small
+    (return a scalar/vector from ``fn``, not the whole matrix, or the span
+    measures tunnel bandwidth instead of compute)."""
+    import numpy as np
+
+    result = None
+    for _ in range(max(warmup, 0)):
+        result = jax.tree.map(np.asarray, fn(*args, **kwargs))
+    best = float("inf")
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        result = jax.tree.map(np.asarray, fn(*args, **kwargs))
         best = min(best, time.perf_counter() - t0)
     return best, result

@@ -1,0 +1,33 @@
+"""Edge cases surfaced by review: bounds, lossless roundtrip, engine errors."""
+
+import io
+
+import numpy as np
+import pytest
+
+from gauss_tpu.io import datfile
+
+
+def test_zero_coordinate_rejected():
+    """'0 3 5' is not a terminator (needs both zero) and must not wrap to -1."""
+    with pytest.raises(ValueError, match="out of bounds"):
+        datfile.read_dat(io.StringIO("3 3 1\n0 3 5.0\n0 0 0\n"))
+
+
+def test_out_of_range_coordinate_rejected():
+    with pytest.raises(ValueError, match="out of bounds"):
+        datfile.read_dat(io.StringIO("3 3 1\n4 1 5.0\n0 0 0\n"))
+
+
+def test_roundtrip_exact(tmp_path):
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((9, 9))
+    p = tmp_path / "exact.dat"
+    datfile.write_dat(p, a)
+    back = datfile.read_dat_dense(p, engine="python")
+    np.testing.assert_array_equal(back, a)
+
+
+def test_native_engine_requires_path():
+    with pytest.raises(ValueError, match="file path"):
+        datfile.read_dat_dense(io.StringIO("1 1 1\n1 1 2\n"), engine="native")
