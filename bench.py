@@ -1,0 +1,92 @@
+"""Benchmark harness: one JSON line for the driver.
+
+Headline metric (BASELINE.json): gauss n=2048 wall-clock, target = beat the
+reference's best CPU result, OpenMP at 0.509428 s on a 72-core Xeon
+(BASELINE.md "Gaussian elimination — parallel, internal input"). vs_baseline
+is the speedup factor (baseline_seconds / our_seconds; > 1 means faster).
+
+Measurement method: the TPU here sits behind a tunnel with ~70 ms RTT and
+block_until_ready that can return early, so single-dispatch timing measures
+the tunnel, not the chip. We time K-iteration chains (data-dependent, so XLA
+cannot collapse them) fully on device for two values of K and take the slope
+(t_K2 - t_K1) / (K2 - K1), which cancels the constant dispatch/fetch offset.
+Each chained iteration is a full factor+solve of a fresh (perturbed) system.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+BASELINE_GAUSS_2048_S = 0.509428  # reference OpenMP best, node2x18a
+N = 2048
+K_SMALL, K_LARGE = 4, 16
+
+
+def _chained_solver(a, b, k: int, panel: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gauss_tpu.core import blocked
+
+    @jax.jit
+    def run(x0):
+        def body(_, x):
+            # Data-dependent perturbation defeats CSE while keeping the
+            # system well-conditioned (the internal matrix is SPD-like).
+            a_i = a + x[0] * jnp.asarray(1e-6, a.dtype)
+            fac = blocked.lu_factor_blocked(a_i, panel=panel)
+            return blocked.lu_solve(fac, b)
+
+        x = lax.fori_loop(0, k, body, x0)
+        return jnp.sum(x)  # scalar fetch: completion signal without bandwidth
+
+    return run
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from gauss_tpu.core.blocked import solve_refined
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.verify import checks
+
+    a64 = synthetic.internal_matrix(N)
+    b64 = synthetic.internal_rhs(N)
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    panel = 128
+
+    from gauss_tpu.utils.timing import timed_fetch
+
+    runs = {}
+    for k in (K_SMALL, K_LARGE):
+        fn = _chained_solver(a, b, k, panel)
+        runs[k], _ = timed_fetch(fn, b, warmup=1, reps=3)
+
+    per_solve = (runs[K_LARGE] - runs[K_SMALL]) / (K_LARGE - K_SMALL)
+    # Guard against timing noise making the slope non-positive.
+    per_solve = max(per_solve, 1e-9)
+
+    # Correctness gate: the refined solve must meet the 1e-4 residual bar.
+    x, _ = solve_refined(a64, b64, panel=panel, iters=2)
+    residual = checks.residual_norm(a64, x, b64)
+    pattern_ok = checks.internal_pattern_ok(x, atol=1e-4)
+
+    print(json.dumps({
+        "metric": "gauss_n2048_wallclock",
+        "value": round(per_solve, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_GAUSS_2048_S / per_solve, 2),
+        "residual": float(f"{residual:.3e}"),
+        "residual_ok": bool(residual < 1e-4),
+        "pattern_ok": bool(pattern_ok),
+        "baseline_s": BASELINE_GAUSS_2048_S,
+        "method": f"slope of K={K_SMALL} vs K={K_LARGE} on-device chains, best of 3",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""CLI drivers reproducing the reference's program surfaces (SURVEY.md §1 L3).
+
+- ``python -m gauss_tpu.cli.gauss_internal -s N -t T``   (internal-input flavor)
+- ``python -m gauss_tpu.cli.gauss_external FILE [T]``    (external-input flavor)
+- ``python -m gauss_tpu.cli.matmul N``                   (cuda_matmul flavor)
+- ``python -m gauss_tpu.cli.matrix_gen N``               (generator tool)
+
+Each driver adds ``--backend`` to select the execution engine — the pluggable
+axis the reference encodes as 12 separate binaries.
+"""

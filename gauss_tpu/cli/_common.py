@@ -1,0 +1,107 @@
+"""Shared CLI machinery: backend dispatch and reference-parity timing spans.
+
+Backend taxonomy (maps the reference's 12-binary grid onto one flag):
+
+    tpu           blocked MXU factorization, f32 + iterative refinement
+                  (the headline engine; reference CUDA/OpenMP analog)
+    tpu-unblocked pure-JAX rank-1 fori_loop elimination (reference sequential
+                  semantics on device; oracle path)
+    tpu-dist      row-cyclic shard_map over the device mesh (reference MPI
+                  gauss_mpi analog); -t selects the shard count
+    seq|omp|threads  native C++ host engines (reference CPU baselines)
+
+Timing semantics follow the reference per flavor (SURVEY.md §1 table): the
+internal flavor times init + elimination (gauss_internal_input.c:278-290), the
+external flavor times elimination only (gauss_external_input.c:300-302). For
+device backends the span includes host->device transfer of the system and is
+bounded by a host fetch of the solution — the honest analog of CUDA timing
+including cudaMemcpy (cuda_matmul.cu:135-167). JIT compilation is excluded via
+a warmup run at the same shape; the reference's binaries are likewise compiled
+ahead of the timed region.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gauss_tpu.utils.timing import timed_fetch
+
+GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-dist", "seq", "omp", "threads")
+MATMUL_BACKENDS = ("tpu", "tpu-pallas", "seq", "omp")
+
+
+def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel):
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    # Warm up compile at the target shape with an identity system.
+    n = len(b64)
+    fac = blocked.lu_factor_blocked(jnp.eye(n, dtype=jnp.float32), panel=panel)
+    np.asarray(blocked.lu_solve(fac, jnp.zeros(n, dtype=jnp.float32)))
+
+    elapsed, (x, _) = timed_fetch(
+        blocked.solve_refined, a64, b64, panel=panel, iters=refine_iters,
+        warmup=0, reps=1)
+    return x, elapsed
+
+
+def _solve_tpu_unblocked(a64, b64, pivoting):
+    import jax.numpy as jnp
+
+    from gauss_tpu.core.gauss import gauss_solve
+
+    n = len(b64)
+    # Warmup at shape with identity to exclude compile time.
+    np.asarray(gauss_solve(jnp.eye(n, dtype=jnp.float32),
+                           jnp.zeros(n, dtype=jnp.float32), pivoting=pivoting))
+    elapsed, x = timed_fetch(
+        lambda: gauss_solve(jnp.asarray(a64, jnp.float32),
+                            jnp.asarray(b64, jnp.float32), pivoting=pivoting),
+        warmup=0, reps=1)
+    return np.asarray(x, np.float64), elapsed
+
+
+def _solve_tpu_dist(a64, b64, nthreads):
+    import jax
+
+    from gauss_tpu.dist import gauss_dist
+
+    ndev = len(jax.devices())
+    shards = max(1, min(nthreads or ndev, ndev))
+    mesh = gauss_dist.make_mesh(shards)
+    n = len(b64)
+    import jax.numpy as jnp
+
+    # Warmup.
+    np.asarray(gauss_dist.gauss_solve_dist(
+        jnp.eye(n, dtype=jnp.float32), jnp.zeros(n, dtype=jnp.float32), mesh=mesh))
+    elapsed, x = timed_fetch(
+        lambda: gauss_dist.gauss_solve_dist(
+            jnp.asarray(a64, jnp.float32), jnp.asarray(b64, jnp.float32), mesh=mesh),
+        warmup=0, reps=1)
+    return np.asarray(x, np.float64), elapsed
+
+
+def _solve_native(a64, b64, backend, nthreads):
+    from gauss_tpu import native
+
+    elapsed, x = timed_fetch(
+        native.gauss_solve, a64, b64, engine=backend, nthreads=nthreads,
+        warmup=0, reps=1)
+    return x, elapsed
+
+
+def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
+                       nthreads: int = 0, pivoting: str = "partial",
+                       refine_iters: int = 2, panel: int = 128):
+    """Dispatch a solve; returns (x_float64, elapsed_seconds)."""
+    if backend == "tpu":
+        return _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel)
+    if backend == "tpu-unblocked":
+        return _solve_tpu_unblocked(a64, b64, pivoting)
+    if backend == "tpu-dist":
+        return _solve_tpu_dist(a64, b64, nthreads)
+    if backend in ("seq", "omp", "threads"):
+        return _solve_native(a64, b64, backend, nthreads)
+    raise ValueError(f"unknown backend {backend!r}; options: {GAUSS_BACKENDS}")

@@ -1,0 +1,45 @@
+"""Matrix generator CLI: emits the synthetic matrix in .dat format to stdout.
+
+Reference surface (matrices_dense/matrix_gen.cc + Makefile): ``./matrix_gen <n>``.
+Dispatches to the native C++ tool when built (identical output); otherwise
+falls back to the Python writer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+from gauss_tpu.io import datfile, synthetic
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="matrix_gen",
+        description="Emit the synthetic benchmark matrix in .dat coordinate format.")
+    p.add_argument("n", type=int, help="matrix dimension")
+    p.add_argument("--python", action="store_true",
+                   help="force the Python writer (skip the native tool)")
+    args = p.parse_args(argv)
+    if args.n <= 0:
+        print("matrix_gen: n must be positive", file=sys.stderr)
+        return 1
+
+    if not args.python:
+        try:
+            from gauss_tpu import native
+
+            rc = subprocess.run([native.matrix_gen_path(), str(args.n)],
+                                stdout=sys.stdout)
+            return rc.returncode
+        except Exception:
+            pass  # fall back to Python below
+
+    # Values are small integers; the .17g format prints them exactly.
+    datfile.write_dat(sys.stdout, synthetic.generator_matrix(args.n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,7 +95,7 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL) -> BlockedLU:
             col = p[:, j]
             cand = jnp.where(rows >= c, jnp.abs(col), -jnp.inf)
             piv_row = jnp.argmax(cand)
-            ipiv = ipiv.at[j].set(piv_row)
+            ipiv = ipiv.at[j].set(piv_row.astype(ipiv.dtype))
             # Swap rows c <-> piv_row of the panel.
             rc, rp = p[c], p[piv_row]
             p = p.at[c].set(rp).at[piv_row].set(rc)
@@ -136,8 +136,9 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL) -> BlockedLU:
 
         # Block row of U: U12 = L11^{-1} A12, masked so finished columns
         # (multipliers left of the panel, the panel itself) stay untouched.
-        l11 = jnp.tril(lax.dynamic_slice(m, (kb, kb), (panel, panel)), -1) + jnp.eye(
-            panel, dtype=dtype)
+        # triangular_solve(lower, unit_diagonal) reads only the strict lower
+        # triangle, which holds exactly L11's multipliers — no masking needed.
+        l11 = lax.dynamic_slice(m, (kb, kb), (panel, panel))
         block_row = lax.dynamic_slice(m, (kb, 0), (panel, npad))
         solved = lax.linalg.triangular_solve(
             l11, block_row, left_side=True, lower=True, unit_diagonal=True)

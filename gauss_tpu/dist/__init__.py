@@ -1,0 +1,15 @@
+"""Multi-chip execution engines over a jax.sharding.Mesh (SURVEY.md §7.4).
+
+The reference's only distributed path is the MPI master–worker gauss
+(reference OpenMP_and_MPI/gauss_mpi/gauss_internal_input.c:124-255): rank 0
+owns the matrix and, per pivot step, broadcasts the pivot row and ships row
+blocks out and back over the network — the documented bottleneck (its own
+report ranks MPI slowest). The TPU-native re-expression keeps data
+device-resident and sharded permanently: rows live row-cyclically across the
+mesh, the pivot row rides a psum over ICI instead of MPI_Bcast + Isend/Irecv,
+and the SPMD program order replaces MPI_Barrier.
+"""
+
+from gauss_tpu.dist.mesh import make_mesh  # noqa: F401
+from gauss_tpu.dist.gauss_dist import gauss_solve_dist, eliminate_dist  # noqa: F401
+from gauss_tpu.dist.matmul_dist import matmul_dist  # noqa: F401

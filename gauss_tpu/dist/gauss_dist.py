@@ -1,0 +1,169 @@
+"""Row-cyclic distributed Gaussian elimination under shard_map.
+
+TPU-first re-expression of the reference's MPI master–worker engine
+(reference OpenMP_and_MPI/gauss_mpi/gauss_internal_input.c:124-255), redesigned
+per SURVEY.md §5/§7.4:
+
+- **Row-cyclic ownership** replaces the master's per-step row-block scatter:
+  global row g lives permanently on shard ``g % P`` (the load-balance trick of
+  the reference's Pthreads cyclic striping, Version-1 gauss_internal_input.c:155,
+  now applied across chips) — late pivot steps still touch every shard.
+- **Pivot-row broadcast** is one ``psum`` of a masked contribution over ICI,
+  replacing MPI_Bcast of the pivot row tail + tagged Isend/Irecv of row blocks
+  (the reference ships the full O(n^2) working set over the network per step;
+  here only the pivot row and a handful of scalars move).
+- **Cross-shard partial pivoting**: local masked argmax, then an ``all_gather``
+  of (value, global-index) candidates — the distributed upgrade of the
+  reference's rank-0-serial getPivot, which SURVEY.md §7 hard part (d) calls
+  out as the latency-critical piece.
+- **Barriers are implicit**: SPMD program order replaces MPI_Barrier, and
+  there are no shutdown/no-work sentinels (bs=-1 / i=-1) because control flow
+  is compiled, not message-driven.
+
+The whole n-step elimination plus distributed back-substitution compiles to a
+single XLA program per (n, P, dtype).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gauss_tpu.dist.mesh import ROWS_AXIS, make_mesh
+
+
+def _cyclic_perm(npad: int, nshards: int) -> np.ndarray:
+    """Row permutation placing global row l*P + d at shard d, local slot l.
+
+    perm[d * m + l] = l * P + d; applying ``a[perm]`` then sharding the leading
+    axis contiguously gives each shard exactly its cyclic row set.
+    """
+    m = npad // nshards
+    return np.arange(npad).reshape(m, nshards).T.reshape(-1)
+
+
+@lru_cache(maxsize=32)
+def _build_solver(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
+    axis = mesh.axis_names[0]
+    nshards = mesh.devices.shape[0]
+    m = npad // nshards
+    dtype = jnp.dtype(dtype_name)
+
+    def shard_fn(a_loc, b_loc):
+        """Runs on every shard: a_loc (m, npad) cyclic rows, b_loc (m,)."""
+        d = lax.axis_index(axis)
+        local_g = jnp.arange(m) * nshards + d  # global index of each local row
+
+        def elim_step(i, carry):
+            A, rhs = carry
+            l_i = i // nshards
+            d_i = i % nshards
+            own_i = d == d_i
+
+            # --- distributed partial pivot (getPivot across shards) ---
+            col = A[:, i]
+            cand = jnp.where(local_g >= i, jnp.abs(col), -jnp.inf)
+            lbest = jnp.argmax(cand)
+            vals = lax.all_gather(cand[lbest], axis)          # (P,)
+            gidxs = lax.all_gather(local_g[lbest], axis)      # (P,)
+            gpiv = gidxs[jnp.argmax(vals)]
+            l_p = gpiv // nshards
+            d_p = gpiv % nshards
+            own_p = d == d_p
+
+            # --- broadcast both swap rows (+rhs) in ONE psum over ICI ---
+            zero = jnp.zeros((), dtype)
+            contrib = jnp.zeros((2, npad + 1), dtype)
+            contrib = contrib.at[0, :npad].set(jnp.where(own_i, A[l_i], zero))
+            contrib = contrib.at[0, npad].set(jnp.where(own_i, rhs[l_i], zero))
+            contrib = contrib.at[1, :npad].set(jnp.where(own_p, A[l_p], zero))
+            contrib = contrib.at[1, npad].set(jnp.where(own_p, rhs[l_p], zero))
+            both = lax.psum(contrib, axis)
+            row_i, b_i = both[0, :npad], both[0, npad]
+            row_p, b_p = both[1, :npad], both[1, npad]
+
+            # Scale the pivot row (reference getPivot semantics, diag pinned).
+            piv = row_p[i]
+            prow = (row_p / piv).at[i].set(jnp.asarray(1.0, dtype))
+            y_i = b_p / piv
+
+            # Swap: slot of gpiv receives old row i; slot of i receives the
+            # scaled pivot row. Write order makes gpiv == i come out right.
+            A = A.at[l_p].set(jnp.where(own_p, row_i, A[l_p]))
+            rhs = rhs.at[l_p].set(jnp.where(own_p, b_i, rhs[l_p]))
+            A = A.at[l_i].set(jnp.where(own_i, prow, A[l_i]))
+            rhs = rhs.at[l_i].set(jnp.where(own_i, y_i, rhs[l_i]))
+
+            # --- local elimination of owned rows below the pivot ---
+            factors = jnp.where(local_g > i, A[:, i], zero)
+            A = A - factors[:, None] * prow[None, :]
+            rhs = rhs - factors * y_i
+            return A, rhs
+
+        A, rhs = lax.fori_loop(0, npad, elim_step, (a_loc, b_loc))
+
+        # --- distributed back-substitution: owner solves, psum broadcasts ---
+        def back_step(k, x):
+            i = npad - 1 - k
+            l_i = i // nshards
+            own = d == (i % nshards)
+            # Unsolved entries of x are 0 and U has unit diagonal, so the
+            # full-row dot picks up exactly the solved suffix.
+            acc = A[l_i] @ x
+            xi = lax.psum(jnp.where(own, rhs[l_i] - acc, jnp.zeros((), dtype)), axis)
+            return x.at[i].set(xi)
+
+        x = lax.fori_loop(0, npad, back_step, jnp.zeros((npad,), dtype))
+        return x
+
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=P(None))
+    return jax.jit(mapped)
+
+
+def _prepare(a, b, nshards: int):
+    """Pad to a shard multiple (identity pad, as in core.blocked) and apply
+    the cyclic row permutation to both the matrix and the RHS."""
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    b = jnp.asarray(b, dtype=a.dtype)
+    npad = -(-n // nshards) * nshards
+    if npad != n:
+        ap = jnp.zeros((npad, npad), a.dtype).at[:n, :n].set(a)
+        ap = ap.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(
+            jnp.asarray(1.0, a.dtype))
+        bp = jnp.zeros((npad,), a.dtype).at[:n].set(b)
+    else:
+        ap, bp = a, b
+    perm = _cyclic_perm(npad, nshards)
+    return ap[perm], bp[perm], npad
+
+
+def gauss_solve_dist(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:
+    """Distributed dense solve; returns x replicated on every shard.
+
+    Columns are never permuted, so x comes back in natural order. The
+    reference equivalent is `mpirun -np P gauss_internal_input` with the
+    matrix resident only on rank 0; here it is sharded the whole time.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    nshards = mesh.devices.shape[0]
+    a_c, b_c, npad = _prepare(a, b, nshards)
+    n = jnp.asarray(a).shape[0]
+    solver = _build_solver(mesh, npad, str(a_c.dtype))
+    x = solver(a_c, b_c)
+    return x[:n]
+
+
+def eliminate_dist(a, b, mesh: jax.sharding.Mesh = None):
+    """Forward elimination + back-substitution, exposed for tests/benchmarks
+    (same signature family as core.gauss.gauss_solve)."""
+    return gauss_solve_dist(a, b, mesh=mesh)

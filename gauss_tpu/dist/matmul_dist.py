@@ -1,0 +1,53 @@
+"""Sharded dense matmul via pjit sharding annotations.
+
+The CUDA matmul engines (reference CUDA_and_OpenMP/Version-{1,2}/cuda_matmul.cu)
+are single-GPU; the reference has no distributed matmul. The TPU framework
+gets one for free from the sharding model: annotate operand shardings over the
+mesh and let XLA insert the collectives (SURVEY.md §5 "distributed
+communication backend"). Two layouts:
+
+- 1-D: A row-sharded, B replicated -> C row-sharded. No communication in the
+  matmul itself; the all_gather (if the caller wants C replicated) rides ICI.
+- 2-D: A sharded (rows, None), B sharded (None, cols) -> C sharded
+  (rows, cols) — the classic SUMMA-style layout, collectives inserted by XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gauss_tpu.dist.mesh import make_mesh
+
+
+def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
+                precision: str = "highest", replicate_out: bool = True):
+    """C = A @ B with operands sharded over the mesh."""
+    if mesh is None:
+        mesh = make_mesh()
+    a = jnp.asarray(a)
+    b = jnp.asarray(b, dtype=a.dtype)
+    prec = (lax.Precision.HIGHEST if precision == "highest"
+            else lax.Precision.DEFAULT)
+
+    if mesh.devices.ndim == 1:
+        axis = mesh.axis_names[0]
+        in_shardings = (NamedSharding(mesh, P(axis, None)),
+                        NamedSharding(mesh, P()))
+        out_spec = P() if replicate_out else P(axis, None)
+    else:
+        r, c = mesh.axis_names
+        in_shardings = (NamedSharding(mesh, P(r, None)),
+                        NamedSharding(mesh, P(None, c)))
+        out_spec = P() if replicate_out else P(r, c)
+
+    @jax.jit
+    def run(a, b):
+        c = jnp.dot(a, b, precision=prec)
+        return lax.with_sharding_constraint(c, NamedSharding(mesh, out_spec))
+
+    a = jax.device_put(a, in_shardings[0])
+    b = jax.device_put(b, in_shardings[1])
+    return run(a, b)

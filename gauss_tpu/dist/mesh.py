@@ -1,0 +1,38 @@
+"""Device-mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+ROWS_AXIS = "rows"
+
+
+def make_mesh(n_shards: Optional[int] = None, axis: str = ROWS_AXIS,
+              devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """A 1-D mesh over the first ``n_shards`` devices (default: all).
+
+    The reference pins its distributed size with ``mpirun -np N`` and a
+    hostfile (OpenMP_and_MPI/README.txt:39-48); here the mesh is the cluster
+    and the axis name is the address space collectives run over.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_shards is not None:
+        if n_shards > len(devs):
+            raise ValueError(f"requested {n_shards} shards but only "
+                             f"{len(devs)} devices are visible")
+        devs = devs[:n_shards]
+    return jax.sharding.Mesh(np.array(devs), (axis,))
+
+
+def make_mesh_2d(rows: int, cols: int, axes=("rows", "cols"),
+                 devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """A rows x cols 2-D mesh (for the 2-D-sharded gauss / matmul variants)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if rows * cols > len(devs):
+        raise ValueError(f"requested {rows}x{cols} mesh but only "
+                         f"{len(devs)} devices are visible")
+    grid = np.array(devs[: rows * cols]).reshape(rows, cols)
+    return jax.sharding.Mesh(grid, axes)

@@ -53,7 +53,12 @@ def read_dat(path_or_file: PathOrFile) -> Tuple[int, np.ndarray, np.ndarray, np.
             parts = line.split()
             if not parts:
                 continue
-            r, c = int(parts[0]), int(parts[1])
+            if len(parts) < 2 or (len(parts) < 3 and not (parts[0] == "0" and parts[1] == "0")):
+                raise ValueError(f"malformed .dat body line: {line.rstrip()!r}")
+            try:
+                r, c = int(parts[0]), int(parts[1])
+            except ValueError as e:
+                raise ValueError(f"malformed .dat body line: {line.rstrip()!r}") from e
             if r == 0 and c == 0:  # `0 0 0` terminator
                 break
             if count >= nnz:

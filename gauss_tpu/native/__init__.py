@@ -1,0 +1,179 @@
+"""ctypes bindings to the native C++ runtime (build-on-demand).
+
+Provides the host-side native components the reference keeps in C/C++
+(SURVEY.md §2 C7/C9 and the CPU baseline engines): a fast .dat parser, the
+``matrix_gen`` tool, and seq / OpenMP / std::thread Gaussian-elimination and
+matmul engines. Falls back gracefully (``available() == False``) when no
+toolchain is present; set ``GAUSS_TPU_NO_NATIVE=1`` to disable entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC_DIR = Path(__file__).resolve().parent / "src"
+_LIB_PATH = _SRC_DIR / "libgauss_native.so"
+_GEN_PATH = _SRC_DIR / "matrix_gen"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+GAUSS_ENGINES = ("seq", "omp", "threads")
+MATMUL_ENGINES = ("seq", "omp")
+
+
+def _sources_newer_than(artifact: Path) -> bool:
+    if not artifact.exists():
+        return True
+    amt = artifact.stat().st_mtime
+    return any(src.stat().st_mtime > amt for src in _SRC_DIR.glob("*.cc"))
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Build the .so + matrix_gen if missing or stale. Returns success."""
+    global _build_failed
+    if os.environ.get("GAUSS_TPU_NO_NATIVE"):
+        return False
+    with _lock:
+        if not force and _build_failed:
+            return False
+        if force or _sources_newer_than(_LIB_PATH) or _sources_newer_than(_GEN_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_SRC_DIR)],
+                    check=True, capture_output=True, text=True, timeout=300)
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                    FileNotFoundError) as e:
+                _build_failed = True
+                detail = getattr(e, "stderr", "") or str(e)
+                import warnings
+
+                warnings.warn(f"native build failed; using fallbacks: {detail[-500:]}")
+                return False
+        return _LIB_PATH.exists()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not ensure_built():
+        return None
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            dp = ctypes.POINTER(ctypes.c_double)
+            lib.gt_gauss_solve_seq.argtypes = [dp, dp, dp, ctypes.c_long]
+            lib.gt_gauss_solve_seq.restype = ctypes.c_int
+            lib.gt_gauss_solve_omp.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
+            lib.gt_gauss_solve_omp.restype = ctypes.c_int
+            lib.gt_gauss_solve_threads.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
+            lib.gt_gauss_solve_threads.restype = ctypes.c_int
+            lib.gt_matmul_seq.argtypes = [dp, dp, dp, ctypes.c_long]
+            lib.gt_matmul_seq.restype = None
+            lib.gt_matmul_omp.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
+            lib.gt_matmul_omp.restype = None
+            lib.gt_dat_read_header.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+            lib.gt_dat_read_header.restype = ctypes.c_int
+            lib.gt_dat_read_dense.argtypes = [ctypes.c_char_p, dp, ctypes.c_long]
+            lib.gt_dat_read_dense.restype = ctypes.c_int
+            _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_c(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float64)
+
+
+def gauss_solve(a: np.ndarray, b: np.ndarray, engine: str = "seq",
+                nthreads: int = 0) -> np.ndarray:
+    """Solve A x = b with a native CPU engine. A/b are not modified."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no toolchain or build failed)")
+    if engine not in GAUSS_ENGINES:
+        raise ValueError(f"unknown native gauss engine {engine!r}; options: {GAUSS_ENGINES}")
+    a = _as_c(a).copy()
+    b = _as_c(b).copy()
+    n = a.shape[0]
+    if a.shape != (n, n) or b.shape != (n,):
+        raise ValueError(f"expected square a and matching b; got {a.shape} and {b.shape}")
+    x = np.empty(n, dtype=np.float64)
+    dp = ctypes.POINTER(ctypes.c_double)
+    pa, pb, px = (arr.ctypes.data_as(dp) for arr in (a, b, x))
+    if engine == "seq":
+        rc = lib.gt_gauss_solve_seq(pa, pb, px, n)
+    elif engine == "omp":
+        rc = lib.gt_gauss_solve_omp(pa, pb, px, n, nthreads)
+    else:
+        rc = lib.gt_gauss_solve_threads(pa, pb, px, n, nthreads or (os.cpu_count() or 2))
+    if rc == -1:
+        raise np.linalg.LinAlgError("matrix is singular")
+    if rc != 0:
+        raise RuntimeError(f"native gauss engine failed with code {rc}")
+    return x
+
+
+def matmul(a: np.ndarray, b: np.ndarray, engine: str = "seq",
+           nthreads: int = 0) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no toolchain or build failed)")
+    if engine not in MATMUL_ENGINES:
+        raise ValueError(f"unknown native matmul engine {engine!r}; options: {MATMUL_ENGINES}")
+    a = _as_c(a)
+    b = _as_c(b)
+    n = a.shape[0]
+    if a.shape != (n, n) or b.shape != (n, n):
+        raise ValueError("native matmul expects square same-size matrices")
+    c = np.empty((n, n), dtype=np.float64)
+    dp = ctypes.POINTER(ctypes.c_double)
+    pa, pb, pc = (arr.ctypes.data_as(dp) for arr in (a, b, c))
+    if engine == "seq":
+        lib.gt_matmul_seq(pa, pb, pc, n)
+    else:
+        lib.gt_matmul_omp(pa, pb, pc, n, nthreads)
+    return c
+
+
+def read_dat_header(path: str) -> tuple[int, int]:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = ctypes.c_long()
+    nnz = ctypes.c_long()
+    rc = lib.gt_dat_read_header(os.fsencode(path), ctypes.byref(n), ctypes.byref(nnz))
+    if rc != 0:
+        raise ValueError(f"failed to parse .dat header of {path} (code {rc})")
+    return n.value, nnz.value
+
+
+def read_dat_dense(path: str) -> np.ndarray:
+    """Fast native .dat parse + densify; same semantics as the Python parser."""
+    n, _ = read_dat_header(path)
+    out = np.empty((n, n), dtype=np.float64)
+    lib = _load()
+    rc = lib.gt_dat_read_dense(
+        os.fsencode(path), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n)
+    if rc != 0:
+        raise ValueError(f"failed to parse .dat body of {path} (code {rc})")
+    return out
+
+
+def matrix_gen_path() -> str:
+    """Path to the built matrix_gen binary (building if needed)."""
+    if not ensure_built() or not _GEN_PATH.exists():
+        raise RuntimeError("matrix_gen binary unavailable")
+    return str(_GEN_PATH)

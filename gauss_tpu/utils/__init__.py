@@ -1,3 +1,3 @@
 """Shared utilities: timing spans, padding helpers."""
 
-from gauss_tpu.utils.timing import Timer, timed, timed_fetch  # noqa: F401
+from gauss_tpu.utils.timing import timed, timed_fetch  # noqa: F401

@@ -1,62 +1,23 @@
-"""Wall-clock timing spans with device-completion semantics.
+"""Wall-clock timing with device-completion semantics.
 
 The reference times with ``gettimeofday`` around the compute phase
 (reference Pthreads/Version-1/gauss_internal_input.c:278-290) and
 ``clock_gettime`` per engine in CUDA (cuda_matmul.cu:135-180). On TPU,
-dispatch is asynchronous, so an honest equivalent span must end with
-``jax.block_until_ready`` on the results — every timer here does.
+dispatch is asynchronous, so an honest equivalent span must end with device
+completion: :func:`timed` uses ``jax.block_until_ready``; :func:`timed_fetch`
+(used by the CLI drivers and bench.py) forces a host fetch, which is the only
+completion signal that holds on tunneled platforms.
 """
 
 from __future__ import annotations
 
 import time
-from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List
+from typing import Callable
 
 import jax
 
 
-@dataclass
-class _Span:
-    """Handle yielded by Timer.span; the body registers what to block on."""
-
-    block: Any = None
-
-
-@dataclass
-class Timer:
-    """Accumulates named wall-clock spans; used by the CLI and bench harness."""
-
-    spans: Dict[str, List[float]] = field(default_factory=dict)
-
-    @contextmanager
-    def span(self, name: str):
-        """Usage::
-
-            with timer.span("solve") as s:
-                s.block = gauss_solve(a, b)   # blocked on at span exit
-
-        The handle is mutable so the value to block on can be produced inside
-        the span body (a plain argument would be bound before the body runs).
-        """
-        handle = _Span()
-        t0 = time.perf_counter()
-        try:
-            yield handle
-        finally:
-            if handle.block is not None:
-                jax.block_until_ready(handle.block)
-            self.spans.setdefault(name, []).append(time.perf_counter() - t0)
-
-    def total(self, name: str) -> float:
-        return sum(self.spans.get(name, []))
-
-    def best(self, name: str) -> float:
-        return min(self.spans[name])
-
-
-def timed(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
+def timed(fn: Callable, *args, warmup: int = 1, reps: int = 1, **kwargs):
     """Run ``fn`` with compile warmup; return (best_seconds, last_result).
 
     ``block_until_ready`` bounds every span so the number is device wall-clock,
@@ -68,14 +29,14 @@ def timed(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
     for _ in range(max(warmup, 0)):
         result = jax.block_until_ready(fn(*args, **kwargs))
     best = float("inf")
-    for _ in range(max(iters, 1)):
+    for _ in range(max(reps, 1)):
         t0 = time.perf_counter()
         result = jax.block_until_ready(fn(*args, **kwargs))
         best = min(best, time.perf_counter() - t0)
     return best, result
 
 
-def timed_fetch(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
+def timed_fetch(fn: Callable, *args, warmup: int = 1, reps: int = 1, **kwargs):
     """Like :func:`timed`, but bounds each span with an actual host fetch of
     the result (``np.asarray``), which is the only completion signal that
     cannot lie. Prefer for benchmarks; the fetched bytes should be small
@@ -87,7 +48,7 @@ def timed_fetch(fn: Callable, *args, warmup: int = 1, iters: int = 1, **kwargs):
     for _ in range(max(warmup, 0)):
         result = jax.tree.map(np.asarray, fn(*args, **kwargs))
     best = float("inf")
-    for _ in range(max(iters, 1)):
+    for _ in range(max(reps, 1)):
         t0 = time.perf_counter()
         result = jax.tree.map(np.asarray, fn(*args, **kwargs))
         best = min(best, time.perf_counter() - t0)

@@ -8,13 +8,19 @@ Must run before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the environment may pin JAX_PLATFORMS to a tunneled TPU
+# ('axon'); tests must run on local CPU with virtual devices.
+os.environ["JAX_PLATFORMS"] = os.environ.get("GAUSS_TPU_TEST_PLATFORM", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+# The env var alone is not enough: the image's sitecustomize pins the tunneled
+# TPU platform ('axon'); the config update takes precedence (backend init is
+# lazy, so doing this before any jax.devices() call is sufficient).
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

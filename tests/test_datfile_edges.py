@@ -31,3 +31,12 @@ def test_roundtrip_exact(tmp_path):
 def test_native_engine_requires_path():
     with pytest.raises(ValueError, match="file path"):
         datfile.read_dat_dense(io.StringIO("1 1 1\n1 1 2\n"), engine="native")
+
+
+def test_malformed_body_line_raises_valueerror():
+    """Short or garbage body lines raise ValueError (not IndexError), so the
+    CLI's error handling catches them."""
+    with pytest.raises(ValueError, match="malformed"):
+        datfile.read_dat(io.StringIO("3 3 1\n1 2\n0 0 0\n"))
+    with pytest.raises(ValueError, match="malformed"):
+        datfile.read_dat(io.StringIO("3 3 1\nx y z\n0 0 0\n"))

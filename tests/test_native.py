@@ -1,0 +1,92 @@
+"""Native C++ runtime tests: engines vs numpy, .dat parser parity, matrix_gen."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from gauss_tpu import native
+from gauss_tpu.io import datfile, synthetic
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.mark.parametrize("engine", native.GAUSS_ENGINES)
+def test_native_gauss_matches_numpy(rng, engine):
+    n = 80
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    x = native.gauss_solve(a, b, engine=engine, nthreads=4)
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("engine", native.GAUSS_ENGINES)
+def test_native_gauss_internal_pattern(engine):
+    from gauss_tpu.verify import checks
+
+    n = 128
+    a = synthetic.internal_matrix(n)
+    b = synthetic.internal_rhs(n)
+    x = native.gauss_solve(a, b, engine=engine, nthreads=3)
+    assert checks.internal_pattern_ok(x, atol=1e-8)
+
+
+def test_native_singular_raises():
+    a = np.ones((8, 8))
+    b = np.ones(8)
+    with pytest.raises(np.linalg.LinAlgError):
+        native.gauss_solve(a, b, engine="seq")
+
+
+@pytest.mark.parametrize("engine", native.MATMUL_ENGINES)
+def test_native_matmul(rng, engine):
+    n = 64
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, n))
+    c = native.matmul(a, b, engine=engine, nthreads=2)
+    np.testing.assert_allclose(c, a @ b, rtol=1e-12)
+
+
+def test_native_dat_parser_matches_python(tmp_path, rng):
+    a = rng.standard_normal((17, 17))
+    p = tmp_path / "m.dat"
+    datfile.write_dat(p, a)
+    via_native = native.read_dat_dense(str(p))
+    via_python = datfile.read_dat_dense(p, engine="python")
+    np.testing.assert_array_equal(via_native, via_python)
+    np.testing.assert_array_equal(via_native, a)
+
+
+def test_native_parser_rejects_bad_coords(tmp_path):
+    p = tmp_path / "bad.dat"
+    p.write_text("3 3 1\n0 3 5.0\n0 0 0\n")
+    with pytest.raises(ValueError):
+        native.read_dat_dense(str(p))
+
+
+def test_native_parser_rejects_truncated(tmp_path):
+    p = tmp_path / "trunc.dat"
+    p.write_text("2 2 3\n1 1 1\n0 0 0\n")
+    with pytest.raises(ValueError):
+        native.read_dat_dense(str(p))
+
+
+def test_matrix_gen_tool(tmp_path):
+    """The C++ tool emits the generator matrix in valid .dat format."""
+    out = subprocess.run([native.matrix_gen_path(), "5"],
+                         capture_output=True, text=True, check=True)
+    import io
+
+    dense = datfile.read_dat_dense(io.StringIO(out.stdout), engine="python")
+    np.testing.assert_array_equal(dense, synthetic.generator_matrix(5))
+    lines = out.stdout.strip().split("\n")
+    assert lines[0] == "5 5 25"
+    assert lines[-1] == "0 0 0"
+
+
+def test_matrix_gen_bad_args():
+    rc = subprocess.run([native.matrix_gen_path()], capture_output=True)
+    assert rc.returncode != 0
+    rc = subprocess.run([native.matrix_gen_path(), "-3"], capture_output=True)
+    assert rc.returncode != 0
