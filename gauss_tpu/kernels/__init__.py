@@ -1,0 +1,18 @@
+"""Hand-written Pallas TPU kernels (reference CUDA engines, re-tiled for MXU/VPU).
+
+- matmul_pallas: output-tile-per-program tiled matmul — the MXU re-expression
+  of CUDA Version-2's one-thread-per-cell grid (reference
+  CUDA_and_OpenMP/Version-2/cuda_matmul.cu:89-101).
+- rowelim_pallas: one pivot step (pivot-row broadcast + masked per-row SAXPY)
+  over an HBM-resident matrix, tiled to VMEM — the BASELINE.json north-star
+  kernel and the analog of the reference's subtractElim hot loop.
+- panel_pallas: VMEM-resident panel factorization driving the blocked LU's
+  inner loop without per-step HBM round trips.
+
+All kernels accept ``interpret=`` for CPU-interpreter execution (how the test
+suite runs them without a TPU); ``None`` auto-selects based on the backend.
+"""
+
+from gauss_tpu.kernels.matmul_pallas import matmul_pallas  # noqa: F401
+from gauss_tpu.kernels.panel_pallas import panel_factor_pallas  # noqa: F401
+from gauss_tpu.kernels.rowelim_pallas import eliminate_step_pallas, gauss_solve_rowelim  # noqa: F401

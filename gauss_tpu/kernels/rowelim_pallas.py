@@ -1,0 +1,132 @@
+"""Row-elimination Pallas kernel: one pivot step over an HBM-resident matrix.
+
+This is the BASELINE.json north-star kernel: "the row-reduction inner loop of
+Gaussian elimination (pivot-row broadcast + per-row SAXPY elimination)
+becomes a Pallas kernel over HBM-resident float32 matrices". It is the TPU
+re-expression of the reference's ``subtractElim`` hot loop
+(reference Pthreads/Version-1/gauss_internal_input.c:140-164): where a pthread
+strides rows ``i+1+tid, i+1+tid+T, ...``, here a (rows, cols) grid of programs
+each owns one VMEM tile; the pivot row arrives in every column-tile's program
+via a dynamically-indexed (1, bn) block (the broadcast), the multiplier column
+via a (bm, 1) block, and the update is one fused VPU FMA per tile.
+
+The pivot *selection* and row swap stay outside the kernel in jnp (they are
+O(n) work on one column; the kernel is the O(n^2) part), exactly as the
+reference keeps ``getPivot`` serial while parallelizing only the elimination.
+
+``gauss_solve_rowelim`` chains n kernel steps under one ``fori_loop`` — the
+whole solve is still a single compiled program. The blocked path
+(core.blocked) remains the throughput engine; this one matches the
+reference's algorithmic shape step-for-step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gauss_tpu.kernels.matmul_pallas import _auto_interpret
+
+
+def _elim_kernel(i_ref, piv_ref, m_ref, prow_ref, pcol_ref, out_ref, *, bm, bn):
+    i = i_ref[0]
+    inv_piv = 1.0 / piv_ref[0, 0]
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+    rows = r * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)[:, 0]
+    cols = c * bn + lax.broadcasted_iota(jnp.int32, (1, bn), 1)[0, :]
+
+    # Scaled pivot row, diagonal pinned to exactly 1 (see core.gauss).
+    prow = jnp.where(cols == i, jnp.ones((), m_ref.dtype),
+                     prow_ref[0, :] * inv_piv.astype(m_ref.dtype))
+    # Multipliers: current column-i values of rows below the pivot.
+    f = jnp.where(rows > i, pcol_ref[:, 0], jnp.zeros((), m_ref.dtype))
+
+    new = m_ref[:] - f[:, None] * prow[None, :]
+    # Rows in this tile equal to the pivot row receive the scaled pivot row.
+    out_ref[:] = jnp.where((rows == i)[:, None], prow[None, :], new)
+
+
+@partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def eliminate_step_pallas(m: jax.Array, i: jax.Array, *, bm: int = 256,
+                          bn: int = 256, interpret: bool | None = None) -> jax.Array:
+    """One elimination step on the (already pivot-swapped) augmented matrix.
+
+    m: (nrows, ncols) with nrows % bm == 0 == ncols % bn (caller pads).
+    i: dynamic pivot index. Returns the updated matrix.
+    """
+    interpret = _auto_interpret(interpret)
+    nrows, ncols = m.shape
+    if nrows % bm or ncols % bn:
+        raise ValueError(f"matrix {m.shape} not a multiple of tiles ({bm}, {bn})")
+    i = jnp.asarray(i, jnp.int32).reshape(1)
+    # Pre-extract the pivot row / multiplier column as standalone arrays: TPU
+    # block shapes must be (8k, 128k) or equal to the array dims, so a
+    # dynamically-positioned (1, bn) block of the big matrix is not lowerable,
+    # but a (1, bn) block of a (1, ncols) array is. The two dynamic slices are
+    # O(n) against the kernel's O(n^2).
+    zero = jnp.zeros((), jnp.int32)
+    prow = lax.dynamic_slice(m, (i[0], zero), (1, ncols))
+    pcol = lax.dynamic_slice(m, (zero, i[0]), (nrows, 1))
+    piv = lax.dynamic_slice(prow, (zero, i[0]), (1, 1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nrows // bm, ncols // bn),
+        in_specs=[
+            # index_map signature: (*grid_ids, *scalar_prefetch_refs)
+            pl.BlockSpec((1, 1), lambda r, c, i_ref: (0, 0),
+                         memory_space=pltpu.SMEM),          # pivot value
+            pl.BlockSpec((bm, bn), lambda r, c, i_ref: (r, c)),  # tile
+            pl.BlockSpec((1, bn), lambda r, c, i_ref: (0, c)),   # pivot row
+            pl.BlockSpec((bm, 1), lambda r, c, i_ref: (r, 0)),   # pivot col
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda r, c, i_ref: (r, c)),
+    )
+    return pl.pallas_call(
+        partial(_elim_kernel, bm=bm, bn=bn),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(m.shape, m.dtype),
+        interpret=interpret,
+    )(i, piv, m, prow, pcol)
+
+
+@partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def gauss_solve_rowelim(a: jax.Array, b: jax.Array, *, bm: int = 256,
+                        bn: int = 256, interpret: bool | None = None) -> jax.Array:
+    """Full solve with the per-step elimination kernel (partial pivoting).
+
+    Pivot select + two-row swap in jnp per step; the O(n^2) elimination in the
+    Pallas kernel; back-substitution from the core oracle.
+    """
+    from gauss_tpu.core.gauss import back_substitute
+
+    a = jnp.asarray(a)
+    b = jnp.asarray(b, a.dtype)
+    n = a.shape[0]
+    npad = -(-n // bm) * bm
+    wpad = -(-(npad + 1) // bn) * bn  # width rounded up to hold the RHS column
+    m = jnp.zeros((npad, wpad), a.dtype)
+    m = m.at[:n, :n].set(a)
+    if npad != n:
+        m = m.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(
+            jnp.asarray(1.0, a.dtype))
+    m = m.at[:n, npad].set(b)
+    ridx = jnp.arange(npad)
+
+    def step(i, m):
+        col = m[:, i]
+        cand = jnp.where(ridx >= i, jnp.abs(col), -jnp.inf)
+        p = jnp.argmax(cand)
+        row_i, row_p = m[i], m[p]
+        m = m.at[i].set(row_p).at[p].set(row_i)
+        return eliminate_step_pallas(m, i, bm=bm, bn=bn, interpret=interpret)
+
+    m = lax.fori_loop(0, npad, step, m)
+    x = back_substitute(m[:npad, :npad], m[:, npad])
+    return x[:n]

@@ -1,0 +1,114 @@
+"""Pallas kernel tests (CPU interpreter mode via conftest's cpu backend)."""
+
+import numpy as np
+import pytest
+
+from gauss_tpu.kernels.matmul_pallas import matmul_pallas
+from gauss_tpu.kernels.rowelim_pallas import eliminate_step_pallas, gauss_solve_rowelim
+from gauss_tpu.core.gauss import eliminate
+from gauss_tpu.io import synthetic
+from gauss_tpu.verify import checks
+
+
+@pytest.mark.parametrize("shape", [(64, 64, 64), (128, 256, 192), (100, 70, 50)])
+def test_matmul_pallas_matches_numpy(rng, shape):
+    m, k, n = shape
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    c = np.asarray(matmul_pallas(a, b, bm=64, bn=128, bk=128))
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    np.testing.assert_allclose(c, ref, rtol=1e-4, atol=1e-4 * np.abs(ref).max())
+
+
+def test_matmul_pallas_cuda_inputs():
+    """The reference's CUDA input pattern at small n."""
+    n = 64
+    idx = np.arange(n * n, dtype=np.float64)
+    a = (idx + 1).reshape(n, n).astype(np.float32)
+    b = (1.0 / (idx + 1)).reshape(n, n).astype(np.float32)
+    c = np.asarray(matmul_pallas(a, b, bm=64, bn=128, bk=128))
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    assert checks.elementwise_match(c, ref, epsilon=checks.EPSILON * np.abs(ref).max())
+
+
+def test_matmul_pallas_bad_shapes():
+    with pytest.raises(ValueError):
+        matmul_pallas(np.ones((4, 5), np.float32), np.ones((4, 5), np.float32))
+
+
+def test_eliminate_step_matches_core(rng):
+    """One kernel step == one step of the core oracle's rank-1 update."""
+    n = 128
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    # Augment: kernel works on [A | b | pad].
+    m = np.zeros((n, n + 128), np.float32)
+    m[:, :n] = a
+    m[:, n] = b
+    out = np.asarray(eliminate_step_pallas(m, 0, bm=64, bn=128))
+    # Expected: scale row 0, eliminate below (diag dominant => no swap at i=0).
+    exp = m.astype(np.float64).copy()
+    exp[0] /= exp[0, 0]
+    for j in range(1, n):
+        exp[j] -= exp[j, 0] * exp[0]
+    np.testing.assert_allclose(out[:, : n + 1], exp[:, : n + 1], rtol=2e-5,
+                               atol=2e-4 * np.abs(exp).max())
+    assert out[0, 0] == 1.0
+    assert np.all(out[1:, 0] == 0.0)
+
+
+@pytest.mark.parametrize("n", [32, 100, 128])
+def test_gauss_solve_rowelim(rng, n):
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = np.asarray(gauss_solve_rowelim(a, b, bm=32, bn=128), np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_gauss_solve_rowelim_internal_pattern():
+    n = 96
+    a = synthetic.internal_matrix(n, dtype=np.float32)
+    b = synthetic.internal_rhs(n, dtype=np.float32)
+    x = np.asarray(gauss_solve_rowelim(a, b, bm=32, bn=128), np.float64)
+    assert checks.internal_pattern_ok(x, atol=1e-4)
+
+
+def test_rowelim_matches_unblocked_eliminate(rng):
+    """Full U from chained kernel steps == core eliminate's U (same policy)."""
+    n = 64
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x_kernel = np.asarray(gauss_solve_rowelim(a, b, bm=32, bn=128))
+    res = eliminate(a, b, pivoting="partial")
+    from gauss_tpu.core.gauss import back_substitute
+
+    x_core = np.asarray(back_substitute(res.u, res.y))
+    # f32 paths with different accumulation orders; equality is to f32 noise.
+    np.testing.assert_allclose(x_kernel, x_core, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("n", [64, 100, 128])
+def test_panel_pallas_blocked_lu(rng, n):
+    """Blocked LU with the Pallas panel kernel (interpret mode) == numpy."""
+    from gauss_tpu.core.blocked import gauss_solve_blocked
+
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = np.asarray(gauss_solve_blocked(a, b, panel=32, panel_impl="pallas"),
+                   np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_panel_pallas_matches_jax_panel(rng):
+    """Same factors from both panel implementations (same pivots, f32)."""
+    from gauss_tpu.core.blocked import lu_factor_blocked
+
+    n = 96
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    f_jax = lu_factor_blocked(a, panel=32, panel_impl="jax")
+    f_pl = lu_factor_blocked(a, panel=32, panel_impl="pallas")
+    np.testing.assert_array_equal(np.asarray(f_jax.perm), np.asarray(f_pl.perm))
+    np.testing.assert_allclose(np.asarray(f_jax.m), np.asarray(f_pl.m),
+                               rtol=1e-5, atol=1e-5)
