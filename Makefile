@@ -1,0 +1,25 @@
+# Top-level build/test entry points (reference C9 analog: the reference
+# builds each program with documented gcc/nvcc one-liners; here one Makefile
+# drives the native library, tests, benchmarks, and dataset regeneration).
+
+PYTHON ?= python
+
+.PHONY: all native test bench datasets clean
+
+all: native
+
+native:
+	$(MAKE) -C gauss_tpu/native/src
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+datasets:
+	$(PYTHON) -m gauss_tpu.cli.datasets
+
+clean:
+	$(MAKE) -C gauss_tpu/native/src clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -6,9 +6,13 @@ Backend taxonomy (maps the reference's 12-binary grid onto one flag):
                   (the headline engine; reference CUDA/OpenMP analog)
     tpu-unblocked pure-JAX rank-1 fori_loop elimination (reference sequential
                   semantics on device; oracle path)
+    tpu-rowelim   per-pivot-step Pallas row-elimination kernel (the
+                  BASELINE.json north-star kernel; subtractElim analog)
     tpu-dist      row-cyclic shard_map over the device mesh (reference MPI
                   gauss_mpi analog); -t selects the shard count
-    seq|omp|threads  native C++ host engines (reference CPU baselines)
+    seq|omp|threads|forkjoin|tiled  native C++ host engines (reference CPU
+                  baselines: sequential, OpenMP C4, persistent-pool C3,
+                  fork-join-per-step C1, cache-tiled C2)
 
 Timing semantics follow the reference per flavor (SURVEY.md §1 table): the
 internal flavor times init + elimination (gauss_internal_input.c:278-290), the
@@ -26,8 +30,9 @@ import numpy as np
 
 from gauss_tpu.utils.timing import timed_fetch
 
-GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-dist", "seq", "omp", "threads")
-MATMUL_BACKENDS = ("tpu", "tpu-pallas", "seq", "omp")
+GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-dist",
+                  "seq", "omp", "threads", "forkjoin", "tiled")
+MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "seq", "omp")
 
 
 def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel):
@@ -83,6 +88,21 @@ def _solve_tpu_dist(a64, b64, nthreads):
     return np.asarray(x, np.float64), elapsed
 
 
+def _solve_tpu_rowelim(a64, b64):
+    import jax.numpy as jnp
+
+    from gauss_tpu.kernels.rowelim_pallas import gauss_solve_rowelim
+
+    n = len(b64)
+    np.asarray(gauss_solve_rowelim(jnp.eye(n, dtype=jnp.float32),
+                                   jnp.zeros(n, dtype=jnp.float32)))  # warmup
+    elapsed, x = timed_fetch(
+        lambda: gauss_solve_rowelim(jnp.asarray(a64, jnp.float32),
+                                    jnp.asarray(b64, jnp.float32)),
+        warmup=0, reps=1)
+    return np.asarray(x, np.float64), elapsed
+
+
 def _solve_native(a64, b64, backend, nthreads):
     from gauss_tpu import native
 
@@ -102,6 +122,8 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
         return _solve_tpu_unblocked(a64, b64, pivoting)
     if backend == "tpu-dist":
         return _solve_tpu_dist(a64, b64, nthreads)
-    if backend in ("seq", "omp", "threads"):
+    if backend == "tpu-rowelim":
+        return _solve_tpu_rowelim(a64, b64)
+    if backend in ("seq", "omp", "threads", "forkjoin", "tiled"):
         return _solve_native(a64, b64, backend, nthreads)
     raise ValueError(f"unknown backend {backend!r}; options: {GAUSS_BACKENDS}")

@@ -37,14 +37,13 @@ def _inputs(n: int):
     return a, b
 
 
-def _run_tpu(a, b, pallas: bool):
+def _run_tpu(a, b, engine: str):
     import jax.numpy as jnp
 
-    if pallas:
-        try:
-            from gauss_tpu.kernels.matmul_pallas import matmul_pallas as mm
-        except ImportError as e:
-            raise SystemExit(f"matmul: tpu-pallas engine unavailable: {e}")
+    if engine == "tpu-pallas":
+        from gauss_tpu.kernels.matmul_pallas import matmul_pallas as mm
+    elif engine == "tpu-pallas-v1":
+        from gauss_tpu.kernels.matmul_pallas import matmul_pallas_stripe as mm
     else:
         from gauss_tpu.core.matmul import matmul as mm
     from gauss_tpu.utils.timing import timed_fetch
@@ -71,7 +70,8 @@ def main(argv=None) -> int:
         description="Dense matmul benchmark (TPU-native port of cuda_matmul).")
     p.add_argument("nsize", nargs="?", type=int, default=DEFAULT_N)
     p.add_argument("--engines", default="tpu,seq,omp",
-                   help="comma-separated subset of: tpu, tpu-pallas, seq, omp")
+                   help="comma-separated subset of: tpu, tpu-pallas, "
+                        "tpu-pallas-v1, seq, omp")
     p.add_argument("-t", "--threads", type=int, default=0,
                    help="threads for the omp engine (default: all)")
     args = p.parse_args(argv)
@@ -90,12 +90,13 @@ def main(argv=None) -> int:
     truth = a @ b  # float64 host truth for the epsilon comparator
     scale = float(np.abs(truth).max())
     labels = {"tpu": "TPU", "tpu-pallas": "TPU-Pallas",
+              "tpu-pallas-v1": "TPU-Pallas-V1",
               "seq": "Sequential", "omp": "OpenMP"}
 
     failed = False
     for engine in engines:
-        if engine in ("tpu", "tpu-pallas"):
-            c, elapsed = _run_tpu(a, b, pallas=(engine == "tpu-pallas"))
+        if engine.startswith("tpu"):
+            c, elapsed = _run_tpu(a, b, engine)
         else:
             c, elapsed = _run_native(a, b, engine, args.threads)
         ok = checks.elementwise_match(c, truth, epsilon=checks.EPSILON * scale)

@@ -13,6 +13,6 @@ All kernels accept ``interpret=`` for CPU-interpreter execution (how the test
 suite runs them without a TPU); ``None`` auto-selects based on the backend.
 """
 
-from gauss_tpu.kernels.matmul_pallas import matmul_pallas  # noqa: F401
+from gauss_tpu.kernels.matmul_pallas import matmul_pallas, matmul_pallas_stripe  # noqa: F401
 from gauss_tpu.kernels.panel_pallas import panel_factor_pallas  # noqa: F401
 from gauss_tpu.kernels.rowelim_pallas import eliminate_step_pallas, gauss_solve_rowelim  # noqa: F401

@@ -43,8 +43,10 @@ def _auto_interpret(interpret):
     return interpret
 
 
-def _mm_kernel(a_ref, b_ref, o_ref, acc_ref, *, precision):
-    @pl.when(pl.program_id(2) == 0)
+def _mm_kernel(a_ref, b_ref, o_ref, acc_ref, *, precision, k_axis):
+    """Shared accumulate kernel; k_axis names the grid axis that walks K
+    (2 for the 3-D tiled variant, 1 for the 2-D row-stripe variant)."""
+    @pl.when(pl.program_id(k_axis) == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -54,7 +56,7 @@ def _mm_kernel(a_ref, b_ref, o_ref, acc_ref, *, precision):
                           preferred_element_type=acc_ref.dtype,
                           precision=precision)
 
-    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    @pl.when(pl.program_id(k_axis) == pl.num_programs(k_axis) - 1)
     def _store():
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
@@ -92,7 +94,7 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 256, bn: int = 256,
     prec = resolve_precision(precision)
     grid = (mp // bm_, np_ // bn_, kp // bk_)
     out = pl.pallas_call(
-        partial(_mm_kernel, precision=prec),
+        partial(_mm_kernel, precision=prec, k_axis=2),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm_, bk_), lambda i, j, kk: (i, kk)),
@@ -101,6 +103,49 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 256, bn: int = 256,
         out_specs=pl.BlockSpec((bm_, bn_), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((mp, np_), a.dtype),
         scratch_shapes=[pltpu.VMEM((bm_, bn_), acc_dtype)],
+        interpret=interpret,
+    )(ap, bp)
+    return out[:m, :n]
+
+
+@partial(jax.jit, static_argnames=("bm", "bk", "interpret", "precision"))
+def matmul_pallas_stripe(a: jax.Array, b: jax.Array, *, bm: int = 256,
+                         bk: int = 512, interpret: bool | None = None,
+                         precision: str = "highest") -> jax.Array:
+    """Row-stripe variant: each program owns a full (bm, N) output stripe.
+
+    The MXU re-expression of CUDA Version-1's one-block-per-output-row layout
+    (reference CUDA_and_OpenMP/Version-1/cuda_matmul.cu:89-103, launch :156):
+    the N dimension is never tiled, so B's (bk, N) slab and the stripe
+    accumulator must fit VMEM — fine to N ~ 4096 at the defaults, which is
+    also the regime where the reference ran V1. The 3-D-grid
+    :func:`matmul_pallas` (the V2 analog) is the general-purpose kernel.
+    """
+    interpret = _auto_interpret(interpret)
+    a = jnp.asarray(a)
+    b = jnp.asarray(b, a.dtype)
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        raise ValueError(f"bad matmul shapes {a.shape} x {b.shape}")
+    m, k = a.shape
+    _, n = b.shape
+    bm_, bk_ = min(bm, max(m, 8)), min(bk, max(k, 128))
+    ap = _pad2(a, bm_, bk_)
+    bp = _pad2(b, bk_, 128)
+    mp, kp = ap.shape
+    np_ = bp.shape[1]
+    acc_dtype = jnp.float32 if a.dtype != jnp.float64 else jnp.float64
+    prec = resolve_precision(precision)
+
+    out = pl.pallas_call(
+        partial(_mm_kernel, precision=prec, k_axis=1),
+        grid=(mp // bm_, kp // bk_),
+        in_specs=[
+            pl.BlockSpec((bm_, bk_), lambda i, kk: (i, kk)),
+            pl.BlockSpec((bk_, np_), lambda i, kk: (kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm_, np_), lambda i, kk: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), a.dtype),
+        scratch_shapes=[pltpu.VMEM((bm_, np_), acc_dtype)],
         interpret=interpret,
     )(ap, bp)
     return out[:m, :n]

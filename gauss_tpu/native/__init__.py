@@ -25,7 +25,7 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
-GAUSS_ENGINES = ("seq", "omp", "threads")
+GAUSS_ENGINES = ("seq", "omp", "threads", "forkjoin", "tiled")
 MATMUL_ENGINES = ("seq", "omp")
 
 
@@ -76,6 +76,10 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.gt_gauss_solve_omp.restype = ctypes.c_int
             lib.gt_gauss_solve_threads.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
             lib.gt_gauss_solve_threads.restype = ctypes.c_int
+            lib.gt_gauss_solve_forkjoin.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
+            lib.gt_gauss_solve_forkjoin.restype = ctypes.c_int
+            lib.gt_gauss_solve_tiled.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
+            lib.gt_gauss_solve_tiled.restype = ctypes.c_int
             lib.gt_matmul_seq.argtypes = [dp, dp, dp, ctypes.c_long]
             lib.gt_matmul_seq.restype = None
             lib.gt_matmul_omp.argtypes = [dp, dp, dp, ctypes.c_long, ctypes.c_int]
@@ -113,12 +117,17 @@ def gauss_solve(a: np.ndarray, b: np.ndarray, engine: str = "seq",
     x = np.empty(n, dtype=np.float64)
     dp = ctypes.POINTER(ctypes.c_double)
     pa, pb, px = (arr.ctypes.data_as(dp) for arr in (a, b, x))
+    nt = nthreads or (os.cpu_count() or 2)
     if engine == "seq":
         rc = lib.gt_gauss_solve_seq(pa, pb, px, n)
     elif engine == "omp":
         rc = lib.gt_gauss_solve_omp(pa, pb, px, n, nthreads)
+    elif engine == "forkjoin":
+        rc = lib.gt_gauss_solve_forkjoin(pa, pb, px, n, nt)
+    elif engine == "tiled":
+        rc = lib.gt_gauss_solve_tiled(pa, pb, px, n, nt)
     else:
-        rc = lib.gt_gauss_solve_threads(pa, pb, px, n, nthreads or (os.cpu_count() or 2))
+        rc = lib.gt_gauss_solve_threads(pa, pb, px, n, nt)
     if rc == -1:
         raise np.linalg.LinAlgError("matrix is singular")
     if rc != 0:

@@ -108,6 +108,77 @@ int gt_gauss_solve_omp(double* A, double* b, double* x, long n, int nthreads) {
 #endif
 }
 
+// Fork-join engine (reference C1, Pthreads Version-1): threads are created
+// and joined anew for EVERY pivot step — n*T thread spawns total. Kept for
+// engine-taxonomy parity and as a benchmarkable demonstration of why the
+// persistent-pool engine (gt_gauss_solve_threads) exists; the reference's own
+// Version-3 draws the same conclusion.
+int gt_gauss_solve_forkjoin(double* A, double* b, double* x, long n, int nthreads) {
+  if (!A || !b || !x || n <= 0) return -2;
+  if (nthreads < 1) nthreads = 1;
+  for (long i = 0; i < n; ++i) {
+    if (!pivot_and_scale(A, b, n, i)) return -1;
+    std::vector<std::thread> pool;
+    pool.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) {
+      pool.emplace_back([&, t]() {
+        for (long j = i + 1 + t; j < n; j += nthreads) eliminate_row(A, b, n, i, j);
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
+  back_substitute(A, b, x, n);
+  return 0;
+}
+
+// Cache-tiled engine (reference C2, Pthreads Version-2): the elimination
+// column range is processed in block_size chunks, all target rows visiting a
+// chunk before advancing, keeping the pivot-row slice cache-resident
+// (reference Version-2/gauss_internal_input.c:18,162-173 uses block_size=16;
+// 64 doubles = one 512-byte prefetch-friendly run works better on modern
+// cores). Persistent pool + barrier like the threads engine.
+int gt_gauss_solve_tiled(double* A, double* b, double* x, long n, int nthreads) {
+  if (!A || !b || !x || n <= 0) return -2;
+  if (nthreads < 1) nthreads = 1;
+  constexpr long kBlock = 64;
+
+  std::atomic<bool> singular{false};
+  std::barrier sync(nthreads);
+
+  auto worker = [&](int tid) {
+    for (long i = 0; i < n; ++i) {
+      if (tid == 0) {
+        if (!pivot_and_scale(A, b, n, i)) singular.store(true);
+      }
+      sync.arrive_and_wait();
+      if (singular.load()) return;
+      const double* piv = A + i * n;
+      // RHS update + multiplier capture first (the tiled passes destroy
+      // column i last, mirroring the reference's deferred zeroing).
+      for (long j = i + 1 + tid; j < n; j += nthreads) b[j] -= A[j * n + i] * b[i];
+      for (long k0 = i; k0 < n; k0 += kBlock) {
+        const long k1 = std::min(n, k0 + kBlock);
+        for (long j = i + 1 + tid; j < n; j += nthreads) {
+          double* tgt = A + j * n;
+          const double f = tgt[i];
+          if (f == 0.0) continue;
+          for (long k = std::max(k0, i + 1); k < k1; ++k) tgt[k] -= f * piv[k];
+        }
+      }
+      for (long j = i + 1 + tid; j < n; j += nthreads) A[j * n + i] = 0.0;
+      sync.arrive_and_wait();
+    }
+  };
+
+  std::vector<std::thread> pool;
+  pool.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker, t);
+  for (auto& th : pool) th.join();
+  if (singular.load()) return -1;
+  back_substitute(A, b, x, n);
+  return 0;
+}
+
 int gt_gauss_solve_threads(double* A, double* b, double* x, long n, int nthreads) {
   if (!A || !b || !x || n <= 0) return -2;
   if (nthreads < 1) nthreads = 1;

@@ -1,0 +1,93 @@
+"""Dataset registry and meshfile tests."""
+
+import numpy as np
+import pytest
+
+from gauss_tpu.dist import topology, make_mesh
+from gauss_tpu.io import datasets, datfile
+
+
+def test_registry_shapes():
+    assert datasets.REGISTRY["sherman3"] == (5005, 20033)
+    assert datasets.REGISTRY["jpwh_991"] == (991, 6027)
+
+
+@pytest.mark.parametrize("name", ["matrix_10", "jpwh_991"])
+def test_dataset_deterministic(name):
+    n1, r1, c1, v1 = datasets.dataset_coords(name)
+    n2, r2, c2, v2 = datasets.dataset_coords(name)
+    assert n1 == n2
+    np.testing.assert_array_equal(r1, r2)
+    np.testing.assert_array_equal(v1, v2)
+    assert len(v1) == datasets.REGISTRY[name][1]
+
+
+def test_dataset_solvable():
+    """Stand-ins are diagonally dominant, so the external-input flow works."""
+    from gauss_tpu.core.gauss import gauss_solve
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.verify import checks
+
+    a = datasets.dataset_dense("jpwh_991")[:200, :200]  # leading block, still dominant
+    x_true = synthetic.manufactured_solution(200)
+    b = synthetic.manufactured_rhs(a, x_true)
+    x = np.asarray(gauss_solve(a, b))
+    assert checks.max_rel_error(x, x_true) < 1e-8
+
+
+def test_dataset_roundtrip(tmp_path):
+    p = tmp_path / "jpwh_991.dat"
+    datasets.write_dataset("jpwh_991", p)
+    dense = datfile.read_dat_dense(p, engine="python")
+    np.testing.assert_array_equal(dense, datasets.dataset_dense("jpwh_991"))
+
+
+def test_dataset_unknown_name():
+    with pytest.raises(KeyError):
+        datasets.dataset_coords("bcsstk01")
+
+
+def test_datasets_cli(tmp_path, capsys):
+    from gauss_tpu.cli import datasets as cli
+
+    rc = cli.main(["matrix_10", "--out", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "matrix_10.dat").exists()
+    rc = cli.main(["--list"])
+    assert rc == 0
+    assert "memplus" in capsys.readouterr().out
+    assert cli.main(["nope"]) == 1
+
+
+def test_meshfile_parse_and_load(tmp_path):
+    p = tmp_path / "meshfile"
+    p.write_text("# six-node analog\naxis rows 4\naxis cols 2\n")
+    mesh = topology.load_meshfile(p)
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("rows", "cols")
+
+
+def test_meshfile_errors(tmp_path):
+    with pytest.raises(ValueError, match="expected 'axis"):
+        topology.parse_meshfile("rows 4")
+    with pytest.raises(ValueError, match="duplicate"):
+        topology.parse_meshfile("axis r 2\naxis r 2")
+    with pytest.raises(ValueError, match="no axes"):
+        topology.parse_meshfile("# nothing\n")
+    p = tmp_path / "meshfile"
+    p.write_text("axis rows 64\n")
+    with pytest.raises(ValueError, match="64 devices"):
+        topology.load_meshfile(p)
+
+
+def test_meshfile_drives_dist_solve(tmp_path, rng):
+    from gauss_tpu.dist import gauss_dist
+
+    p = tmp_path / "meshfile"
+    p.write_text("axis rows 4\n")
+    mesh = topology.load_meshfile(p)
+    n = 32
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    x = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh))
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-9, atol=1e-9)

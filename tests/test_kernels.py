@@ -112,3 +112,25 @@ def test_panel_pallas_matches_jax_panel(rng):
     np.testing.assert_array_equal(np.asarray(f_jax.perm), np.asarray(f_pl.perm))
     np.testing.assert_allclose(np.asarray(f_jax.m), np.asarray(f_pl.m),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(64, 64, 64), (100, 70, 130)])
+def test_matmul_pallas_stripe(rng, shape):
+    from gauss_tpu.kernels.matmul_pallas import matmul_pallas_stripe
+
+    m, k, n = shape
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    c = np.asarray(matmul_pallas_stripe(a, b, bm=64, bk=128))
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    np.testing.assert_allclose(c, ref, rtol=1e-4, atol=1e-4 * np.abs(ref).max())
+
+
+def test_stripe_matches_tiled_variant(rng):
+    from gauss_tpu.kernels.matmul_pallas import matmul_pallas, matmul_pallas_stripe
+
+    a = rng.standard_normal((96, 96)).astype(np.float32)
+    b = rng.standard_normal((96, 96)).astype(np.float32)
+    c1 = np.asarray(matmul_pallas(a, b, bm=32, bn=128, bk=128))
+    c2 = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128))
+    np.testing.assert_allclose(c1, c2, rtol=1e-5, atol=1e-5)

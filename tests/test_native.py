@@ -90,3 +90,24 @@ def test_matrix_gen_bad_args():
     assert rc.returncode != 0
     rc = subprocess.run([native.matrix_gen_path(), "-3"], capture_output=True)
     assert rc.returncode != 0
+
+
+@pytest.mark.parametrize("engine", ["forkjoin", "tiled"])
+def test_new_engines_match_numpy(rng, engine):
+    n = 70
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    x = native.gauss_solve(a, b, engine=engine, nthreads=3)
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-9, atol=1e-9)
+
+
+def test_all_gauss_engines_agree(rng):
+    """Every native engine produces the same solution bit-for-bit-close."""
+    n = 60
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    results = {e: native.gauss_solve(a, b, engine=e, nthreads=2)
+               for e in native.GAUSS_ENGINES}
+    ref = results["seq"]
+    for e, x in results.items():
+        np.testing.assert_allclose(x, ref, rtol=1e-12, atol=1e-12, err_msg=e)
