@@ -22,6 +22,7 @@ import numpy as np
 BASELINE_GAUSS_2048_S = 0.509428  # reference OpenMP best, node2x18a
 N = 2048
 K_SMALL, K_LARGE = 4, 16
+ROUNDS = 5  # interleaved timing rounds per K (see _measure_slope)
 
 
 def _chained_solver(a, b, k: int, panel: int):
@@ -46,6 +47,35 @@ def _chained_solver(a, b, k: int, panel: int):
     return run
 
 
+def _measure_slope(a, b, panel: int) -> float:
+    """Per-solve seconds via the two-chain slope, hardened against tunnel noise.
+
+    Tunnel latency is noisy in epochs (cold compile caches, background
+    transfers): a burst that lands on all of one K's reps but not the other's
+    skews the slope badly (observed 20x once). Defense: compile and warm BOTH
+    chains first, then INTERLEAVE the timed reps across several rounds so both
+    K values sample the same epochs, and take the best (minimum) time per K —
+    noise only ever adds time, so min is the right estimator.
+    """
+    from gauss_tpu.utils.timing import timed_fetch
+
+    fns = {k: _chained_solver(a, b, k, panel) for k in (K_SMALL, K_LARGE)}
+    for fn in fns.values():  # compile + settle before any timing
+        timed_fetch(fn, b, warmup=2, reps=0)
+    best = {k: float("inf") for k in fns}
+    for _ in range(ROUNDS):
+        for k, fn in fns.items():
+            t, _ = timed_fetch(fn, b, warmup=0, reps=1)
+            best[k] = min(best[k], t)
+    slope = (best[K_LARGE] - best[K_SMALL]) / (K_LARGE - K_SMALL)
+    if slope <= 0:
+        # Noise swamped the slope. Fall back to the whole-chain mean, which
+        # still includes the constant dispatch/fetch offset — a conservative
+        # overestimate, never a fabricated speedup.
+        return best[K_LARGE] / K_LARGE
+    return slope
+
+
 def main() -> None:
     import jax.numpy as jnp
 
@@ -59,16 +89,7 @@ def main() -> None:
     b = jnp.asarray(b64, jnp.float32)
     panel = 128
 
-    from gauss_tpu.utils.timing import timed_fetch
-
-    runs = {}
-    for k in (K_SMALL, K_LARGE):
-        fn = _chained_solver(a, b, k, panel)
-        runs[k], _ = timed_fetch(fn, b, warmup=1, reps=3)
-
-    per_solve = (runs[K_LARGE] - runs[K_SMALL]) / (K_LARGE - K_SMALL)
-    # Guard against timing noise making the slope non-positive.
-    per_solve = max(per_solve, 1e-9)
+    per_solve = _measure_slope(a, b, panel)
 
     # Correctness gate: the refined solve must meet the 1e-4 residual bar.
     x, _ = solve_refined(a64, b64, panel=panel, iters=2)
@@ -84,9 +105,20 @@ def main() -> None:
         "residual_ok": bool(residual < 1e-4),
         "pattern_ok": bool(pattern_ok),
         "baseline_s": BASELINE_GAUSS_2048_S,
-        "method": f"slope of K={K_SMALL} vs K={K_LARGE} on-device chains, best of 3",
+        "method": (f"slope of K={K_SMALL} vs K={K_LARGE} on-device chains, "
+                   f"interleaved best of {ROUNDS}"),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    import traceback
+
+    try:
+        main()
+    except Exception:
+        # Transient tunnel/device failures have been observed; one retry
+        # protects the driver's single once-per-round invocation.
+        traceback.print_exc(file=sys.stderr)
+        print("bench: transient failure, retrying once", file=sys.stderr)
+        main()
