@@ -60,8 +60,9 @@ def _measure_slope(a, b, panel: int) -> float:
     from gauss_tpu.utils.timing import timed_fetch
 
     fns = {k: _chained_solver(a, b, k, panel) for k in (K_SMALL, K_LARGE)}
-    for fn in fns.values():  # compile + settle before any timing
-        timed_fetch(fn, b, warmup=2, reps=0)
+    for fn in fns.values():  # compile + settle before any timing (untimed)
+        np.asarray(fn(b))
+        np.asarray(fn(b))
     best = {k: float("inf") for k in fns}
     for _ in range(ROUNDS):
         for k, fn in fns.items():

@@ -10,6 +10,9 @@ Backend taxonomy (maps the reference's 12-binary grid onto one flag):
                   BASELINE.json north-star kernel; subtractElim analog)
     tpu-dist      row-cyclic shard_map over the device mesh (reference MPI
                   gauss_mpi analog); -t selects the shard count
+    tpu-dist2d    2-D block-cyclic shard_map (ScaLAPACK layout; BASELINE
+                  config 5); -t selects the total device count, factored
+                  into the squarest R x C grid
     seq|omp|threads|forkjoin|tiled  native C++ host engines (reference CPU
                   baselines: sequential, OpenMP C4, persistent-pool C3,
                   fork-join-per-step C1, cache-tiled C2)
@@ -31,7 +34,7 @@ import numpy as np
 from gauss_tpu.utils.timing import timed_fetch
 
 GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-dist",
-                  "seq", "omp", "threads", "forkjoin", "tiled")
+                  "tpu-dist2d", "seq", "omp", "threads", "forkjoin", "tiled")
 MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "seq", "omp")
 
 
@@ -88,6 +91,27 @@ def _solve_tpu_dist(a64, b64, nthreads):
     return np.asarray(x, np.float64), elapsed
 
 
+def _solve_tpu_dist2d(a64, b64, nthreads):
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.dist import gauss_dist2d
+    from gauss_tpu.dist.mesh import make_mesh_2d_auto
+
+    ndev = len(jax.devices())
+    total = max(1, min(nthreads or ndev, ndev))
+    mesh = make_mesh_2d_auto(total)
+    n = len(b64)
+    # Warmup.
+    np.asarray(gauss_dist2d.gauss_solve_dist2d(
+        jnp.eye(n, dtype=jnp.float32), jnp.zeros(n, dtype=jnp.float32), mesh=mesh))
+    elapsed, x = timed_fetch(
+        lambda: gauss_dist2d.gauss_solve_dist2d(
+            jnp.asarray(a64, jnp.float32), jnp.asarray(b64, jnp.float32), mesh=mesh),
+        warmup=0, reps=1)
+    return np.asarray(x, np.float64), elapsed
+
+
 def _solve_tpu_rowelim(a64, b64):
     import jax.numpy as jnp
 
@@ -122,6 +146,8 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
         return _solve_tpu_unblocked(a64, b64, pivoting)
     if backend == "tpu-dist":
         return _solve_tpu_dist(a64, b64, nthreads)
+    if backend == "tpu-dist2d":
+        return _solve_tpu_dist2d(a64, b64, nthreads)
     if backend == "tpu-rowelim":
         return _solve_tpu_rowelim(a64, b64)
     if backend in ("seq", "omp", "threads", "forkjoin", "tiled"):

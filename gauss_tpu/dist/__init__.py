@@ -10,6 +10,7 @@ mesh, the pivot row rides a psum over ICI instead of MPI_Bcast + Isend/Irecv,
 and the SPMD program order replaces MPI_Barrier.
 """
 
-from gauss_tpu.dist.mesh import make_mesh  # noqa: F401
+from gauss_tpu.dist.mesh import make_mesh, make_mesh_2d  # noqa: F401
 from gauss_tpu.dist.gauss_dist import gauss_solve_dist, eliminate_dist  # noqa: F401
+from gauss_tpu.dist.gauss_dist2d import gauss_solve_dist2d  # noqa: F401
 from gauss_tpu.dist.matmul_dist import matmul_dist  # noqa: F401

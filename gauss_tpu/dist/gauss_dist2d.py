@@ -1,0 +1,186 @@
+"""2-D cyclic-sharded distributed Gaussian elimination (BASELINE config 5).
+
+The 1-D row-cyclic engine (:mod:`gauss_tpu.dist.gauss_dist`) re-expresses the
+reference's MPI master-worker row distribution (reference
+OpenMP_and_MPI/gauss_mpi/gauss_internal_input.c:124-255). At pod scale the 1-D
+layout stops scaling: every shard holds full n-wide rows, so the per-step
+pivot-row broadcast moves O(n) per chip regardless of the chip count. This
+module is the 2-D generalization — the ScaLAPACK block-cyclic layout rebuilt
+on the JAX sharding model for meshes like the v5p-64 of BASELINE.json's
+config 5 ("gauss with partial pivoting N=16384, 2D-sharded"):
+
+- **Layout**: global element (g, j) lives on mesh tile (g % R, j % C), i.e.
+  cyclic in both dimensions — late pivot steps still touch every tile (the
+  same load-balance argument as the reference's cyclic row striping,
+  Pthreads/Version-1/gauss_internal_input.c:155, applied to both axes).
+- **Pivot search** runs only in the mesh column that owns matrix column i:
+  local masked argmax, an ``all_gather`` of (value, row) candidates along the
+  ``rows`` axis, then a scalar ``psum`` along ``cols`` to tell everyone the
+  winner — SURVEY.md §7 hard part (d)'s latency-critical piece costs R+1
+  small collectives, never O(n) data.
+- **Row swap + pivot-row broadcast** fuse into one (2, mc+1) ``psum`` along
+  ``rows``: each shard contributes its column-slice of the two rows being
+  swapped, and the summed result *is* the broadcast pivot row — per-step
+  traffic is O(n/C) per chip, vs O(n) for 1-D and O(n^2) for the reference's
+  ship-all-rows MPI scheme.
+- **Multiplier column** is one (mr,) ``psum`` along ``cols``.
+- Elimination and back-substitution are then local FMAs; SPMD program order
+  replaces every MPI_Barrier.
+
+The whole solve compiles to a single XLA program per (n, mesh, dtype).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gauss_tpu.dist.gauss_dist import _cyclic_perm
+from gauss_tpu.dist.mesh import make_mesh_2d_auto
+
+
+@lru_cache(maxsize=32)
+def _build_solver_2d(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
+    rax, cax = mesh.axis_names
+    R, C = mesh.devices.shape
+    mr, mc = npad // R, npad // C
+    dtype = jnp.dtype(dtype_name)
+
+    def shard_fn(a_loc, b_loc):
+        """a_loc: (mr, mc) cyclic tile; b_loc: (mr,) row-sharded, col-replicated."""
+        dr = lax.axis_index(rax)
+        dc = lax.axis_index(cax)
+        g_rows = jnp.arange(mr) * R + dr  # global row of each local row
+        g_cols = jnp.arange(mc) * C + dc  # global col of each local col
+        zero = jnp.zeros((), dtype)
+        # b arrives replicated over cols; the loop body makes it vary there
+        # (it mixes in col-psum'd terms), so widen its varying set up front.
+        b_loc = lax.pcast(b_loc, (cax,), to="varying")
+
+        def elim_step(i, carry):
+            A, rhs = carry
+            l_i, m_i = i // R, i // C
+            own_ri = dr == i % R   # this mesh row holds global row i
+            own_ci = dc == i % C   # this mesh col holds global col i
+
+            # --- distributed partial pivot, owner mesh-column only ---
+            col = A[:, m_i]
+            cand = jnp.where(own_ci & (g_rows >= i), jnp.abs(col), -jnp.inf)
+            lbest = jnp.argmax(cand)
+            vals = lax.all_gather(cand[lbest], rax)        # (R,)
+            gidxs = lax.all_gather(g_rows[lbest], rax)     # (R,)
+            gpiv_local = gidxs[jnp.argmax(vals)]           # valid where own_ci
+            gpiv = lax.psum(jnp.where(own_ci, gpiv_local, 0), cax)
+            l_p = gpiv // R
+            own_rp = dr == gpiv % R
+
+            # --- swap rows i <-> gpiv and broadcast both, one psum over rows ---
+            contrib = jnp.zeros((2, mc + 1), dtype)
+            contrib = contrib.at[0, :mc].set(jnp.where(own_ri, A[l_i], zero))
+            contrib = contrib.at[0, mc].set(jnp.where(own_ri, rhs[l_i], zero))
+            contrib = contrib.at[1, :mc].set(jnp.where(own_rp, A[l_p], zero))
+            contrib = contrib.at[1, mc].set(jnp.where(own_rp, rhs[l_p], zero))
+            both = lax.psum(contrib, rax)
+            row_i, b_i = both[0, :mc], both[0, mc]
+            row_p, b_p = both[1, :mc], both[1, mc]
+
+            # Pivot value lives at local column m_i of the owner mesh column.
+            piv = lax.psum(jnp.where(own_ci, row_p[m_i], zero), cax)
+
+            # Scaled pivot row slice (diagonal pinned to exactly 1, as in
+            # core.gauss) — already resident everywhere after the swap psum.
+            prow = jnp.where(g_cols == i, jnp.asarray(1.0, dtype), row_p / piv)
+            y_i = b_p / piv
+
+            # Slot of gpiv receives old row i; slot of i the scaled pivot row.
+            # Write order makes gpiv == i come out right.
+            A = A.at[l_p].set(jnp.where(own_rp, row_i, A[l_p]))
+            rhs = rhs.at[l_p].set(jnp.where(own_rp, b_i, rhs[l_p]))
+            A = A.at[l_i].set(jnp.where(own_ri, prow, A[l_i]))
+            rhs = rhs.at[l_i].set(jnp.where(own_ri, y_i, rhs[l_i]))
+
+            # --- multiplier column: one (mr,) psum over the cols axis ---
+            f_local = jnp.where(own_ci, A[:, m_i], zero)
+            f = lax.psum(f_local, cax)
+            f = jnp.where(g_rows > i, f, zero)
+
+            # --- local rank-1 elimination ---
+            A = A - f[:, None] * prow[None, :]
+            rhs = rhs - f * y_i
+            return A, rhs
+
+        A, rhs = lax.fori_loop(0, npad, elim_step, (a_loc, b_loc))
+
+        # --- back-substitution: x kept column-sharded (mc,), row-replicated ---
+        def back_step(k, x_loc):
+            i = npad - 1 - k
+            l_i = i // R
+            own_ri = dr == i % R
+            # Unsolved entries of x are 0 and U has unit diagonal, so the
+            # full-slice dot picks up exactly the solved suffix.
+            part = jnp.where(own_ri, A[l_i] @ x_loc, zero)
+            acc = lax.psum(part, cax)                      # full row dot
+            xi = lax.psum(jnp.where(own_ri, rhs[l_i] - acc, zero), rax)
+            return jnp.where(g_cols == i, xi, x_loc)
+
+        # xi is row-invariant (it ends in a psum over rows), so x stays
+        # varying over cols only — matching the P(cols) out_spec.
+        x0 = lax.pcast(jnp.zeros((mc,), dtype), (cax,), to="varying")
+        x_loc = lax.fori_loop(0, npad, back_step, x0)
+        return x_loc
+
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(rax, cax), P(rax)),
+        out_specs=P(cax))
+    return jax.jit(mapped)
+
+
+def _prepare_2d(a, b, R: int, C: int):
+    """Identity-pad to a multiple of lcm(R, C), then apply the cyclic
+    permutation to rows and columns so contiguous 2-D sharding yields the
+    cyclic layout. Returns (a_c, b_c, npad, col_perm)."""
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    b = jnp.asarray(b, dtype=a.dtype)
+    blk = math.lcm(R, C)
+    npad = -(-n // blk) * blk
+    if npad != n:
+        ap = jnp.zeros((npad, npad), a.dtype).at[:n, :n].set(a)
+        ap = ap.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(
+            jnp.asarray(1.0, a.dtype))
+        bp = jnp.zeros((npad,), a.dtype).at[:n].set(b)
+    else:
+        ap, bp = a, b
+    rperm = _cyclic_perm(npad, R)
+    cperm = _cyclic_perm(npad, C)
+    return ap[rperm][:, cperm], bp[rperm], npad, cperm
+
+
+def gauss_solve_dist2d(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:
+    """Distributed dense solve over a 2-D mesh; returns x in natural order.
+
+    The solver's output is column-cyclic-ordered (it comes back sharded along
+    the mesh's cols axis); the inverse permutation is applied here on host.
+    """
+    if mesh is None:
+        mesh = make_mesh_2d_auto()
+    if mesh.devices.ndim != 2:
+        raise ValueError(f"gauss_solve_dist2d needs a 2-D mesh; got shape "
+                         f"{mesh.devices.shape} (use gauss_solve_dist for 1-D)")
+    R, C = mesh.devices.shape
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    a_c, b_c, npad, cperm = _prepare_2d(a, b, R, C)
+    solver = _build_solver_2d(mesh, npad, str(a_c.dtype))
+    x_cyc = solver(a_c, b_c)
+    # x_cyc[k] = x[cperm[k]]; undo on host.
+    inv = np.empty(npad, dtype=np.int64)
+    inv[cperm] = np.arange(npad)
+    return x_cyc[inv][:n]

@@ -36,3 +36,20 @@ def make_mesh_2d(rows: int, cols: int, axes=("rows", "cols"),
                          f"{len(devs)} devices are visible")
     grid = np.array(devs[: rows * cols]).reshape(rows, cols)
     return jax.sharding.Mesh(grid, axes)
+
+
+def squarest_factors(n: int) -> tuple[int, int]:
+    """Factor n into the squarest (rows, cols) grid with rows >= cols."""
+    import math
+
+    cols = max(d for d in range(1, int(math.isqrt(n)) + 1) if n % d == 0)
+    return n // cols, cols
+
+
+def make_mesh_2d_auto(n_devices: Optional[int] = None,
+                      devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """A 2-D mesh over n_devices (default: all visible), squarest grid."""
+    devs = list(devices if devices is not None else jax.devices())
+    total = n_devices if n_devices is not None else len(devs)
+    rows, cols = squarest_factors(total)
+    return make_mesh_2d(rows, cols, devices=devs)

@@ -108,3 +108,13 @@ def test_gauss_internal_tpu_dist(capsys):
     assert rc == 0, out
     assert "Application time:" in out
     assert "OK" in out
+
+
+def test_gauss_internal_tpu_dist2d(capsys):
+    """tpu-dist2d backend factors the device pool into a 2-D mesh."""
+    rc = gauss_internal.main(
+        ["-s", "48", "-t", "8", "--backend", "tpu-dist2d", "--verify"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "Application time:" in out
+    assert "OK" in out

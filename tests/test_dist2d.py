@@ -1,0 +1,79 @@
+"""2-D cyclic-sharded distributed gauss vs the single-device oracle.
+
+Runs on the 8 virtual CPU devices from conftest (SURVEY.md §4 implication:
+sharding must be unit-testable without a pod)."""
+
+import numpy as np
+import pytest
+
+from gauss_tpu.core.gauss import gauss_solve
+from gauss_tpu.dist.gauss_dist2d import gauss_solve_dist2d
+from gauss_tpu.dist.mesh import make_mesh_2d
+from gauss_tpu.io import synthetic
+from gauss_tpu.verify import checks
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (4, 2), (2, 4), (8, 1), (1, 8)])
+def test_dist2d_matches_oracle(rng, shape):
+    n = 24  # multiple of lcm for every mesh shape above
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    mesh = make_mesh_2d(*shape)
+    x = np.asarray(gauss_solve_dist2d(a, b, mesh=mesh))
+    np.testing.assert_allclose(x, np.asarray(gauss_solve(a, b)), rtol=1e-9)
+
+
+def test_dist2d_non_multiple_padding(rng):
+    # n = 23 is a multiple of neither mesh dimension -> identity padding path.
+    n = 23
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    mesh = make_mesh_2d(4, 2)
+    x = np.asarray(gauss_solve_dist2d(a, b, mesh=mesh))
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-8)
+
+
+def test_dist2d_internal_pattern():
+    n = 32
+    a = synthetic.internal_matrix(n)
+    b = synthetic.internal_rhs(n)
+    x = np.asarray(gauss_solve_dist2d(a, b, mesh=make_mesh_2d(2, 4)))
+    assert checks.internal_pattern_ok(x, atol=1e-8)
+
+
+def test_dist2d_needs_cross_shard_swaps():
+    # Zero diagonal everywhere: every step must pivot to a row owned by a
+    # different mesh row than the diagonal's owner.
+    n = 16
+    a = np.fliplr(np.diag(np.arange(1.0, n + 1)))
+    x_true = np.arange(1.0, n + 1)
+    b = a @ x_true
+    x = np.asarray(gauss_solve_dist2d(a, b, mesh=make_mesh_2d(2, 2)))
+    np.testing.assert_allclose(x, x_true, rtol=1e-10)
+
+
+def test_dist2d_f32(rng):
+    n = 32
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    a += n * np.eye(n, dtype=np.float32)  # well-conditioned for f32
+    b = rng.standard_normal(n).astype(np.float32)
+    x = np.asarray(gauss_solve_dist2d(a, b, mesh=make_mesh_2d(2, 2)))
+    assert x.dtype == np.float32
+    np.testing.assert_allclose(
+        a.astype(np.float64) @ x, b, rtol=0, atol=1e-4)
+
+
+def test_dist2d_rejects_1d_mesh():
+    from gauss_tpu.dist.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="2-D mesh"):
+        gauss_solve_dist2d(np.eye(4), np.ones(4), mesh=make_mesh(4))
+
+
+def test_dist2d_default_mesh(rng):
+    # Default mesh factors the 8 visible devices into 4x2.
+    n = 16
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal(n)
+    x = np.asarray(gauss_solve_dist2d(a, b))
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-8)
