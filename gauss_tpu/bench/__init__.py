@@ -1,0 +1,10 @@
+"""Benchmark grid harness (SURVEY.md §7.7).
+
+Reproduces the reference reports' timing grids — gauss internal-input size
+sweep, gauss external-input dataset sweep, matmul size sweep — across this
+framework's engines, and emits tables in the BASELINE.md format with
+reference-baseline comparison columns. ``python -m gauss_tpu.bench.grid -h``.
+"""
+
+from gauss_tpu.bench.baselines import reference_seconds  # noqa: F401
+from gauss_tpu.bench.grid import run_suite  # noqa: F401

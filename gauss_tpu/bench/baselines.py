@@ -1,0 +1,118 @@
+"""Reference baseline numbers as data (transcribed in BASELINE.md).
+
+Sources: Pthreads/report.pdf, OpenMP_and_MPI/Report.pdf,
+CUDA_and_OpenMP/Report.pdf of the reference (tables quoted by title in
+BASELINE.md, which carries the full provenance). Keys are (suite, key,
+engine-class); values are seconds. Used by the grid harness to print
+vs-reference columns next to measured numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+# Gauss internal input, sequential, node2x14a (the machine the reference
+# README derives its headline speedups from) — "Sequential Performances".
+GAUSS_SEQ: Dict[int, float] = {
+    128: 0.00411,
+    256: 0.030433,
+    512: 0.374293,
+    1024: 1.310601,
+    2048: 10.977564,
+}
+
+# Gauss internal input, n=2048, best cell per engine across thread counts
+# ("Gaussian elimination — parallel, internal input" table).
+GAUSS_2048_BEST: Dict[str, float] = {
+    "pthreads-v1": 2.36825,     # 32 t, node2x18a
+    "pthreads-v2": 2.970117,    # 16 t, node2x18a
+    "pthreads-v3": 1.377353,    # 16 t + affinity, node2x18a
+    "openmp": 0.509428,         # 70 t, node2x18a (the reference's best CPU)
+    "mpi": 10.32634,            # 16 ranks single node, node2x18a
+}
+
+# Gauss external input, best-across-threads per engine, node2x18a
+# ("Best Performances Cross Comparison").
+GAUSS_EXTERNAL_BEST: Dict[str, Dict[str, float]] = {
+    "jpwh_991": {"seq": 1.102551, "pthreads": 0.233257, "mpi": 1.221907,
+                 "openmp": 0.084672},
+    "orsreg_1": {"seq": 12.009902, "pthreads": 1.696003, "mpi": 9.948886,
+                 "openmp": 0.600996},
+    "sherman5": {"seq": 41.651507, "pthreads": 4.581856, "mpi": 31.15757,
+                 "openmp": 1.957547},
+    "saylr4": {"seq": 51.446487, "pthreads": 5.584708, "mpi": 38.58076,
+               "openmp": 2.956282},
+    "sherman3": {"seq": 143.196348, "pthreads": 14.846271, "mpi": 121.7746,
+                 "openmp": 11.584218},
+}
+
+# Matmul, gpu-node1 (GTX 1080 / i7-7700K), "Performance Comparisons" time table.
+MATMUL: Dict[str, Dict[int, float]] = {
+    "seq": {1001: 1.02894, 1024: 1.39945, 2001: 22.3342, 2048: 66.4837},
+    "openmp": {1001: 0.247864, 1024: 0.411193, 2001: 2.60929, 2048: 21.4269},
+    "cuda-v1": {1001: 0.08397, 1024: 0.081569, 2001: 0.258896, 2048: 0.22632},
+    "cuda-v2": {1001: 0.096222, 1024: 0.089706, 2001: 0.198037, 2048: 0.114906},
+}
+
+# Which reference engine class each of our backends competes with, per task.
+# Device engines compete with the reference's overall best for that task:
+# OpenMP for gauss (no CUDA gauss exists), CUDA V2 for matmul.
+BACKEND_CLASS: Dict[str, str] = {
+    "seq": "seq",
+    "omp": "openmp",
+    "threads": "pthreads-v3",
+    "forkjoin": "pthreads-v1",
+    "tiled": "pthreads-v2",
+    "tpu-dist": "mpi",
+    "tpu-dist2d": "mpi",
+    "tpu": "openmp",
+    "tpu-unblocked": "seq",
+    "tpu-rowelim": "openmp",
+}
+
+_MATMUL_CLASS: Dict[str, str] = {
+    "seq": "seq",
+    "omp": "openmp",
+    "tpu": "cuda-v2",
+    "tpu-pallas": "cuda-v2",
+    "tpu-pallas-v1": "cuda-v1",
+}
+
+# The external-input report collapses the three pthreads versions into one
+# "Pthreads" column; derive from BACKEND_CLASS so new backends stay in sync.
+_EXTERNAL_CLASS = {k: ("pthreads" if v.startswith("pthreads") else v)
+                   for k, v in BACKEND_CLASS.items()}
+
+
+def reference_seconds(suite: str, key, backend: str) -> Optional[float]:
+    """Reference wall-clock this (suite, size-or-matrix, backend) competes
+    with, or None when the reports have no comparable cell."""
+    if suite == "gauss-internal":
+        cls = BACKEND_CLASS.get(backend)
+        if key == 2048 and cls in GAUSS_2048_BEST:
+            return GAUSS_2048_BEST[cls]
+        if cls == "seq" or backend.startswith("tpu"):
+            # Size sweep exists only for the sequential engine; device
+            # engines fall back to it below 2048 (conservative comparator).
+            return GAUSS_SEQ.get(key)
+        return None
+    if suite == "gauss-external":
+        table = GAUSS_EXTERNAL_BEST.get(key)
+        cls = _EXTERNAL_CLASS.get(backend)
+        return table.get(cls) if table and cls else None
+    if suite == "matmul":
+        cls = _MATMUL_CLASS.get(backend)
+        table = MATMUL.get(cls) if cls else None
+        return table.get(key) if table else None
+    raise ValueError(f"unknown suite {suite!r}")
+
+
+def suite_keys(suite: str) -> Tuple:
+    """The reference reports' sweep axis for a suite."""
+    if suite == "gauss-internal":
+        return tuple(GAUSS_SEQ)
+    if suite == "gauss-external":
+        return tuple(GAUSS_EXTERNAL_BEST)
+    if suite == "matmul":
+        return (1001, 1024, 2001, 2048)
+    raise ValueError(f"unknown suite {suite!r}")

@@ -1,0 +1,245 @@
+"""The benchmark grid: the reference reports' timing tables, regenerated.
+
+The reference's evaluation is three grids (BASELINE.md): gauss internal-input
+over n in {128..2048} x engines, gauss external-input over the dataset library
+x engines, and matmul over n in {1001, 1024, 2001, 2048} x engines. This
+module sweeps the same axes over this framework's backends and prints
+BASELINE.md-format markdown tables with a vs-reference column, plus optional
+machine-readable JSON.
+
+Usage::
+
+    python -m gauss_tpu.bench.grid --suite gauss-internal \
+        --keys 512,1024,2048 --backends tpu,seq,omp --json out.json
+
+Timing semantics per suite match the corresponding reference program
+(see gauss_tpu/cli/_common.py docstring); every cell is verified (residual /
+manufactured-solution error / epsilon comparator) before it is reported —
+an unverified time is printed as FAILED, never as a number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import asdict, dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from gauss_tpu.bench import baselines
+from gauss_tpu.cli import _common
+from gauss_tpu.verify import checks
+
+SUITES = ("gauss-internal", "gauss-external", "matmul")
+RESIDUAL_BAR = 1e-4  # BASELINE.json acceptance bar
+
+
+@dataclass
+class Cell:
+    suite: str
+    key: str          # size or dataset name
+    backend: str
+    seconds: float
+    verified: bool
+    error: float      # residual (internal) / max rel error (external) / max abs diff (matmul)
+    reference_s: Optional[float]
+
+    @property
+    def speedup(self) -> Optional[float]:
+        if self.reference_s is None or self.seconds <= 0:
+            return None
+        return self.reference_s / self.seconds
+
+
+def _prep_gauss_internal(n: int):
+    import time
+
+    from gauss_tpu.io import synthetic
+
+    t0 = time.perf_counter()
+    a, b = synthetic.internal_matrix(n), synthetic.internal_rhs(n)
+    return a, b, time.perf_counter() - t0
+
+
+def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int) -> Cell:
+    # Reference "Application time" = init + elimination
+    # (gauss_internal_input.c:278-290); init is measured once in prep and
+    # charged to every backend's cell so the vs-reference column compares
+    # like spans.
+    a, b, init_s = ctx
+    x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads)
+    res = checks.residual_norm(a, x, b)  # absolute, the BASELINE.json bar
+    return Cell("gauss-internal", str(n), backend, init_s + elapsed,
+                res < RESIDUAL_BAR, res,
+                baselines.reference_seconds("gauss-internal", n, backend))
+
+
+def _prep_gauss_external(name: str):
+    from gauss_tpu.io import datasets
+
+    a = datasets.dataset_dense(name)
+    x_true = np.arange(1, a.shape[0] + 1, dtype=np.float64)  # X__[i] = i+1
+    return a, a @ x_true, x_true                             # R = A . X__
+
+
+def _run_gauss_external(ctx, name: str, backend: str, nthreads: int) -> Cell:
+    a, b, x_true = ctx
+    x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads)
+    err = checks.max_rel_error(x, x_true)
+    return Cell("gauss-external", name, backend, elapsed,
+                err < RESIDUAL_BAR, err,
+                baselines.reference_seconds("gauss-external", name, backend))
+
+
+def _prep_matmul(n: int):
+    from gauss_tpu.cli.matmul import _inputs
+
+    a, b = _inputs(n)
+    truth = a @ b  # float64 host truth, computed once per size
+    return a, b, truth, float(np.abs(truth).max())
+
+
+def _run_matmul(ctx, n: int, backend: str, nthreads: int) -> Cell:
+    from gauss_tpu.cli.matmul import _run_native, _run_tpu
+
+    a, b, truth, scale = ctx
+    if backend.startswith("tpu"):
+        c, elapsed = _run_tpu(a, b, backend)
+    else:
+        c, elapsed = _run_native(a, b, backend, nthreads)
+    diff = float(np.max(np.abs(c - truth))) / scale
+    return Cell("matmul", str(n), backend, elapsed,
+                diff <= checks.EPSILON, diff,
+                baselines.reference_seconds("matmul", n, backend))
+
+
+_SUITE_FNS = {
+    "gauss-internal": (_prep_gauss_internal, _run_gauss_internal),
+    "gauss-external": (_prep_gauss_external, _run_gauss_external),
+    "matmul": (_prep_matmul, _run_matmul),
+}
+
+
+def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
+              nthreads: int = 0) -> List[Cell]:
+    """Run one grid; returns the verified/timed cells in sweep order.
+
+    Inputs (and the host truth) are prepared once per key and shared across
+    the backend sweep — at n=2048 the float64 truth product alone is worth
+    not recomputing per backend."""
+    if suite not in SUITES:
+        raise ValueError(f"unknown suite {suite!r}; options: {SUITES}")
+    prep, run = _SUITE_FNS[suite]
+    cells = []
+    for key in keys:
+        ctx = prep(key)
+        for backend in backends:
+            try:
+                cells.append(run(ctx, key, backend, nthreads))
+            except Exception as e:  # one broken backend must not lose the run
+                print(f"bench-grid: {suite}/{key}/{backend} failed: {e}",
+                      file=sys.stderr)
+                cells.append(Cell(suite, str(key), backend, 0.0, False,
+                                  float("nan"),
+                                  baselines.reference_seconds(
+                                      suite, key, backend)))
+    return cells
+
+
+def format_table(cells: List[Cell]) -> str:
+    """One BASELINE.md-style markdown table per suite, keys as rows."""
+    out = []
+    for suite in dict.fromkeys(c.suite for c in cells):
+        suite_cells = [c for c in cells if c.suite == suite]
+        backends = list(dict.fromkeys(c.backend for c in suite_cells))
+        keys = list(dict.fromkeys(c.key for c in suite_cells))
+        label = {"gauss-internal": "n", "gauss-external": "matrix",
+                 "matmul": "n"}[suite]
+        out.append(f"## {suite} (seconds; xR = speedup vs reference cell)\n")
+        out.append("| " + label + " | " + " | ".join(backends) + " |")
+        out.append("|" + "---|" * (len(backends) + 1))
+        index = {(c.key, c.backend): c for c in suite_cells}
+        for key in keys:
+            row = [key]
+            for backend in backends:
+                c = index.get((key, backend))
+                if c is None:
+                    row.append("—")
+                elif not c.verified:
+                    row.append(f"FAILED (err {c.error:.2e})")
+                else:
+                    s = f"{c.seconds:.6f}"
+                    if c.speedup is not None:
+                        s += f" ({c.speedup:.1f}xR)"
+                    row.append(s)
+            out.append("| " + " | ".join(row) + " |")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench-grid",
+        description="Reproduce the reference reports' benchmark grids.")
+    p.add_argument("--suite", choices=SUITES + ("all",), default="all")
+    p.add_argument("--keys", default="",
+                   help="comma-separated sizes / dataset names "
+                        "(default: the reference reports' sweep)")
+    p.add_argument("--backends", default="tpu,seq,omp",
+                   help=f"comma-separated; gauss: {_common.GAUSS_BACKENDS}; "
+                        f"matmul: {_common.MATMUL_BACKENDS}")
+    p.add_argument("-t", "--threads", type=int, default=0)
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="also write cells as a JSON array to this path")
+    args = p.parse_args(argv)
+
+    if args.keys and args.suite == "all":
+        p.error("--keys requires a single --suite (sizes and dataset names "
+                "do not apply across suites)")
+    suites = list(SUITES) if args.suite == "all" else [args.suite]
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    known = set(_common.GAUSS_BACKENDS) | set(_common.MATMUL_BACKENDS)
+    unknown = [b for b in backends if b not in known]
+    if unknown:
+        p.error(f"unknown backend(s) {unknown}; gauss: "
+                f"{_common.GAUSS_BACKENDS}; matmul: {_common.MATMUL_BACKENDS}")
+    all_cells: List[Cell] = []
+    for suite in suites:
+        if args.keys:
+            raw = [k.strip() for k in args.keys.split(",") if k.strip()]
+            if suite == "gauss-external":
+                keys = raw
+            else:
+                bad = [k for k in raw if not k.isdigit()]
+                if bad:
+                    p.error(f"--keys for {suite} must be integer sizes; "
+                            f"got {bad}")
+                keys = [int(k) for k in raw]
+        else:
+            keys = list(baselines.suite_keys(suite))
+        valid = (_common.MATMUL_BACKENDS if suite == "matmul"
+                 else _common.GAUSS_BACKENDS)
+        suite_backends = [b for b in backends if b in valid]
+        if not suite_backends:
+            print(f"bench-grid: no requested backend applies to {suite}; "
+                  f"valid: {valid}", file=sys.stderr)
+            continue
+        all_cells += run_suite(suite, keys, suite_backends, args.threads)
+
+    if not all_cells:
+        print("bench-grid: nothing ran (no valid suite/backend combination)",
+              file=sys.stderr)
+        return 1
+    print(format_table(all_cells))
+    if args.json_path:
+        payload = [dict(asdict(c), speedup=c.speedup) for c in all_cells]
+        with open(args.json_path, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {len(payload)} cells to {args.json_path}", file=sys.stderr)
+    return 0 if all(c.verified for c in all_cells) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="iterative-refinement steps for the f32 tpu backend")
     p.add_argument("--panel", type=int, default=128,
                    help="panel width for the blocked tpu backend")
+    p.add_argument("--trace", metavar="DIR", default=None,
+                   help="capture a jax.profiler device trace into DIR "
+                        "(the gprof analog; view in TensorBoard/Perfetto)")
+    p.add_argument("--profile", action="store_true",
+                   help="print a gprof-style per-phase wall-clock table")
     return p
 
 
@@ -71,16 +76,31 @@ def main(argv=None) -> int:
     # Timed region = init + elimination, matching the internal flavor
     # (gauss_internal_input.c:278-284). Init is the synthetic fill; for device
     # backends the H2D transfer happens inside solve_with_backend's span.
-    t0 = time.perf_counter()
-    a = synthetic.internal_matrix(n)
-    b = synthetic.internal_rhs(n)
-    init_elapsed = time.perf_counter() - t0
+    from gauss_tpu.utils import profiling
 
-    x, solve_elapsed = _common.solve_with_backend(
-        a, b, args.backend, nthreads=t, pivoting=args.pivoting,
-        refine_iters=args.refine, panel=args.panel)
+    pt = profiling.PhaseTimer()
+    with pt.phase("initMatrix"):
+        a = synthetic.internal_matrix(n)
+        b = synthetic.internal_rhs(n)
+    init_elapsed = pt.seconds["initMatrix"]
+
+    t0 = time.perf_counter()
+    with profiling.trace(args.trace):
+        x, solve_elapsed = _common.solve_with_backend(
+            a, b, args.backend, nthreads=t, pivoting=args.pivoting,
+            refine_iters=args.refine, panel=args.panel)
+    # solve_with_backend's span excludes the JIT warmup; attribute the rest
+    # of the wrapper time to compilation so the profile matches the printed
+    # Application time instead of blaming compile time on the compute phase.
+    pt.seconds["computeGauss"] = solve_elapsed
+    pt.seconds["jit compile+warmup"] = max(
+        0.0, time.perf_counter() - t0 - solve_elapsed)
 
     print(f"Application time: {init_elapsed + solve_elapsed:f} Secs")
+    if args.profile:
+        print(pt.report())
+    if args.trace:
+        print(f"Device trace written to {args.trace}")
 
     if args.verify:
         ok = checks.internal_pattern_ok(x, atol=1e-4)

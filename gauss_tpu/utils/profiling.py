@@ -1,0 +1,79 @@
+"""Tracing / profiling subsystem (SURVEY.md §5).
+
+The reference instruments with ``gettimeofday`` spans around each phase
+(reference Pthreads/Version-1/gauss_internal_input.c:278-290) and analyses
+hotspots offline with gprof (Pthreads/report.pdf "Profiling of the
+Algorithm": computeGauss/subtractElim at 99.93-100%). The TPU-native
+equivalents here:
+
+- :class:`PhaseTimer` — named wall-clock spans with a gprof-style percentage
+  report, device-completion bounded when given JAX values;
+- :func:`trace` — a ``jax.profiler.trace`` context manager producing XLA/TPU
+  traces viewable in TensorBoard/Perfetto (the gprof analog for compiled
+  device code), no-op when given no directory so CLI flags can pass None
+  straight through.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+
+class PhaseTimer:
+    """Accumulates named wall-clock spans; renders a gprof-like table.
+
+    >>> pt = PhaseTimer()
+    >>> with pt.phase("init"): ...
+    >>> with pt.phase("computeGauss"): ...
+    >>> print(pt.report())
+    """
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block_on=None):
+        """Time a phase. ``block_on``: optional JAX value (or pytree) to
+        ``block_until_ready`` before closing the span, so asynchronous
+        dispatch does not leak one phase's device time into the next."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_on is not None:
+                import jax
+
+                jax.block_until_ready(block_on)
+            self.seconds[name] = (
+                self.seconds.get(name, 0.0) + time.perf_counter() - t0)
+
+    @property
+    def total(self) -> float:
+        return sum(self.seconds.values())
+
+    def report(self) -> str:
+        """gprof-flavoured flat profile: % time, seconds, phase."""
+        total = self.total or 1.0
+        lines = ["  %time   seconds  phase"]
+        for name, s in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {100.0 * s / total:5.1f}  {s:9.6f}  {name}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]):
+    """Capture a device trace into ``logdir`` (None -> no-op).
+
+    Wraps ``jax.profiler.trace``; the output is the compiled-code hotspot
+    view (XLA fusions, Pallas kernels, collectives) that gprof provided for
+    the reference's C hot loops.
+    """
+    if not logdir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(logdir)):
+        yield

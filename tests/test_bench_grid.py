@@ -1,0 +1,136 @@
+"""Benchmark grid harness tests (SURVEY.md §7.7).
+
+Runs tiny instances of each suite on the CPU test platform and checks cell
+structure, verification gating, baseline lookups, and table rendering. The
+full-size grid is exercised manually / by the driver on real hardware.
+"""
+
+import numpy as np
+import pytest
+
+from gauss_tpu.bench import baselines, grid
+
+
+def test_reference_seconds_lookups():
+    # Known cells from BASELINE.md tables.
+    assert baselines.reference_seconds("gauss-internal", 2048, "omp") == 0.509428
+    assert baselines.reference_seconds("gauss-internal", 2048, "tpu") == 0.509428
+    assert baselines.reference_seconds("gauss-internal", 512, "seq") == 0.374293
+    assert baselines.reference_seconds("gauss-internal", 512, "threads") is None
+    assert baselines.reference_seconds("gauss-external", "sherman3", "tpu") == 11.584218
+    assert baselines.reference_seconds("gauss-external", "jpwh_991", "forkjoin") == 0.233257
+    assert baselines.reference_seconds("matmul", 2048, "tpu-pallas") == 0.114906
+    # Device matmul engines compete with the reference's CUDA best, not the
+    # CPU OpenMP row (the gauss-side mapping must not leak into matmul).
+    assert baselines.reference_seconds("matmul", 1024, "tpu") == 0.089706
+    assert baselines.reference_seconds("matmul", 2048, "tpu-pallas-v1") == 0.22632
+    assert baselines.reference_seconds("matmul", 1024, "seq") == 1.39945
+    assert baselines.reference_seconds("matmul", 999, "tpu") is None
+    with pytest.raises(ValueError):
+        baselines.reference_seconds("nope", 1, "tpu")
+
+
+def test_suite_keys_match_reports():
+    assert baselines.suite_keys("gauss-internal") == (128, 256, 512, 1024, 2048)
+    assert baselines.suite_keys("matmul") == (1001, 1024, 2001, 2048)
+    assert "sherman3" in baselines.suite_keys("gauss-external")
+
+
+def test_gauss_internal_grid_cells():
+    cells = grid.run_suite("gauss-internal", [32, 64], ["tpu-unblocked"])
+    assert len(cells) == 2
+    for c in cells:
+        assert c.verified, f"residual {c.error}"
+        assert c.seconds > 0
+        assert c.speedup is None or c.speedup > 0
+
+
+def test_gauss_external_grid_cell():
+    cells = grid.run_suite("gauss-external", ["matrix_10"], ["tpu-unblocked"])
+    (c,) = cells
+    assert c.verified, f"max rel error {c.error}"
+    assert c.key == "matrix_10"
+    assert c.reference_s is None  # no report row for matrix_10
+
+
+def test_matmul_grid_cell():
+    cells = grid.run_suite("matmul", [64], ["tpu"])
+    (c,) = cells
+    assert c.verified
+    assert c.seconds > 0
+
+
+def test_format_table_marks_failures_and_baselines():
+    cells = [
+        grid.Cell("gauss-internal", "2048", "tpu", 0.0509428, True, 1e-9, 0.509428),
+        grid.Cell("gauss-internal", "2048", "seq", 1.0, False, 0.5, 10.977564),
+    ]
+    table = grid.format_table(cells)
+    assert "(10.0xR)" in table      # speedup column
+    assert "FAILED" in table        # unverified cell never shows as a time
+    assert "| n |" in table
+
+
+def test_grid_cli_main(tmp_path, capsys):
+    out = tmp_path / "cells.json"
+    rc = grid.main(["--suite", "gauss-internal", "--keys", "16,32",
+                    "--backends", "tpu-unblocked", "--json", str(out)])
+    assert rc == 0
+    import json
+
+    cells = json.loads(out.read_text())
+    assert len(cells) == 2 and all(c["verified"] for c in cells)
+    assert "gauss-internal" in capsys.readouterr().out
+
+
+def test_run_suite_survives_a_broken_backend(monkeypatch, capsys):
+    from gauss_tpu.cli import _common
+
+    real = _common.solve_with_backend
+
+    def flaky(a, b, backend, **kw):
+        if backend == "seq":
+            raise RuntimeError("native library unavailable")
+        return real(a, b, backend, **kw)
+
+    monkeypatch.setattr(_common, "solve_with_backend", flaky)
+    cells = grid.run_suite("gauss-internal", [16], ["tpu-unblocked", "seq"])
+    assert len(cells) == 2
+    ok, broken = cells
+    assert ok.verified and not broken.verified
+    assert "seq failed" in capsys.readouterr().err
+    assert "FAILED" in grid.format_table(cells)
+
+
+def test_grid_cli_rejects_unknown_backend(capsys):
+    with pytest.raises(SystemExit):
+        grid.main(["--suite", "matmul", "--backends", "tpu,thread"])
+    assert "unknown backend" in capsys.readouterr().err
+
+
+def test_grid_cli_rejects_non_integer_sizes(capsys):
+    with pytest.raises(SystemExit):
+        grid.main(["--suite", "matmul", "--keys", "2048,sherman5",
+                   "--backends", "tpu"])
+    assert "integer sizes" in capsys.readouterr().err
+
+
+def test_external_class_tracks_backend_class():
+    # Derivation guard: every backend with a reference class resolves for
+    # the external suite too (pthreads-v* collapse to the report's single
+    # Pthreads column).
+    for backend, cls in baselines.BACKEND_CLASS.items():
+        got = baselines._EXTERNAL_CLASS[backend]
+        assert got == ("pthreads" if cls.startswith("pthreads") else cls)
+
+
+def test_grid_cli_rejects_keys_with_all_suites(capsys):
+    with pytest.raises(SystemExit):
+        grid.main(["--keys", "512", "--backends", "tpu-unblocked"])
+    assert "--keys requires a single --suite" in capsys.readouterr().err
+
+
+def test_grid_cli_nothing_ran_is_failure(capsys):
+    rc = grid.main(["--suite", "matmul", "--backends", "tpu-dist"])
+    assert rc == 1
+    assert "nothing ran" in capsys.readouterr().err
