@@ -134,7 +134,14 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
     prep, run = _SUITE_FNS[suite]
     cells = []
     for key in keys:
-        ctx = prep(key)
+        try:
+            ctx = prep(key)
+        except Exception as e:  # bad key: fail its cells, keep the sweep
+            print(f"bench-grid: {suite}/{key} setup failed: {e}",
+                  file=sys.stderr)
+            cells += [Cell(suite, str(key), backend, 0.0, False, float("nan"),
+                           None) for backend in backends]
+            continue
         for backend in backends:
             try:
                 cells.append(run(ctx, key, backend, nthreads))
@@ -234,7 +241,10 @@ def main(argv=None) -> int:
         return 1
     print(format_table(all_cells))
     if args.json_path:
-        payload = [dict(asdict(c), speedup=c.speedup) for c in all_cells]
+        # NaN (failed-cell error) is not valid JSON; emit null instead.
+        payload = [dict(asdict(c), speedup=c.speedup,
+                        error=c.error if np.isfinite(c.error) else None)
+                   for c in all_cells]
         with open(args.json_path, "w") as f:
             json.dump(payload, f, indent=1)
         print(f"wrote {len(payload)} cells to {args.json_path}", file=sys.stderr)

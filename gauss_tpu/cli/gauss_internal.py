@@ -54,7 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="check the closed-form solution pattern and residual "
                         "(the reference's compile-time VERIFY, now a flag)")
     p.add_argument("--refine", type=int, default=2, metavar="K",
-                   help="iterative-refinement steps for the f32 tpu backend")
+                   help="max iterative-refinement steps for the f32 tpu "
+                        "backend (stops early at --refine-tol)")
+    p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
+                   help="stop refining once ||Ax-b|| <= TOL; 0 always runs "
+                        "exactly --refine steps (default 1e-5)")
     p.add_argument("--panel", type=int, default=128,
                    help="panel width for the blocked tpu backend")
     p.add_argument("--trace", metavar="DIR", default=None,
@@ -74,8 +78,9 @@ def main(argv=None) -> int:
           f"backend {args.backend}, threads/shards {t}")
 
     # Timed region = init + elimination, matching the internal flavor
-    # (gauss_internal_input.c:278-284). Init is the synthetic fill; for device
-    # backends the H2D transfer happens inside solve_with_backend's span.
+    # (gauss_internal_input.c:278-284). Init is the synthetic fill; device
+    # backends stage the system to the device before their span opens
+    # (see _common's module docstring for the timing semantics).
     from gauss_tpu.utils import profiling
 
     pt = profiling.PhaseTimer()
@@ -88,7 +93,8 @@ def main(argv=None) -> int:
     with profiling.trace(args.trace):
         x, solve_elapsed = _common.solve_with_backend(
             a, b, args.backend, nthreads=t, pivoting=args.pivoting,
-            refine_iters=args.refine, panel=args.panel)
+            refine_iters=args.refine, panel=args.panel,
+            refine_tol=args.refine_tol)
     # solve_with_backend's span excludes the JIT warmup; attribute the rest
     # of the wrapper time to compilation so the profile matches the printed
     # Application time instead of blaming compile time on the compute phase.

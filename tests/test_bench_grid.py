@@ -102,6 +102,33 @@ def test_run_suite_survives_a_broken_backend(monkeypatch, capsys):
     assert "FAILED" in grid.format_table(cells)
 
 
+def test_run_suite_survives_a_bad_key(capsys):
+    cells = grid.run_suite("gauss-external", ["shermn3", "matrix_10"],
+                           ["tpu-unblocked"])
+    assert len(cells) == 2
+    bad, good = cells
+    assert not bad.verified and np.isnan(bad.error)
+    assert good.verified
+    assert "setup failed" in capsys.readouterr().err
+
+
+def test_grid_cli_json_is_strict_when_cells_fail(tmp_path, monkeypatch):
+    from gauss_tpu.cli import _common
+
+    def broken(*a, **k):
+        raise RuntimeError("boom")
+
+    monkeypatch.setattr(_common, "solve_with_backend", broken)
+    out = tmp_path / "cells.json"
+    rc = grid.main(["--suite", "gauss-internal", "--keys", "16",
+                    "--backends", "tpu-unblocked", "--json", str(out)])
+    assert rc == 1
+    import json
+
+    (cell,) = json.loads(out.read_text())  # strict parse must succeed
+    assert cell["error"] is None and not cell["verified"]
+
+
 def test_grid_cli_rejects_unknown_backend(capsys):
     with pytest.raises(SystemExit):
         grid.main(["--suite", "matmul", "--backends", "tpu,thread"])

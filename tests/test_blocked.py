@@ -95,3 +95,21 @@ def test_blocked_f32_dtype(rng):
     b = rng.standard_normal(n).astype(np.float32)
     x = gauss_solve_blocked(a, b, panel=32)
     assert x.dtype == np.float32
+
+
+def test_refined_tol_early_exit_and_staged_devices(rng):
+    """tol stops refinement once the residual meets it; pre-staged device
+    operands (a_dev/b_dev) give the same solution as host operands."""
+    import jax.numpy as jnp
+
+    n = 96
+    a = synthetic.internal_matrix(n)
+    b = synthetic.internal_rhs(n)
+    # Generous tol: converges before exhausting a large iteration budget.
+    x_tol, _ = solve_refined(a, b, iters=50, tol=1e-5)
+    assert checks.residual_norm(a, x_tol, b) <= 1e-4
+    x_ref, _ = solve_refined(a, b, iters=2)
+    a_dev = jnp.asarray(a, jnp.float32)
+    b_dev = jnp.asarray(b, jnp.float32)
+    x_staged, _ = solve_refined(a, b, iters=2, a_dev=a_dev, b_dev=b_dev)
+    np.testing.assert_array_equal(x_staged, x_ref)
