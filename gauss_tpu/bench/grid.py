@@ -140,18 +140,28 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
             print(f"bench-grid: {suite}/{key} setup failed: {e}",
                   file=sys.stderr)
             cells += [Cell(suite, str(key), backend, 0.0, False, float("nan"),
-                           None) for backend in backends]
+                           baselines.reference_seconds(suite, key, backend))
+                      for backend in backends]
             continue
         for backend in backends:
+            # Progress to stderr per cell: sweeps run for minutes behind slow
+            # device dispatch, and a silent hang is indistinguishable from
+            # work without this.
+            print(f"bench-grid: running {suite}/{key}/{backend} ...",
+                  file=sys.stderr, flush=True)
             try:
-                cells.append(run(ctx, key, backend, nthreads))
+                cell = run(ctx, key, backend, nthreads)
             except Exception as e:  # one broken backend must not lose the run
                 print(f"bench-grid: {suite}/{key}/{backend} failed: {e}",
                       file=sys.stderr)
-                cells.append(Cell(suite, str(key), backend, 0.0, False,
-                                  float("nan"),
-                                  baselines.reference_seconds(
-                                      suite, key, backend)))
+                cell = Cell(suite, str(key), backend, 0.0, False,
+                            float("nan"),
+                            baselines.reference_seconds(suite, key, backend))
+            else:
+                print(f"bench-grid: {suite}/{key}/{backend} -> "
+                      f"{cell.seconds:.6f}s verified={cell.verified}",
+                      file=sys.stderr, flush=True)
+            cells.append(cell)
     return cells
 
 

@@ -1,0 +1,167 @@
+"""Result graphs from bench-grid JSON (reference C11 analog: graphs/*.jpg).
+
+The reference ships three result plots (SURVEY.md §2 C11: gauss_seq.jpg,
+pthreads-mpi-openmp.jpg, mm_seq-openmp-cuda.jpg). This module regenerates the
+same three views from measured grid cells:
+
+    gauss_scaling.png   gauss-internal wall-clock vs n, one line per engine
+    gauss_engines.png   n=2048 engine comparison, ours vs reference bests
+    matmul_scaling.png  matmul wall-clock vs n, one line per engine
+
+Usage: python -m gauss_tpu.bench.plots cells.json [more.json ...] --outdir graphs
+
+Colors are a fixed-order CVD-validated categorical palette (adjacent-pair
+CVD deltaE >= 8); reference-baseline context is drawn in neutral gray dashes,
+never a series hue. Time axes are log-scaled (the data spans decades), which
+is also why the engine comparison is a dot plot, not bars — bar length is
+meaningless on a log axis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+# Validated categorical palette, fixed slot order (dataviz reference palette;
+# worst adjacent CVD deltaE 9.1 on light surfaces).
+PALETTE = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100",
+           "#e87ba4", "#008300", "#4a3aa7", "#e34948"]
+GRAY = "#767571"
+TEXT = "#1a1a19"
+
+# Fixed engine -> (slot, linestyle). There are more engines than palette
+# slots, so identity is color + linestyle: device engines solid, native CPU
+# engines dashed (a group-level secondary encoding), and no two engines share
+# the same (slot, style) pair. Unknown engines fold to gray, never a
+# generated hue.
+ENGINE_STYLE = {"tpu": (0, "-"), "tpu-unblocked": (1, "-"),
+                "tpu-rowelim": (2, "-"), "tpu-dist": (3, "-"),
+                "tpu-dist2d": (4, "-"),
+                "tpu-pallas": (5, "-"), "tpu-pallas-v1": (6, "-"),
+                "seq": (7, "--"), "omp": (0, "--"), "threads": (1, "--"),
+                "forkjoin": (2, "--"), "tiled": (3, "--")}
+
+
+def _color(engine: str) -> str:
+    style = ENGINE_STYLE.get(engine)
+    return GRAY if style is None else PALETTE[style[0]]
+
+
+def _linestyle(engine: str) -> str:
+    return ENGINE_STYLE.get(engine, (0, "-"))[1]
+
+
+def _style_axes(ax):
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    ax.grid(True, which="major", axis="both", color="#e8e6dc", linewidth=0.8)
+    ax.set_axisbelow(True)
+    ax.tick_params(colors=TEXT, labelsize=9)
+
+
+def _load_cells(paths):
+    cells = []
+    for p in paths:
+        cells += json.loads(Path(p).read_text())
+    return [c for c in cells if c.get("verified")]
+
+
+def _scaling_plot(ax, cells, suite, title):
+    series = defaultdict(list)
+    for c in cells:
+        if c["suite"] == suite and c["key"].isdigit():
+            series[c["backend"]].append((int(c["key"]), c["seconds"]))
+    order = {b: i for i, b in enumerate(ENGINE_STYLE)}
+    for backend in sorted(series, key=lambda b: order.get(b, 99)):
+        pts = sorted(series[backend])
+        ax.plot([n for n, _ in pts], [s for _, s in pts], marker="o",
+                markersize=4, linewidth=2, label=backend,
+                color=_color(backend), linestyle=_linestyle(backend))
+    ax.set_xscale("log", base=2)
+    ax.set_yscale("log")
+    ax.set_xlabel("matrix size n", color=TEXT)
+    ax.set_ylabel("wall-clock (s)", color=TEXT)
+    ax.set_title(title, color=TEXT, fontsize=11)
+    if len(series) >= 2:
+        ax.legend(frameon=False, fontsize=9)
+    _style_axes(ax)
+    return bool(series)
+
+
+def _engines_plot(ax, cells):
+    from gauss_tpu.bench import baselines
+
+    ours = {c["backend"]: c["seconds"] for c in cells
+            if c["suite"] == "gauss-internal" and c["key"] == "2048"}
+    if not ours:
+        return False
+    ref = dict(baselines.GAUSS_2048_BEST,
+               **{"sequential": baselines.GAUSS_SEQ[2048]})
+    rows = ([(f"ref {k}", v, True) for k, v in sorted(ref.items(),
+                                                      key=lambda kv: -kv[1])] +
+            [(k, v, False) for k, v in sorted(ours.items(),
+                                              key=lambda kv: -kv[1])])
+    ys = range(len(rows))
+    for y, (label, secs, is_ref) in zip(ys, rows):
+        color = GRAY if is_ref else _color(label)
+        ax.plot([secs], [y], "o", markersize=9, color=color,
+                markeredgecolor="white", markeredgewidth=1.5)
+        ax.annotate(f" {secs:.3g}s", (secs, y), fontsize=8, color=TEXT,
+                    va="center", xytext=(6, 0), textcoords="offset points")
+    ax.set_yticks(list(ys), [r[0] for r in rows], fontsize=9)
+    ax.set_xscale("log")
+    ax.set_xlabel("wall-clock (s), n=2048 — log scale", color=TEXT)
+    ax.set_title("Gauss n=2048: this framework vs reference best cells",
+                 color=TEXT, fontsize=11)
+    _style_axes(ax)
+    ax.grid(axis="y", visible=False)
+    return True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench-plots",
+        description="Render the three reference-analog result graphs.")
+    p.add_argument("json_files", nargs="+")
+    p.add_argument("--outdir", default="graphs")
+    args = p.parse_args(argv)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    cells = _load_cells(args.json_files)
+    if not cells:
+        print("bench-plots: no verified cells in input", file=sys.stderr)
+        return 1
+    outdir = Path(args.outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+
+    written = []
+    jobs = [
+        ("gauss_scaling.png",
+         lambda ax: _scaling_plot(ax, cells, "gauss-internal",
+                                  "Gaussian elimination scaling (internal input)")),
+        ("gauss_engines.png", lambda ax: _engines_plot(ax, cells)),
+        ("matmul_scaling.png",
+         lambda ax: _scaling_plot(ax, cells, "matmul", "Matmul scaling")),
+    ]
+    for name, draw in jobs:
+        fig, ax = plt.subplots(figsize=(7, 4.5), dpi=120)
+        fig.patch.set_facecolor("white")
+        if draw(ax):
+            fig.tight_layout()
+            path = outdir / name
+            fig.savefig(path)
+            written.append(str(path))
+        plt.close(fig)
+    print("\n".join(written) or "bench-plots: no plots produced (wrong suites?)")
+    return 0 if written else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

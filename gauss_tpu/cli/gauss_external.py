@@ -34,7 +34,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="threads / shards (backend-dependent; default: auto)")
     p.add_argument("--backend", choices=_common.GAUSS_BACKENDS, default="tpu")
     p.add_argument("--refine", type=int, default=2, metavar="K")
+    p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
+                   help="stop refining once ||Ax-b|| <= TOL; 0 always runs "
+                        "exactly --refine steps")
     p.add_argument("--panel", type=int, default=128)
+    p.add_argument("--trace", metavar="DIR", default=None,
+                   help="capture a jax.profiler device trace into DIR")
     return p
 
 
@@ -53,9 +58,13 @@ def main(argv=None) -> int:
 
     # Timed region = elimination only (gauss_external_input.c:300-302); the
     # solve span includes back-substitution, which is O(n^2) noise against it.
-    x, elapsed = _common.solve_with_backend(
-        a, b, args.backend, nthreads=args.threads,
-        pivoting="partial", refine_iters=args.refine, panel=args.panel)
+    from gauss_tpu.utils import profiling
+
+    with profiling.trace(args.trace):
+        x, elapsed = _common.solve_with_backend(
+            a, b, args.backend, nthreads=args.threads,
+            pivoting="partial", refine_iters=args.refine, panel=args.panel,
+            refine_tol=args.refine_tol)
 
     print(f"Time: {elapsed:f} seconds")
     err = checks.max_rel_error(x, x_true)

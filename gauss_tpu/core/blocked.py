@@ -256,10 +256,13 @@ def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
     memory, gauss_internal_input.c:278-284); a/b remain the f64 host operands
     used for residuals.
 
-    ``tol``: stop refining once ``||Ax - b||_2 <= tol`` (the residual is
-    already in hand each iteration, so the check is free and each skipped
-    iteration saves a host->device->host correction round trip). 0.0 (the
-    default) runs exactly ``iters`` iterations.
+    ``tol``: stop refining once ``||Ax - b||_2 <= tol * min(1, ||b||_2)``
+    (the residual is already in hand each iteration, so the check is free and
+    each skipped iteration saves a host->device->host correction round trip).
+    The ``min(1, ||b||)`` scaling is never looser than the absolute ``tol``
+    (the internal flavor's acceptance bar is absolute) and tightens
+    proportionally for small-magnitude systems (the external flavor's bar is
+    relative). 0.0 (the default) runs exactly ``iters`` iterations.
     """
     a64 = np.asarray(a, dtype=np.float64)
     b64 = np.asarray(b, dtype=np.float64)
@@ -269,9 +272,10 @@ def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
         b_dev = jnp.asarray(b64, dtype=dtype)
     fac = lu_factor_blocked(a_dev, panel=panel, panel_impl=panel_impl)
     x = np.asarray(lu_solve(fac, b_dev), dtype=np.float64)
+    tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
     for _ in range(iters):
         r = b64 - a64 @ x
-        if tol > 0.0 and float(np.linalg.norm(r)) <= tol:
+        if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
             break
         d = np.asarray(lu_solve(fac, jnp.asarray(r, dtype=dtype)), dtype=np.float64)
         x = x + d

@@ -1,0 +1,51 @@
+"""Result-graph rendering tests (C11 analog; no device work needed)."""
+
+import json
+
+from gauss_tpu.bench import plots
+
+
+def _cells():
+    return [
+        {"suite": "gauss-internal", "key": "1024", "backend": "tpu",
+         "seconds": 0.03, "verified": True, "error": 0.0, "reference_s": 1.31},
+        {"suite": "gauss-internal", "key": "2048", "backend": "tpu",
+         "seconds": 0.045, "verified": True, "error": 0.0, "reference_s": 0.509},
+        {"suite": "gauss-internal", "key": "2048", "backend": "seq",
+         "seconds": 1.3, "verified": True, "error": 0.0, "reference_s": 10.98},
+        {"suite": "matmul", "key": "1024", "backend": "tpu",
+         "seconds": 0.08, "verified": True, "error": 0.0, "reference_s": 0.0897},
+        {"suite": "matmul", "key": "2048", "backend": "tpu",
+         "seconds": 0.09, "verified": True, "error": 0.0, "reference_s": 0.1149},
+        # Unverified cells must never be plotted.
+        {"suite": "matmul", "key": "4096", "backend": "tpu",
+         "seconds": 0.0, "verified": False, "error": None, "reference_s": None},
+    ]
+
+
+def test_plots_render_all_three(tmp_path):
+    src = tmp_path / "cells.json"
+    src.write_text(json.dumps(_cells()))
+    out = tmp_path / "graphs"
+    rc = plots.main([str(src), "--outdir", str(out)])
+    assert rc == 0
+    names = {p.name for p in out.iterdir()}
+    assert names == {"gauss_scaling.png", "gauss_engines.png",
+                     "matmul_scaling.png"}
+    assert all((out / n).stat().st_size > 5000 for n in names)
+
+
+def test_plots_empty_input_fails(tmp_path, capsys):
+    src = tmp_path / "cells.json"
+    src.write_text("[]")
+    rc = plots.main([str(src), "--outdir", str(tmp_path / "g")])
+    assert rc == 1
+    assert "no verified cells" in capsys.readouterr().err
+
+
+def test_engine_identities_are_unique():
+    # Color+linestyle follows the entity; no two engines share a pair, and
+    # unknown engines fold to gray rather than colliding with a real one.
+    pairs = [(plots._color(e), plots._linestyle(e)) for e in plots.ENGINE_STYLE]
+    assert len(set(pairs)) == len(plots.ENGINE_STYLE)
+    assert plots._color("mystery-engine") == plots.GRAY
