@@ -38,7 +38,7 @@ def _chained_solver(a, b, k: int, panel: int):
             # Data-dependent perturbation defeats CSE while keeping the
             # system well-conditioned (the internal matrix is SPD-like).
             a_i = a + x[0] * jnp.asarray(1e-6, a.dtype)
-            fac = blocked.lu_factor_blocked(a_i, panel=panel)
+            fac = blocked.lu_factor_blocked_unrolled(a_i, panel=panel)
             return blocked.lu_solve(fac, b)
 
         x = lax.fori_loop(0, k, body, x0)

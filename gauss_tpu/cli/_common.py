@@ -165,8 +165,9 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
                        refine_tol: float = 1e-5):
     """Dispatch a solve; returns (x_float64, elapsed_seconds).
 
-    ``refine_tol``: the tpu backend stops refining once ||Ax-b|| <= this
-    (default a tenth of the 1e-4 acceptance bar — each skipped iteration is
+    ``refine_tol``: the tpu backend stops refining once
+    ``||Ax-b|| <= refine_tol * min(1, ||b||)`` (see blocked.solve_refined;
+    default a tenth of the 1e-4 acceptance bar — each skipped iteration is
     a correction round trip); 0 runs exactly ``refine_iters`` iterations.
     """
     if backend == "tpu":

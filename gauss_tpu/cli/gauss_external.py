@@ -35,8 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=_common.GAUSS_BACKENDS, default="tpu")
     p.add_argument("--refine", type=int, default=2, metavar="K")
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
-                   help="stop refining once ||Ax-b|| <= TOL; 0 always runs "
-                        "exactly --refine steps")
+                   help="stop refining once ||Ax-b|| <= TOL*min(1, ||b||); "
+                        "0 always runs exactly --refine steps")
     p.add_argument("--panel", type=int, default=128)
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="capture a jax.profiler device trace into DIR")

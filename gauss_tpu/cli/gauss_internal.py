@@ -57,8 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max iterative-refinement steps for the f32 tpu "
                         "backend (stops early at --refine-tol)")
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
-                   help="stop refining once ||Ax-b|| <= TOL; 0 always runs "
-                        "exactly --refine steps (default 1e-5)")
+                   help="stop refining once ||Ax-b|| <= TOL*min(1, ||b||); "
+                        "0 always runs exactly --refine steps (default 1e-5)")
     p.add_argument("--panel", type=int, default=128,
                    help="panel width for the blocked tpu backend")
     p.add_argument("--trace", metavar="DIR", default=None,

@@ -65,6 +65,49 @@ def _pad_to_panel(a: jax.Array, panel: int) -> jax.Array:
     return out.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(jnp.asarray(1.0, a.dtype))
 
 
+def _panel_factor_jax(p: jax.Array, kb):
+    """Unblocked partial-pivot elimination of one (h, panel) column block whose
+    diagonal lives at row offset ``kb`` within the block (stock-JAX analog of
+    kernels.panel_pallas; single source of the pivot/NaN-as-singular policy).
+
+    The rank-1 inner loop over the panel's columns — the analog of the
+    reference's subtractElim hot loop (gauss_internal_input.c:155-162) —
+    restricted to a VMEM-friendly panel width. Returns (factored_panel,
+    ipiv, min_abs_pivot); ipiv indices are rows of ``p``.
+    """
+    h, panel = p.shape
+    rows = jnp.arange(h)
+    pcols = jnp.arange(panel)
+    dtype = p.dtype
+
+    def step(j, carry):
+        p, ipiv, min_piv = carry
+        c = kb + j  # row of this panel column's diagonal
+        col = p[:, j]
+        cand = jnp.where(rows >= c, jnp.abs(col), -jnp.inf)
+        piv_row = jnp.argmax(cand)
+        ipiv = ipiv.at[j].set(piv_row.astype(ipiv.dtype))
+        # Swap rows c <-> piv_row of the panel.
+        rc, rp = p[c], p[piv_row]
+        p = p.at[c].set(rp).at[piv_row].set(rc)
+        piv = p[c, j]
+        # A NaN pivot means a zero pivot already poisoned the trailing
+        # rows; report it as singular (0), not NaN.
+        apiv = jnp.abs(piv)
+        min_piv = jnp.minimum(min_piv, jnp.where(jnp.isnan(apiv), 0.0, apiv))
+        # Multipliers below the diagonal, stored in place (getrf layout).
+        mult = jnp.where(rows > c, p[:, j] / piv, jnp.zeros((), dtype))
+        p = p.at[:, j].set(jnp.where(rows > c, mult, p[:, j]))
+        # Rank-1 update of the panel columns right of j.
+        urow = jnp.where(pcols > j, p[c], jnp.zeros((), dtype))
+        p = p - mult[:, None] * urow[None, :]
+        return p, ipiv, min_piv
+
+    ipiv0 = jnp.zeros((panel,), dtype=jnp.int32)
+    return lax.fori_loop(0, panel, step,
+                         (p, ipiv0, jnp.asarray(jnp.inf, dtype)))
+
+
 def _resolve_panel_impl(panel_impl):
     if panel_impl == "auto":
         # The Pallas VMEM-resident panel kernel uses TPU-only Mosaic features;
@@ -106,58 +149,23 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
     nb = npad // panel
     rows = jnp.arange(npad)
     cols = jnp.arange(npad)
-    pcols = jnp.arange(panel)
     dtype = m.dtype
-    one = jnp.asarray(1.0, dtype)
-
-    def panel_factor(kb, p):
-        """Unblocked partial-pivot elimination of one n x panel column block.
-
-        The rank-1 inner loop over the panel's columns — the analog of the
-        reference's subtractElim hot loop (gauss_internal_input.c:155-162) —
-        restricted to a VMEM-friendly panel width.
-        """
-
-        def step(j, carry):
-            p, ipiv, min_piv = carry
-            c = kb + j  # global row of this panel column's diagonal
-            col = p[:, j]
-            cand = jnp.where(rows >= c, jnp.abs(col), -jnp.inf)
-            piv_row = jnp.argmax(cand)
-            ipiv = ipiv.at[j].set(piv_row.astype(ipiv.dtype))
-            # Swap rows c <-> piv_row of the panel.
-            rc, rp = p[c], p[piv_row]
-            p = p.at[c].set(rp).at[piv_row].set(rc)
-            piv = p[c, j]
-            # A NaN pivot means a zero pivot already poisoned the trailing
-            # rows; report it as singular (0), not NaN.
-            apiv = jnp.abs(piv)
-            min_piv = jnp.minimum(min_piv, jnp.where(jnp.isnan(apiv), 0.0, apiv))
-            # Multipliers below the diagonal, stored in place (getrf layout).
-            mult = jnp.where(rows > c, p[:, j] / piv, jnp.zeros((), dtype))
-            p = p.at[:, j].set(jnp.where(rows > c, mult, p[:, j]))
-            # Rank-1 update of the panel columns right of j.
-            urow = jnp.where(pcols > j, p[c], jnp.zeros((), dtype))
-            p = p - mult[:, None] * urow[None, :]
-            return p, ipiv, min_piv
-
-        ipiv0 = jnp.zeros((panel,), dtype=jnp.int32)
-        return lax.fori_loop(0, panel, step, (p, ipiv0, jnp.asarray(jnp.inf, dtype)))
 
     def outer(k, carry):
         m, perm, min_piv = carry
         kb = k * panel
         p = lax.dynamic_slice(m, (0, kb), (npad, panel))
+        perm_local = None
         if panel_impl == "pallas":
             from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
 
-            p, ipiv = panel_factor_pallas(p, kb)
+            p, ipiv, perm_local = panel_factor_pallas(p, kb)
             # Pivot magnitudes live on the factored panel's diagonal block.
             dblk = lax.dynamic_slice(p, (kb, 0), (panel, panel))
             mp = jnp.min(jnp.abs(jnp.diagonal(dblk)))
             mp = jnp.where(jnp.isnan(mp), jnp.zeros((), dtype), mp)
         else:
-            p, ipiv, mp = panel_factor(kb, p)
+            p, ipiv, mp = _panel_factor_jax(p, kb)
         min_piv = jnp.minimum(min_piv, mp)
 
         # Apply the panel's pivot swaps to the rest of the matrix. Two
@@ -165,7 +173,10 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
         # "gather" folds them into one permutation and gathers the whole
         # matrix — O(n^2) traffic but one fused op, measured ~2.5x faster on
         # v5e than "loop", which exchanges two rows per step (O(panel * n)
-        # traffic but `panel` serialized tiny dispatches).
+        # traffic but `panel` serialized tiny dispatches). The Pallas panel
+        # kernel folds the permutation in-kernel (see panel_pallas docstring:
+        # the XLA-level fold loop was 6.3 ms of an 11 ms n=2048 factorization);
+        # the jax panel path folds here.
         if swap_impl == "loop":
             def swapj(j, state):
                 m, perm = state
@@ -178,11 +189,12 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
 
             m, perm = lax.fori_loop(0, panel, swapj, (m, perm))
         else:
-            def fold(j, pl):
-                x, y = pl[kb + j], pl[ipiv[j]]
-                return pl.at[kb + j].set(y).at[ipiv[j]].set(x)
+            if perm_local is None:
+                def fold(j, pl):
+                    x, y = pl[kb + j], pl[ipiv[j]]
+                    return pl.at[kb + j].set(y).at[ipiv[j]].set(x)
 
-            perm_local = lax.fori_loop(0, panel, fold, jnp.arange(npad))
+                perm_local = lax.fori_loop(0, panel, fold, jnp.arange(npad))
             m = m[perm_local]
             perm = perm[perm_local]
         m = lax.dynamic_update_slice(m, p, (0, kb))
@@ -214,6 +226,76 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv)
 
 
+@partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision"))
+def lu_factor_blocked_unrolled(a: jax.Array, panel: int = DEFAULT_PANEL,
+                               panel_impl: str = "auto",
+                               gemm_precision: str = "highest") -> BlockedLU:
+    """Blocked LU with the panel loop unrolled at trace time.
+
+    Identical math and factor layout to :func:`lu_factor_blocked`, but the
+    outer loop over column panels is a Python loop, so every slice bound is
+    static and the trailing submatrix genuinely shrinks: the GEMM does the
+    true triangular ~2/3*n^3 FLOPs instead of the masked full-size 2*n^3, the
+    panel kernel factors (n - kb, panel) instead of (n, panel), and no
+    row/column masks are needed anywhere. Costs one traced program per panel
+    (nb GEMM shapes to compile) — the right trade for the repeated-solve
+    benchmark sizes; the fori_loop version keeps compile time flat for
+    one-shot or very large n.
+    """
+    from gauss_tpu.kernels.matmul_pallas import resolve_precision
+
+    panel_impl = _resolve_panel_impl(panel_impl)
+    gemm_prec = resolve_precision(gemm_precision)
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    m = _pad_to_panel(a, panel)
+    npad = m.shape[0]
+    dtype = m.dtype
+    perm = jnp.arange(npad)
+    min_piv = jnp.asarray(jnp.inf, dtype)
+
+    for kb in range(0, npad, panel):
+        tail = npad - kb
+        # The live column block: rows kb.. only — earlier rows are finished U.
+        p = m[kb:, kb:kb + panel]
+        if panel_impl == "pallas":
+            from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+
+            p, ipiv, perm_local = panel_factor_pallas(p, 0)
+            mp = jnp.min(jnp.abs(jnp.diagonal(p[:panel])))
+            mp = jnp.where(jnp.isnan(mp), jnp.zeros((), dtype), mp)
+        else:
+            p, ipiv, mp = _panel_factor_jax(p, 0)
+
+            def fold(j, pl, ipiv=ipiv):
+                x, y = pl[j], pl[ipiv[j]]
+                return pl.at[j].set(y).at[ipiv[j]].set(x)
+
+            perm_local = lax.fori_loop(0, panel, fold, jnp.arange(tail))
+        min_piv = jnp.minimum(min_piv, mp)
+
+        # Permute the live rows (all columns: L multipliers left of the panel
+        # move with their rows), install the factored panel, then update.
+        live = m[kb:][perm_local]
+        perm = perm.at[kb:].set(perm[kb:][perm_local])
+        live = live.at[:, kb:kb + panel].set(p)
+        if kb + panel < npad:
+            l11 = live[:panel, kb:kb + panel]
+            u12 = lax.linalg.triangular_solve(
+                l11, live[:panel, kb + panel:],
+                left_side=True, lower=True, unit_diagonal=True)
+            live = live.at[:panel, kb + panel:].set(u12)
+            l21 = live[panel:, kb:kb + panel]
+            trail = live[panel:, kb + panel:]
+            live = live.at[panel:, kb + panel:].set(
+                trail - jnp.dot(l21, u12, precision=gemm_prec))
+        m = m.at[kb:].set(live)
+
+    return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv)
+
+
 @jax.jit
 def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
     """Solve A x = b given a BlockedLU of A: permute, L-solve, U-solve."""
@@ -229,18 +311,32 @@ def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
     return x[:n, 0]
 
 
-@partial(jax.jit, static_argnames=("panel", "panel_impl"))
+def _resolve_unroll(unroll) -> bool:
+    if unroll == "auto":
+        # Unrolling trades nb extra traced GEMM shapes for the true
+        # triangular FLOP count (measured 6.1 -> 3.9 ms at n=2048 on v5e);
+        # on the CPU test platform compile time matters more than FLOPs.
+        return jax.default_backend() == "tpu"
+    if isinstance(unroll, str):
+        raise ValueError(f"unknown unroll {unroll!r}; options: (True, False, 'auto')")
+    return bool(unroll)
+
+
+@partial(jax.jit, static_argnames=("panel", "panel_impl", "unroll"))
 def gauss_solve_blocked(a: jax.Array, b: jax.Array, panel: int = DEFAULT_PANEL,
-                        panel_impl: str = "auto") -> jax.Array:
+                        panel_impl: str = "auto",
+                        unroll: bool | str = "auto") -> jax.Array:
     """Factor + solve in one jitted program (the fast single-chip solver)."""
-    return lu_solve(lu_factor_blocked(a, panel=panel, panel_impl=panel_impl), b)
+    factor = (lu_factor_blocked_unrolled if _resolve_unroll(unroll)
+              else lu_factor_blocked)
+    return lu_solve(factor(a, panel=panel, panel_impl=panel_impl), b)
 
 
 def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
                   iters: int = 2, dtype=jnp.float32, panel_impl: str = "auto",
                   a_dev: jax.Array | None = None,
                   b_dev: jax.Array | None = None,
-                  tol: float = 0.0):
+                  tol: float = 0.0, unroll: bool | str = "auto"):
     """Mixed-precision solve: f32 blocked factorization + f64 residual refinement.
 
     TPUs are f32-native; the reference's gauss programs compute in f64. To meet
@@ -270,7 +366,9 @@ def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
         a_dev = jnp.asarray(a64, dtype=dtype)
     if b_dev is None:
         b_dev = jnp.asarray(b64, dtype=dtype)
-    fac = lu_factor_blocked(a_dev, panel=panel, panel_impl=panel_impl)
+    factor = (lu_factor_blocked_unrolled if _resolve_unroll(unroll)
+              else lu_factor_blocked)
+    fac = factor(a_dev, panel=panel, panel_impl=panel_impl)
     x = np.asarray(lu_solve(fac, b_dev), dtype=np.float64)
     tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
     for _ in range(iters):
