@@ -88,7 +88,9 @@ def main() -> None:
     b64 = synthetic.internal_rhs(N)
     a = jnp.asarray(a64, jnp.float32)
     b = jnp.asarray(b64, jnp.float32)
-    panel = 128
+    # panel=256 beats 128 since the transposed panel kernel (2 full-tile
+    # passes/step): fewer XLA glue steps now outweigh the extra VPU work.
+    panel = 256
 
     per_solve = _measure_slope(a, b, panel)
 

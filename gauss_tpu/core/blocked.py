@@ -133,6 +133,12 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
     (6-pass f32 emulation): measured on v5e, "high" (bf16x3) saves only ~4%
     wall-clock but costs ~50x residual accuracy on random matrices and stalls
     iterative refinement at ~1e-7 relative residual.
+    swap_impl: how the jax panel path applies pivot swaps to the rest of the
+    matrix — "gather" (one folded permutation, default) or "loop" (two-row
+    exchanges, kept for comparison). The Pallas panel kernel emits a folded
+    permutation directly (its ipiv is the pivot-choice sequence, not swap
+    partners), so with panel_impl "pallas" — the "auto" resolution on TPU —
+    swaps always go through the gather path and "loop" has no effect.
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
@@ -159,25 +165,22 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
         if panel_impl == "pallas":
             from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
 
-            p, ipiv, perm_local = panel_factor_pallas(p, kb)
-            # Pivot magnitudes live on the factored panel's diagonal block.
-            dblk = lax.dynamic_slice(p, (kb, 0), (panel, panel))
-            mp = jnp.min(jnp.abs(jnp.diagonal(dblk)))
-            mp = jnp.where(jnp.isnan(mp), jnp.zeros((), dtype), mp)
+            p, ipiv, perm_local, mp = panel_factor_pallas(p, kb)
         else:
             p, ipiv, mp = _panel_factor_jax(p, kb)
         min_piv = jnp.minimum(min_piv, mp)
 
-        # Apply the panel's pivot swaps to the rest of the matrix. Two
-        # equivalent implementations (the panel itself already has them):
-        # "gather" folds them into one permutation and gathers the whole
+        # Apply the panel's pivot permutation to the rest of the matrix. Two
+        # equivalent implementations (the panel itself already has it):
+        # "gather" folds it into one permutation and gathers the whole
         # matrix — O(n^2) traffic but one fused op, measured ~2.5x faster on
         # v5e than "loop", which exchanges two rows per step (O(panel * n)
         # traffic but `panel` serialized tiny dispatches). The Pallas panel
-        # kernel folds the permutation in-kernel (see panel_pallas docstring:
-        # the XLA-level fold loop was 6.3 ms of an 11 ms n=2048 factorization);
-        # the jax panel path folds here.
-        if swap_impl == "loop":
+        # kernel builds the permutation in-kernel (see panel_pallas docstring:
+        # the XLA-level fold loop was 6.3 ms of an 11 ms n=2048 factorization)
+        # and its ipiv is a pivot-choice sequence, not swap partners, so the
+        # "loop" transposition replay only applies to the jax panel path.
+        if swap_impl == "loop" and perm_local is None:
             def swapj(j, state):
                 m, perm = state
                 r1, r2 = kb + j, ipiv[j]
@@ -263,9 +266,7 @@ def lu_factor_blocked_unrolled(a: jax.Array, panel: int = DEFAULT_PANEL,
         if panel_impl == "pallas":
             from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
 
-            p, ipiv, perm_local = panel_factor_pallas(p, 0)
-            mp = jnp.min(jnp.abs(jnp.diagonal(p[:panel])))
-            mp = jnp.where(jnp.isnan(mp), jnp.zeros((), dtype), mp)
+            p, ipiv, perm_local, mp = panel_factor_pallas(p, 0)
         else:
             p, ipiv, mp = _panel_factor_jax(p, 0)
 
