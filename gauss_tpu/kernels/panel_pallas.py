@@ -52,11 +52,10 @@ from gauss_tpu.kernels.matmul_pallas import _auto_interpret
 
 
 def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
-                  chosen_ref, done_ref, *, h, panel):
+                  chosen_ref, done_ref, *, h, panel, seg):
     kb = kb_ref[0]
     out_ref[:] = t_ref[:]
     lanes = lax.broadcasted_iota(jnp.int32, (1, h), 1)
-    subs = lax.broadcasted_iota(jnp.int32, (panel, 1), 0)
     inv_ref[:] = lax.broadcasted_iota(jnp.int32, (h, 1), 0)
     chosen_ref[:] = jnp.zeros((h, 1), jnp.int32)
     # Rows above the diagonal block are finished U rows: not pivotable.
@@ -66,46 +65,70 @@ def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
     zero = jnp.zeros((), dtype)
     neg_inf = jnp.asarray(-jnp.inf, dtype)
 
-    def step(j, _):
-        j = j.astype(jnp.int32)  # fori index is int64 under x64
-        c = kb + j
+    # The per-step tile passes only need the LIVE columns j..panel — columns
+    # left of j hold finished L multipliers and receive no further updates.
+    # pl.ds sizes must be static, so the step loop is segmented at trace time:
+    # within segment [s0, s1) every pass touches the static slice [s0, panel)
+    # of the sublane (column) axis, shrinking the touched tile from
+    # (panel, h) to an average of ~(panel/2 + seg/2, h) across the chain.
+    def make_step(s0: int):
+        w = panel - s0  # static live width for this segment
+        subs = s0 + lax.broadcasted_iota(jnp.int32, (w, 1), 0)
 
-        # Column j of the panel = sublane row j of the transposed block: O(1).
-        col = out_ref[pl.ds(j, 1), :]  # (1, h)
-        cand = jnp.where(done_ref[:] != 0, neg_inf, jnp.abs(col))
-        p_idx = jnp.argmax(cand).astype(jnp.int32)
-        ipiv_ref[j] = p_idx
-        inv_ref[pl.ds(p_idx, 1), :] = jnp.full((1, 1), c, jnp.int32)
-        chosen_ref[pl.ds(p_idx, 1), :] = jnp.ones((1, 1), jnp.int32)
+        def step(j, _):
+            j = j.astype(jnp.int32)  # fori index is int64 under x64
+            c = kb + j
 
-        lane_p = lanes == p_idx
-        piv = jnp.sum(jnp.where(lane_p, col, zero))
-        apiv = jnp.abs(piv)
-        # A NaN pivot means a zero pivot already poisoned the trailing
-        # rows; report it as singular (0), not NaN.
-        minpiv_ref[0] = jnp.minimum(
-            minpiv_ref[0], jnp.where(jnp.isnan(apiv), zero, apiv))
-        done = (done_ref[:] != 0) | lane_p
-        done_ref[:] = done.astype(jnp.int32)
+            # Column j of the panel = sublane row j of the transposed block.
+            col = out_ref[pl.ds(j, 1), :]  # (1, h)
+            cand = jnp.where(done_ref[:] != 0, neg_inf, jnp.abs(col))
+            p_idx = jnp.argmax(cand).astype(jnp.int32)
+            ipiv_ref[j] = p_idx
+            # inv/chosen are reconstructible from ipiv at the XLA level
+            # (rows never move), but reconstructing them outside costs more
+            # than these stores: measured on v5e at n=2048, scatter- or
+            # onehot+argsort-based wrappers were +0.4 ms per solve vs
+            # keeping the bookkeeping in-kernel.
+            inv_ref[pl.ds(p_idx, 1), :] = jnp.full((1, 1), c, jnp.int32)
+            chosen_ref[pl.ds(p_idx, 1), :] = jnp.ones((1, 1), jnp.int32)
 
-        mult = jnp.where(done, zero, col / piv)  # (1, h); 0 on pivot + done
-        T = out_ref[:]
-        # Pivot row = lane p_idx (full pass 1: lane-masked reduction).
-        u = jnp.sum(jnp.where(lane_p, T, zero), axis=1, keepdims=True)
-        upd = jnp.where(subs > j, u, zero)  # only original columns > j
-        # Column-j store: done lanes (U above the diagonal) and the pivot
-        # lane (the diagonal) keep their values; live lanes take multipliers.
-        row_j_new = jnp.where(done, col, col / piv)
-        # Full pass 2: rank-1 update fused with the column-j store.
-        out_ref[:] = jnp.where(subs == j, row_j_new, T - upd * mult)
-        return 0
+            lane_p = lanes == p_idx
+            piv = jnp.sum(jnp.where(lane_p, col, zero))
+            apiv = jnp.abs(piv)
+            # A NaN pivot means a zero pivot already poisoned the trailing
+            # rows; report it as singular (0), not NaN.
+            minpiv_ref[0] = jnp.minimum(
+                minpiv_ref[0], jnp.where(jnp.isnan(apiv), zero, apiv))
+            done = (done_ref[:] != 0) | lane_p
+            done_ref[:] = done.astype(jnp.int32)
 
-    lax.fori_loop(0, panel, step, 0)
+            mult = jnp.where(done, zero, col / piv)  # (1, h); 0 on pivot+done
+            T = out_ref[pl.ds(s0, w), :]
+            # Pivot row = lane p_idx (live pass 1: lane-masked reduction).
+            u = jnp.sum(jnp.where(lane_p, T, zero), axis=1, keepdims=True)
+            upd = jnp.where(subs > j, u, zero)  # only original columns > j
+            # Column-j store: done lanes (U above the diagonal) and the pivot
+            # lane (the diagonal) keep their values; live lanes take
+            # multipliers.
+            row_j_new = jnp.where(done, col, col / piv)
+            # Live pass 2: rank-1 update fused with the column-j store.
+            out_ref[pl.ds(s0, w), :] = jnp.where(
+                subs == j, row_j_new, T - upd * mult)
+            return 0
+
+        return step
+
+    for s0 in range(0, panel, seg):
+        lax.fori_loop(s0, min(s0 + seg, panel), make_step(s0), 0)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
+DEFAULT_SEG = 64  # sub-panel segment width; see _panel_kernel (64 best on v5e)
+
+
+@partial(jax.jit, static_argnames=("interpret", "seg"))
 def panel_factor_pallas(p: jax.Array, kb: jax.Array,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        seg: int | None = None):
     """Factor one (h, panel) column block whose diagonal lives at global row
     offset ``kb``. Returns (factored_panel, ipiv, perm_local, min_abs_pivot):
     the panel comes back already row-permuted (getrf layout), ipiv holds the
@@ -128,8 +151,12 @@ def panel_factor_pallas(p: jax.Array, kb: jax.Array,
         ],
         scratch_shapes=[pltpu.VMEM((1, h), jnp.int32)],
     )
+    seg = DEFAULT_SEG if seg is None else seg
+    if seg < 1:
+        raise ValueError(f"seg must be >= 1, got {seg}")
+    seg = min(seg, panel)
     out_t, ipiv, inv, minpiv, chosen = pl.pallas_call(
-        partial(_panel_kernel, h=h, panel=panel),
+        partial(_panel_kernel, h=h, panel=panel, seg=seg),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((panel, h), p.dtype),

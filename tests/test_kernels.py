@@ -134,3 +134,29 @@ def test_stripe_matches_tiled_variant(rng):
     c1 = np.asarray(matmul_pallas(a, b, bm=32, bn=128, bk=128))
     c2 = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128))
     np.testing.assert_allclose(c1, c2, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("seg", [8, 16, 32])
+def test_panel_pallas_segmented_matches_single_segment(rng, seg):
+    """The trace-time segmented step loop (seg < panel) is bit-identical to
+    the single-segment (seg == panel) kernel — including an unaligned seg."""
+    from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+
+    h, panel = 96, 48
+    p = rng.standard_normal((h, panel)).astype(np.float32)
+    out1, ipiv1, perm1, mp1 = panel_factor_pallas(p, 16, seg=panel)
+    out2, ipiv2, perm2, mp2 = panel_factor_pallas(p, 16, seg=seg)
+    np.testing.assert_array_equal(np.asarray(ipiv1), np.asarray(ipiv2))
+    np.testing.assert_array_equal(np.asarray(perm1), np.asarray(perm2))
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert float(mp1) == float(mp2)
+
+
+def test_panel_pallas_rejects_bad_seg():
+    from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+
+    p = np.eye(8, dtype=np.float32)
+    with pytest.raises(ValueError):
+        panel_factor_pallas(p, 0, seg=0)
+    with pytest.raises(ValueError):
+        panel_factor_pallas(p, 0, seg=-4)
