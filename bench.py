@@ -21,66 +21,20 @@ import numpy as np
 
 BASELINE_GAUSS_2048_S = 0.509428  # reference OpenMP best, node2x18a
 N = 2048
-K_SMALL, K_LARGE = 4, 16
-ROUNDS = 5  # interleaved timing rounds per K (see _measure_slope)
-
-
-def _chained_solver(a, b, k: int, panel: int):
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    from gauss_tpu.core import blocked
-
-    @jax.jit
-    def run(x0):
-        def body(_, x):
-            # Data-dependent perturbation defeats CSE while keeping the
-            # system well-conditioned (the internal matrix is SPD-like).
-            a_i = a + x[0] * jnp.asarray(1e-6, a.dtype)
-            fac = blocked.lu_factor_blocked_unrolled(a_i, panel=panel)
-            return blocked.lu_solve(fac, b)
-
-        x = lax.fori_loop(0, k, body, x0)
-        return jnp.sum(x)  # scalar fetch: completion signal without bandwidth
-
-    return run
 
 
 def _measure_slope(a, b, panel: int) -> float:
-    """Per-solve seconds via the two-chain slope, hardened against tunnel noise.
+    """Per-solve seconds via the two-chain slope (see gauss_tpu.bench.slope
+    for the method, its K/rounds parameters, and its noise hardening)."""
+    from gauss_tpu.bench import slope
 
-    Tunnel latency is noisy in epochs (cold compile caches, background
-    transfers): a burst that lands on all of one K's reps but not the other's
-    skews the slope badly (observed 20x once). Defense: compile and warm BOTH
-    chains first, then INTERLEAVE the timed reps across several rounds so both
-    K values sample the same epochs, and take the best (minimum) time per K —
-    noise only ever adds time, so min is the right estimator.
-    """
-    from gauss_tpu.utils.timing import timed_fetch
-
-    fns = {k: _chained_solver(a, b, k, panel) for k in (K_SMALL, K_LARGE)}
-    for fn in fns.values():  # compile + settle before any timing (untimed)
-        np.asarray(fn(b))
-        np.asarray(fn(b))
-    best = {k: float("inf") for k in fns}
-    for _ in range(ROUNDS):
-        for k, fn in fns.items():
-            t, _ = timed_fetch(fn, b, warmup=0, reps=1)
-            best[k] = min(best[k], t)
-    slope = (best[K_LARGE] - best[K_SMALL]) / (K_LARGE - K_SMALL)
-    if slope <= 0:
-        # Noise swamped the slope. Fall back to the whole-chain mean, which
-        # still includes the constant dispatch/fetch offset — a conservative
-        # overestimate, never a fabricated speedup.
-        return best[K_LARGE] / K_LARGE
-    return slope
+    make_chain, args = slope.gauss_chain(a, b, panel)
+    return slope.measure_slope(make_chain, args)
 
 
 def main() -> None:
     import jax.numpy as jnp
 
-    from gauss_tpu.core.blocked import solve_refined
     from gauss_tpu.io import synthetic
     from gauss_tpu.verify import checks
 
@@ -94,10 +48,16 @@ def main() -> None:
 
     per_solve = _measure_slope(a, b, panel)
 
-    # Correctness gate: the refined solve must meet the 1e-4 residual bar.
-    x, _ = solve_refined(a64, b64, panel=panel, iters=2)
+    # Correctness gate on EXACTLY the timed configuration (one f32 blocked
+    # factor+solve, no refinement — it solves the internal system exactly;
+    # solve_refined exists for systems that need the mixed-precision path).
+    from gauss_tpu.bench.slope import gauss_solve_once
+
+    x = np.asarray(gauss_solve_once(a, b, panel), np.float64)
     residual = checks.residual_norm(a64, x, b64)
     pattern_ok = checks.internal_pattern_ok(x, atol=1e-4)
+
+    from gauss_tpu.bench.slope import K_LARGE, K_SMALL, ROUNDS
 
     print(json.dumps({
         "metric": "gauss_n2048_wallclock",

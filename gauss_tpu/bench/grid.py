@@ -45,6 +45,7 @@ class Cell:
     verified: bool
     error: float      # residual (internal) / max rel error (external) / max abs diff (matmul)
     reference_s: Optional[float]
+    span: str = "reference"   # "reference" parity span or "device" slope span
 
     @property
     def speedup(self) -> Optional[float]:
@@ -63,7 +64,32 @@ def _prep_gauss_internal(n: int):
     return a, b, time.perf_counter() - t0
 
 
-def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int) -> Cell:
+def _gauss_device_cell(a64, b64, refine_steps: int):
+    """Slope-timed per-solve seconds for the blocked TPU engine (operands
+    device-resident, dispatch/fetch offset cancelled; see bench.slope),
+    plus the float64 solution of EXACTLY the timed configuration — the
+    cell's verification must check what the slope measured, not some other
+    (e.g. host-refined) solve."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.bench import slope
+    from gauss_tpu.core.blocked import DEFAULT_PANEL
+
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    panel = 256 if a.shape[0] >= 1024 else DEFAULT_PANEL
+    x = np.asarray(slope.gauss_solve_once(a, b, panel, refine_steps),
+                   np.float64)
+    make_chain, args = slope.gauss_chain(a, b, panel, refine_steps)
+    return slope.measure_slope(make_chain, args), x
+
+
+DEVICE_SPAN_GAUSS = ("tpu",)
+DEVICE_SPAN_MATMUL = ("tpu", "tpu-pallas", "tpu-pallas-v1")
+
+
+def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
+                        span: str = "reference") -> Cell:
     # Reference "Application time" = init + elimination
     # (gauss_internal_input.c:278-290); init is measured once in prep and
     # charged to every backend's cell so the vs-reference column compares
@@ -71,6 +97,16 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int) -> Cell:
     a, b, init_s = ctx
     x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads)
     res = checks.residual_norm(a, x, b)  # absolute, the BASELINE.json bar
+    if span == "device" and backend in DEVICE_SPAN_GAUSS:
+        # The internal system solves exactly in one f32 factor+solve
+        # (measured residual 0.0 at every reference size), so the timed
+        # chain runs no refinement — and is verified as-is.
+        seconds, x_dev = _gauss_device_cell(a, b, refine_steps=0)
+        res_dev = checks.residual_norm(a, x_dev, b)
+        return Cell("gauss-internal", str(n), backend, seconds,
+                    res_dev < RESIDUAL_BAR, res_dev,
+                    baselines.reference_seconds("gauss-internal", n, backend),
+                    span="device")
     return Cell("gauss-internal", str(n), backend, init_s + elapsed,
                 res < RESIDUAL_BAR, res,
                 baselines.reference_seconds("gauss-internal", n, backend))
@@ -84,10 +120,23 @@ def _prep_gauss_external(name: str):
     return a, a @ x_true, x_true                             # R = A . X__
 
 
-def _run_gauss_external(ctx, name: str, backend: str, nthreads: int) -> Cell:
+def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
+                        span: str = "reference") -> Cell:
     a, b, x_true = ctx
     x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads)
     err = checks.max_rel_error(x, x_true)
+    if span == "device" and backend in DEVICE_SPAN_GAUSS:
+        # External datasets need on-device f32 refinement to meet the 1e-4
+        # bar (2 steps covers the whole registry; each is one matvec +
+        # triangular solves, O(n^2) against the O(n^3) factor). The timed
+        # chain includes those steps, and the cell verifies that exact
+        # configuration.
+        seconds, x_dev = _gauss_device_cell(a, b, refine_steps=2)
+        err_dev = checks.max_rel_error(x_dev, x_true)
+        return Cell("gauss-external", name, backend, seconds,
+                    err_dev < RESIDUAL_BAR, err_dev,
+                    baselines.reference_seconds("gauss-external", name,
+                                                backend), span="device")
     return Cell("gauss-external", name, backend, elapsed,
                 err < RESIDUAL_BAR, err,
                 baselines.reference_seconds("gauss-external", name, backend))
@@ -101,7 +150,20 @@ def _prep_matmul(n: int):
     return a, b, truth, float(np.abs(truth).max())
 
 
-def _run_matmul(ctx, n: int, backend: str, nthreads: int) -> Cell:
+def _matmul_device_seconds(a64, b64, backend: str) -> float:
+    import jax.numpy as jnp
+
+    from gauss_tpu.bench import slope
+    from gauss_tpu.cli.matmul import _tpu_engine_fn
+
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    make_chain, args = slope.matmul_chain(a, b, _tpu_engine_fn(backend))
+    return slope.measure_slope(make_chain, args)
+
+
+def _run_matmul(ctx, n: int, backend: str, nthreads: int,
+                span: str = "reference") -> Cell:
     from gauss_tpu.cli.matmul import _run_native, _run_tpu
 
     a, b, truth, scale = ctx
@@ -110,6 +172,12 @@ def _run_matmul(ctx, n: int, backend: str, nthreads: int) -> Cell:
     else:
         c, elapsed = _run_native(a, b, backend, nthreads)
     diff = float(np.max(np.abs(c - truth))) / scale
+    if span == "device" and backend in DEVICE_SPAN_MATMUL:
+        return Cell("matmul", str(n), backend,
+                    _matmul_device_seconds(a, b, backend),
+                    diff <= checks.EPSILON, diff,
+                    baselines.reference_seconds("matmul", n, backend),
+                    span="device")
     return Cell("matmul", str(n), backend, elapsed,
                 diff <= checks.EPSILON, diff,
                 baselines.reference_seconds("matmul", n, backend))
@@ -123,7 +191,7 @@ _SUITE_FNS = {
 
 
 def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
-              nthreads: int = 0) -> List[Cell]:
+              nthreads: int = 0, span: str = "reference") -> List[Cell]:
     """Run one grid; returns the verified/timed cells in sweep order.
 
     Inputs (and the host truth) are prepared once per key and shared across
@@ -131,6 +199,9 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
     not recomputing per backend."""
     if suite not in SUITES:
         raise ValueError(f"unknown suite {suite!r}; options: {SUITES}")
+    if span not in ("reference", "device"):
+        raise ValueError(f"unknown span {span!r}; options: "
+                         "('reference', 'device')")
     prep, run = _SUITE_FNS[suite]
     cells = []
     for key in keys:
@@ -150,7 +221,7 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
             print(f"bench-grid: running {suite}/{key}/{backend} ...",
                   file=sys.stderr, flush=True)
             try:
-                cell = run(ctx, key, backend, nthreads)
+                cell = run(ctx, key, backend, nthreads, span=span)
             except Exception as e:  # one broken backend must not lose the run
                 print(f"bench-grid: {suite}/{key}/{backend} failed: {e}",
                       file=sys.stderr)
@@ -165,19 +236,26 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
     return cells
 
 
+def _span_label(c: Cell) -> str:
+    """Backend column label; device-span cells are explicitly marked so the
+    two timing spans are never silently mixed in one table."""
+    return (c.backend + " [device-span]" if c.span == "device"
+            else c.backend)
+
+
 def format_table(cells: List[Cell]) -> str:
     """One BASELINE.md-style markdown table per suite, keys as rows."""
     out = []
     for suite in dict.fromkeys(c.suite for c in cells):
         suite_cells = [c for c in cells if c.suite == suite]
-        backends = list(dict.fromkeys(c.backend for c in suite_cells))
+        backends = list(dict.fromkeys(_span_label(c) for c in suite_cells))
         keys = list(dict.fromkeys(c.key for c in suite_cells))
         label = {"gauss-internal": "n", "gauss-external": "matrix",
                  "matmul": "n"}[suite]
         out.append(f"## {suite} (seconds; xR = speedup vs reference cell)\n")
         out.append("| " + label + " | " + " | ".join(backends) + " |")
         out.append("|" + "---|" * (len(backends) + 1))
-        index = {(c.key, c.backend): c for c in suite_cells}
+        index = {(c.key, _span_label(c)): c for c in suite_cells}
         for key in keys:
             row = [key]
             for backend in backends:
@@ -208,6 +286,13 @@ def main(argv=None) -> int:
                    help=f"comma-separated; gauss: {_common.GAUSS_BACKENDS}; "
                         f"matmul: {_common.MATMUL_BACKENDS}")
     p.add_argument("-t", "--threads", type=int, default=0)
+    p.add_argument("--span", choices=("reference", "device"),
+                   default="reference",
+                   help="timing span for device engines: 'reference' keeps "
+                        "the reference programs' transfer-inclusive spans "
+                        "(tunnel dispatch dominates here); 'device' measures "
+                        "per-op seconds by the K-chain slope method with "
+                        "operands device-resident (bench.slope)")
     p.add_argument("--json", dest="json_path", default=None,
                    help="also write cells as a JSON array to this path")
     args = p.parse_args(argv)
@@ -243,7 +328,8 @@ def main(argv=None) -> int:
             print(f"bench-grid: no requested backend applies to {suite}; "
                   f"valid: {valid}", file=sys.stderr)
             continue
-        all_cells += run_suite(suite, keys, suite_backends, args.threads)
+        all_cells += run_suite(suite, keys, suite_backends, args.threads,
+                               span=args.span)
 
     if not all_cells:
         print("bench-grid: nothing ran (no valid suite/backend combination)",

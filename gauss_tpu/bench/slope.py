@@ -1,0 +1,122 @@
+"""Slope-based device timing: per-op seconds from K-iteration chains.
+
+The single real chip in this environment sits behind a tunnel with ~70 ms
+dispatch+fetch RTT, so a one-shot span measures the tunnel, not the chip
+(and ``block_until_ready`` alone can return early on the tunneled platform).
+The honest per-op number is the *slope* of K-iteration on-device chains:
+time chains of K1 and K2 data-dependent iterations (XLA cannot collapse
+them), fetch only a scalar, and take (t_K2 - t_K1) / (K2 - K1) — the
+constant dispatch/fetch offset cancels exactly. Used by bench.py (the
+headline metric) and by ``bench.grid --span device``.
+
+Noise hardening (measured, see bench.py history): tunnel latency is noisy in
+epochs, and a burst landing on all of one K's reps skews the slope badly
+(20x observed once). Both chains are compiled and warmed first, the timed
+reps are INTERLEAVED across rounds so both K values sample the same epochs,
+and the estimator is the per-K minimum — noise only ever adds time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import numpy as np
+
+K_SMALL, K_LARGE = 4, 16
+ROUNDS = 5
+
+# Data-dependent perturbation scale: defeats CSE across chained iterations
+# while keeping the system numerically unchanged for verification purposes.
+PERTURB = 1e-6
+
+
+def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
+                  k_small: int = K_SMALL, k_large: int = K_LARGE,
+                  rounds: int = ROUNDS) -> float:
+    """Per-iteration seconds via the two-chain slope.
+
+    ``make_chain(k)`` must return a jitted callable running k data-dependent
+    iterations on device and returning a SMALL result (scalar fetch — the
+    completion signal must not measure tunnel bandwidth). Falls back to the
+    whole-chain mean (a conservative overestimate that still contains the
+    dispatch offset) if noise swamps the slope.
+    """
+    from gauss_tpu.utils.timing import timed_fetch
+
+    fns = {k: make_chain(k) for k in (k_small, k_large)}
+    for fn in fns.values():  # compile + settle before any timing (untimed)
+        np.asarray(fn(*args))
+        np.asarray(fn(*args))
+    best = {k: float("inf") for k in fns}
+    for _ in range(rounds):
+        for k, fn in fns.items():
+            t, _ = timed_fetch(fn, *args, warmup=0, reps=1)
+            best[k] = min(best[k], t)
+    slope = (best[k_large] - best[k_small]) / (k_large - k_small)
+    if slope <= 0:
+        return best[k_large] / k_large
+    return slope
+
+
+def gauss_solve_once(a, b, panel: int, refine_steps: int = 0):
+    """One iteration of exactly the configuration :func:`gauss_chain` times:
+    blocked f32 factor + solve (+ optional on-device f32 refinement steps).
+    Exposed so callers can VERIFY the very computation the slope measures —
+    a timed cell whose verification ran on a different configuration would
+    be meaningless."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gauss_tpu.core import blocked
+
+    fac = blocked.lu_factor_blocked_unrolled(a, panel=panel)
+    x = blocked.lu_solve(fac, b)
+    for _ in range(refine_steps):
+        r = b - jnp.dot(a, x, precision=lax.Precision.HIGHEST)
+        x = x + blocked.lu_solve(fac, r)
+    return x
+
+
+def gauss_chain(a, b, panel: int, refine_steps: int = 0
+                ) -> Tuple[Callable[[int], Callable], tuple]:
+    """Chain factory for the blocked gauss solve: each iteration is a full
+    factor+solve (+ refine_steps on-device f32 refinement iterations — each
+    one matvec + triangular solves, O(n^2) against the O(n^3) factor) of a
+    freshly perturbed system. Returns (make_chain, args)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make_chain(k: int):
+        @jax.jit
+        def run(x0):
+            def body(_, x):
+                a_i = a + x[0] * jnp.asarray(PERTURB, a.dtype)
+                return gauss_solve_once(a_i, b, panel, refine_steps)
+
+            x = lax.fori_loop(0, k, body, x0)
+            return jnp.sum(x)  # scalar fetch: completion without bandwidth
+
+        return run
+
+    return make_chain, (b,)
+
+
+def matmul_chain(a, b, mm: Callable) -> Tuple[Callable[[int], Callable], tuple]:
+    """Chain factory for a device matmul engine ``mm(a, b) -> c``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make_chain(k: int):
+        @jax.jit
+        def run(c0):
+            def body(_, c):
+                return mm(a + c[0, 0] * jnp.asarray(PERTURB, a.dtype), b)
+
+            c = lax.fori_loop(0, k, body, c0)
+            return c[0, 0]
+
+        return run
+
+    return make_chain, (jnp.zeros((a.shape[0], b.shape[1]), a.dtype),)

@@ -37,15 +37,21 @@ def _inputs(n: int):
     return a, b
 
 
-def _run_tpu(a, b, engine: str):
-    import jax.numpy as jnp
-
+def _tpu_engine_fn(engine: str):
+    """The device matmul callable behind a tpu* engine name."""
     if engine == "tpu-pallas":
         from gauss_tpu.kernels.matmul_pallas import matmul_pallas as mm
     elif engine == "tpu-pallas-v1":
         from gauss_tpu.kernels.matmul_pallas import matmul_pallas_stripe as mm
     else:
         from gauss_tpu.core.matmul import matmul as mm
+    return mm
+
+
+def _run_tpu(a, b, engine: str):
+    import jax.numpy as jnp
+
+    mm = _tpu_engine_fn(engine)
     from gauss_tpu.utils.timing import timed_fetch
 
     np.asarray(mm(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)))  # compile

@@ -108,6 +108,39 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 256, bn: int = 256,
     return out[:m, :n]
 
 
+STRIPE_VMEM_BUDGET = 12 * 1024 * 1024  # leave slack under the ~16 MB limit
+
+
+def _stripe_vmem_bytes(bm: int, bk: int, n: int, itemsize: int) -> int:
+    """Stripe-kernel VMEM estimate: A tile + B slab + output stripe, each
+    double-buffered by the Mosaic pipeline, plus the accumulator scratch."""
+    return (2 * (bm * bk + bk * n) + 3 * bm * n) * itemsize
+
+
+def _stripe_blocks(m: int, k: int, n: int, bm: int, bk: int,
+                   itemsize: int) -> tuple:
+    """Shrink the requested (bm, bk) until the stripe working set fits VMEM.
+
+    The full-width stripe is the point of the V1 layout, so N never tiles;
+    bk halves first (it only gates pipeline granularity), then bm (it costs
+    output-stripe parallelism). Raises when even the minimum blocks cannot
+    hold the stripe — that is V2 (matmul_pallas) territory.
+    """
+    bm_, bk_ = min(bm, max(m, 8)), min(bk, max(k, 128))
+    npad = -(-n // 128) * 128
+    while (_stripe_vmem_bytes(bm_, bk_, npad, itemsize) > STRIPE_VMEM_BUDGET
+           and bk_ > 128):
+        bk_ = max(128, bk_ // 2)
+    while (_stripe_vmem_bytes(bm_, bk_, npad, itemsize) > STRIPE_VMEM_BUDGET
+           and bm_ > 8):
+        bm_ = max(8, bm_ // 2)
+    if _stripe_vmem_bytes(bm_, bk_, npad, itemsize) > STRIPE_VMEM_BUDGET:
+        raise ValueError(
+            f"stripe kernel cannot hold an n={n} output stripe in VMEM even "
+            f"at minimum blocks; use matmul_pallas (the tiled V2 analog)")
+    return bm_, bk_
+
+
 @partial(jax.jit, static_argnames=("bm", "bk", "interpret", "precision"))
 def matmul_pallas_stripe(a: jax.Array, b: jax.Array, *, bm: int = 256,
                          bk: int = 512, interpret: bool | None = None,
@@ -117,9 +150,11 @@ def matmul_pallas_stripe(a: jax.Array, b: jax.Array, *, bm: int = 256,
     The MXU re-expression of CUDA Version-1's one-block-per-output-row layout
     (reference CUDA_and_OpenMP/Version-1/cuda_matmul.cu:89-103, launch :156):
     the N dimension is never tiled, so B's (bk, N) slab and the stripe
-    accumulator must fit VMEM — fine to N ~ 4096 at the defaults, which is
-    also the regime where the reference ran V1. The 3-D-grid
-    :func:`matmul_pallas` (the V2 analog) is the general-purpose kernel.
+    accumulator must fit VMEM: bm/bk are treated as upper bounds and shrunk
+    until the working set (with Mosaic's double buffering) fits the ~16 MB
+    budget — workable to N ~ 4096, the regime where the reference ran V1.
+    The 3-D-grid :func:`matmul_pallas` (the V2 analog) is the
+    general-purpose kernel.
     """
     interpret = _auto_interpret(interpret)
     a = jnp.asarray(a)
@@ -128,7 +163,7 @@ def matmul_pallas_stripe(a: jax.Array, b: jax.Array, *, bm: int = 256,
         raise ValueError(f"bad matmul shapes {a.shape} x {b.shape}")
     m, k = a.shape
     _, n = b.shape
-    bm_, bk_ = min(bm, max(m, 8)), min(bk, max(k, 128))
+    bm_, bk_ = _stripe_blocks(m, k, n, bm, bk, jnp.dtype(a.dtype).itemsize)
     ap = _pad2(a, bm_, bk_)
     bp = _pad2(b, bk_, 128)
     mp, kp = ap.shape

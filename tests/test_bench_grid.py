@@ -161,3 +161,22 @@ def test_grid_cli_nothing_ran_is_failure(capsys):
     rc = grid.main(["--suite", "matmul", "--backends", "tpu-dist"])
     assert rc == 1
     assert "nothing ran" in capsys.readouterr().err
+
+
+def test_grid_device_span_gauss_and_matmul():
+    """--span device: slope-timed cells for device engines, tagged 'device';
+    ineligible backends keep the reference span."""
+    cells = grid.run_suite("gauss-internal", [32], ["tpu", "seq"],
+                           span="device")
+    by_backend = {c.backend: c for c in cells}
+    assert by_backend["tpu"].span == "device"
+    assert by_backend["tpu"].verified and by_backend["tpu"].seconds > 0
+    assert by_backend["seq"].span == "reference"
+
+    mm = grid.run_suite("matmul", [32], ["tpu"], span="device")
+    assert mm[0].span == "device" and mm[0].verified and mm[0].seconds > 0
+
+
+def test_grid_rejects_unknown_span():
+    with pytest.raises(ValueError, match="span"):
+        grid.run_suite("matmul", [16], ["tpu"], span="bogus")

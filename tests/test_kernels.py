@@ -160,3 +160,27 @@ def test_panel_pallas_rejects_bad_seg():
         panel_factor_pallas(p, 0, seg=0)
     with pytest.raises(ValueError):
         panel_factor_pallas(p, 0, seg=-4)
+
+
+def test_stripe_blocks_fit_vmem_budget():
+    """n=2048 at default blocks used to exceed the 16 MB VMEM budget
+    (compile-time OOM on v5e); the sizing must shrink blocks to fit."""
+    from gauss_tpu.kernels.matmul_pallas import (
+        STRIPE_VMEM_BUDGET, _stripe_blocks, _stripe_vmem_bytes)
+
+    for n in (256, 1001, 2048, 4096):
+        bm, bk = _stripe_blocks(n, n, n, 256, 512, 4)
+        assert _stripe_vmem_bytes(bm, bk, -(-n // 128) * 128, 4) <= STRIPE_VMEM_BUDGET
+    with pytest.raises(ValueError, match="matmul_pallas"):
+        _stripe_blocks(32768, 32768, 32768, 256, 512, 4)
+
+
+def test_stripe_shrunk_blocks_correct(rng):
+    """The shrunken-block path computes the same product (interpret mode)."""
+    from gauss_tpu.kernels.matmul_pallas import matmul_pallas_stripe
+
+    a = rng.standard_normal((96, 80)).astype(np.float32)
+    b = rng.standard_normal((80, 160)).astype(np.float32)
+    c = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128))
+    np.testing.assert_allclose(
+        c, a.astype(np.float64) @ b.astype(np.float64), rtol=1e-5, atol=1e-4)

@@ -1,0 +1,106 @@
+"""Report composer tests (C11 analog; pure text, no device work needed)."""
+
+import json
+
+import pytest
+
+from gauss_tpu.bench import report
+
+
+def _cells():
+    return [
+        {"suite": "gauss-internal", "key": "1024", "backend": "tpu",
+         "seconds": 0.012, "verified": True, "error": 0.0,
+         "reference_s": 1.31},
+        {"suite": "gauss-internal", "key": "1024", "backend": "seq",
+         "seconds": 0.30, "verified": True, "error": 0.0,
+         "reference_s": 1.20},
+        {"suite": "gauss-internal", "key": "2048", "backend": "tpu",
+         "seconds": 0.045, "verified": True, "error": 0.0,
+         "reference_s": 0.509428},
+        {"suite": "gauss-internal", "key": "2048", "backend": "seq",
+         "seconds": 2.40, "verified": True, "error": 0.0,
+         "reference_s": 9.644256},
+        # Unverified: must render as FAILED, never a number, and be
+        # excluded from speedups/bests.
+        {"suite": "gauss-internal", "key": "2048", "backend": "omp",
+         "seconds": 0.001, "verified": False, "error": 99.0,
+         "reference_s": 0.509428},
+        {"suite": "matmul", "key": "2048", "backend": "tpu-pallas",
+         "seconds": 0.0011, "verified": True, "error": 1e-6,
+         "reference_s": 0.114906},
+    ]
+
+
+def test_report_sections_and_tables():
+    text = report.compose_report(_cells(), "t", "hw")
+    assert "# t" in text and "**Hardware:** hw" in text
+    assert "Gaussian elimination — internal" in text
+    assert "Dense matrix multiplication" in text
+    # timing table contains the verified numbers
+    assert "0.045000" in text and "2.400000" in text
+    # speedup vs seq: 2.40/0.045 - 1 = 52.3 -> "+5233%"
+    assert "+5233%" in text
+    # reference comparison: best engine + margin
+    assert "0.509428" in text and "11.3x" in text
+
+
+def test_report_failed_cells_never_get_numbers():
+    text = report.compose_report(_cells(), "t", "hw")
+    assert "FAILED" in text
+    assert "0.001000" not in text  # the unverified omp time must not appear
+    assert "2048/omp" in text      # but the failure is called out
+
+
+def test_report_best_engine_excludes_unverified():
+    # omp at 0.001 s is the fastest number but unverified; best must be tpu.
+    text = report.compose_report(_cells(), "t", "hw")
+    assert "fastest verified engine is **tpu**" in text
+
+
+def test_report_profile_sections_included():
+    text = report.compose_report(_cells(), "t", "hw",
+                                 {"gauss n=64": "phase  seconds\nx  1.0"})
+    assert "Profiling of the algorithm" in text
+    assert "phase  seconds" in text
+
+
+def test_report_cli_writes_file(tmp_path):
+    src = tmp_path / "cells.json"
+    src.write_text(json.dumps(_cells()))
+    out = tmp_path / "r" / "REPORT.md"
+    rc = report.main([str(src), "--out", str(out), "--title", "CLI report"])
+    assert rc == 0
+    assert out.read_text().startswith("# CLI report")
+
+
+def test_report_cli_empty_input_fails(tmp_path):
+    src = tmp_path / "cells.json"
+    src.write_text("[]")
+    assert report.main([str(src)]) == 2
+
+
+def test_report_profile_runs_real_solve():
+    """--profile path: one tiny real solve through the profiler."""
+    table = report._profile_gauss(32, "tpu-unblocked")
+    assert "computeGauss" in table
+
+
+def test_scaling_exponent_cubic():
+    cells = [{"suite": "s", "key": str(n), "backend": "b",
+              "seconds": (n / 256) ** 3, "verified": True, "error": 0.0,
+              "reference_s": None} for n in (256, 512, 1024)]
+    p = report._scaling_exponent(cells, "b")
+    assert p == pytest.approx(3.0, abs=0.01)
+
+
+def test_report_device_span_labeled_separately():
+    cells = _cells() + [
+        {"suite": "gauss-internal", "key": "2048", "backend": "tpu",
+         "seconds": 0.0024, "verified": True, "error": 0.0,
+         "reference_s": 0.509428, "span": "device"}]
+    text = report.compose_report(cells, "t", "hw")
+    assert "tpu [device-span]" in text
+    # both the reference-span and device-span tpu numbers appear
+    assert "0.045000" in text and "0.002400" in text
+    assert "K-chain slope" in text
