@@ -219,9 +219,11 @@ def compose_report(cells: Sequence[dict], title: str, hardware: str,
     # transfer-inclusive span, and the device span (operands resident,
     # per-op seconds by the K-chain slope; bench/slope.py). Label device-span
     # engines so the columns are never silently mixed.
-    cells = [dict(c, backend=c["backend"] + " [device-span]")
+    from gauss_tpu.bench.grid import DEVICE_SPAN_MARK
+
+    cells = [dict(c, backend=c["backend"] + DEVICE_SPAN_MARK)
              if c.get("span") == "device" else c for c in cells]
-    if any("[device-span]" in c["backend"] for c in cells):
+    if any(DEVICE_SPAN_MARK in c["backend"] for c in cells):
         lines += ["Engines marked `[device-span]` are timed by the on-device "
                   "K-chain slope method (dispatch/transfer offsets cancelled; "
                   "`gauss_tpu/bench/slope.py`); unmarked engines keep the "

@@ -40,11 +40,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--panel", type=int, default=128)
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="capture a jax.profiler device trace into DIR")
+    from gauss_tpu.dist.multihost import add_multihost_args
+
+    add_multihost_args(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from gauss_tpu.dist import multihost
+
+    if multihost.maybe_initialize_from_args(args):
+        print(multihost.process_banner())
     try:
         a = datfile.read_dat_dense(args.matrixfile)
     except (OSError, ValueError) as e:

@@ -66,11 +66,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the gprof analog; view in TensorBoard/Perfetto)")
     p.add_argument("--profile", action="store_true",
                    help="print a gprof-style per-phase wall-clock table")
+    from gauss_tpu.dist.multihost import add_multihost_args
+
+    add_multihost_args(p)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from gauss_tpu.dist import multihost
+
+    if multihost.maybe_initialize_from_args(args):
+        print(multihost.process_banner())
     n = positive_int_or_default(args.s, DEFAULT_N, "matrix size")
     t = positive_int_or_default(args.t, DEFAULT_THREADS, "thread count")
 

@@ -1,0 +1,109 @@
+"""Multi-host bootstrap: the mpirun + hostfile axis, re-expressed for JAX.
+
+The reference scales past one machine with MPI: an ssh-key bootstrap, a
+hostfile naming the nodes, and ``mpirun -np N -hostfile hosts`` starting one
+rank per slot (reference OpenMP_and_MPI/README.txt:39-48,
+OpenMP_and_MPI/gauss_mpi/hosts:1-6). Ranks then talk through
+MPI_Bcast/Isend/Irecv over TCP.
+
+The JAX equivalent is SPMD over a *global* device pool: every host runs the
+same program, calls :func:`initialize` once (the MPI_Init analog — a gRPC
+coordination service replaces the ssh/hostfile plumbing), and afterwards
+``jax.devices()`` spans all hosts. The distributed engines in this package
+(dist.gauss_dist / gauss_dist2d / matmul_dist) need no changes: they build
+their mesh over the global pool, XLA partitions the one program, and the
+pivot-row broadcast rides ICI within a slice and DCN across slices — there
+is no per-step host messaging to port, which is precisely the reference
+MPI engine's documented bottleneck (SURVEY.md §3.3).
+
+Launch parity table:
+
+    mpirun -np N -hostfile hosts ./gauss -s 8192
+        == on each host:
+    python -m gauss_tpu.cli.gauss_internal -s 8192 --backend tpu-dist \
+        --coordinator host0:8476 --num-processes N --process-id <i>
+
+On Cloud TPU pods the three coordinates are discovered from the metadata
+server and plain ``initialize()`` (no arguments) suffices; the explicit
+flags exist for manual clusters and for CPU-backend rehearsal, which
+tests/test_multihost.py exercises with two real localhost processes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_INITIALIZED = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """MPI_Init analog: join this process into the global JAX runtime.
+
+    Arguments fall back to the standard environment variables
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), then to
+    JAX's own cluster auto-detection (TPU pod metadata, SLURM, ...).
+    Safe to call once; raises on re-initialization with different topology.
+    """
+    global _INITIALIZED
+    import jax
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if _INITIALIZED:
+        raise RuntimeError("multihost.initialize() already called")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED = True
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_banner() -> str:
+    """One-line rank banner, the analog of the reference's per-rank prints
+    (gauss_mpi/gauss_internal_input.c:319-327)."""
+    import jax
+
+    return (f"process {jax.process_index()}/{jax.process_count()}: "
+            f"{jax.local_device_count()} local / "
+            f"{jax.device_count()} global devices")
+
+
+def maybe_initialize_from_args(args) -> bool:
+    """CLI hook: initialize when any multihost flag/env coordinate is set.
+
+    Returns True when running multihost. Drivers call this before touching
+    any device so the global pool is established first (jax.distributed must
+    initialize before the backend)."""
+    explicit = any(getattr(args, k, None) is not None
+                   for k in ("coordinator", "num_processes", "process_id"))
+    env = any(k in os.environ for k in
+              ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+               "JAX_PROCESS_ID"))
+    if not (explicit or env):
+        return False
+    initialize(getattr(args, "coordinator", None),
+               getattr(args, "num_processes", None),
+               getattr(args, "process_id", None))
+    return True
+
+
+def add_multihost_args(parser) -> None:
+    """Attach the three launch coordinates to a CLI parser (mpirun parity)."""
+    g = parser.add_argument_group(
+        "multihost", "multi-process launch coordinates (the mpirun "
+        "-np/-hostfile analog; omit on TPU pods for auto-detection)")
+    g.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="coordination service address (process 0's)")
+    g.add_argument("--num-processes", type=int, default=None)
+    g.add_argument("--process-id", type=int, default=None)
