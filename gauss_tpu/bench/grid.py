@@ -193,18 +193,28 @@ _SUITE_FNS = {
 
 
 def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
-              nthreads: int = 0, span: str = "reference") -> List[Cell]:
+              nthreads: int = 0, span: str = "reference",
+              thread_sweep: Optional[Sequence[int]] = None) -> List[Cell]:
     """Run one grid; returns the verified/timed cells in sweep order.
 
     Inputs (and the host truth) are prepared once per key and shared across
     the backend sweep — at n=2048 the float64 truth product alone is worth
-    not recomputing per backend."""
+    not recomputing per backend.
+
+    ``thread_sweep``: the reference reports' second axis — each of its main
+    tables sweeps the thread/rank count at fixed n (BASELINE.md "parallel,
+    internal input" columns 1-72 t). When given, every (key, backend) cell
+    is run once per thread count, keyed "<key> @<T>t". Device engines ignore
+    the thread count (the mesh, not a thread pool, is their parallelism), so
+    they are swept only once, at the first entry.
+    """
     if suite not in SUITES:
         raise ValueError(f"unknown suite {suite!r}; options: {SUITES}")
     if span not in ("reference", "device"):
         raise ValueError(f"unknown span {span!r}; options: "
                          "('reference', 'device')")
     prep, run = _SUITE_FNS[suite]
+    sweep = list(thread_sweep) if thread_sweep else [None]
     cells = []
     for key in keys:
         try:
@@ -216,25 +226,36 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                            baselines.reference_seconds(suite, key, backend))
                       for backend in backends]
             continue
-        for backend in backends:
-            # Progress to stderr per cell: sweeps run for minutes behind slow
-            # device dispatch, and a silent hang is indistinguishable from
-            # work without this.
-            print(f"bench-grid: running {suite}/{key}/{backend} ...",
-                  file=sys.stderr, flush=True)
-            try:
-                cell = run(ctx, key, backend, nthreads, span=span)
-            except Exception as e:  # one broken backend must not lose the run
-                print(f"bench-grid: {suite}/{key}/{backend} failed: {e}",
-                      file=sys.stderr)
-                cell = Cell(suite, str(key), backend, 0.0, False,
-                            float("nan"),
-                            baselines.reference_seconds(suite, key, backend))
-            else:
-                print(f"bench-grid: {suite}/{key}/{backend} -> "
-                      f"{cell.seconds:.6f}s verified={cell.verified}",
+        for t in sweep:
+            key_label = str(key) if t is None else f"{key} @{t}t"
+            run_t = nthreads if t is None else t
+            for backend in backends:
+                if (t is not None and t != sweep[0]
+                        and backend.startswith("tpu")):
+                    continue  # device engines have no thread axis
+                # Progress to stderr per cell: sweeps run for minutes behind
+                # slow device dispatch, and a silent hang is
+                # indistinguishable from work without this.
+                print(f"bench-grid: running {suite}/{key_label}/{backend} ...",
                       file=sys.stderr, flush=True)
-            cells.append(cell)
+                try:
+                    cell = run(ctx, key, backend, run_t, span=span)
+                except Exception as e:  # keep the sweep on backend failure
+                    print(f"bench-grid: {suite}/{key_label}/{backend} "
+                          f"failed: {e}", file=sys.stderr)
+                    cell = Cell(suite, str(key), backend, 0.0, False,
+                                float("nan"),
+                                baselines.reference_seconds(suite, key,
+                                                            backend))
+                else:
+                    print(f"bench-grid: {suite}/{key_label}/{backend} -> "
+                          f"{cell.seconds:.6f}s verified={cell.verified}",
+                          file=sys.stderr, flush=True)
+                if t is not None:
+                    cell = Cell(cell.suite, key_label, cell.backend,
+                                cell.seconds, cell.verified, cell.error,
+                                cell.reference_s, cell.span)
+                cells.append(cell)
     return cells
 
 
@@ -290,6 +311,10 @@ def main(argv=None) -> int:
                    help=f"comma-separated; gauss: {_common.GAUSS_BACKENDS}; "
                         f"matmul: {_common.MATMUL_BACKENDS}")
     p.add_argument("-t", "--threads", type=int, default=0)
+    p.add_argument("--thread-sweep", default=None, metavar="T1,T2,...",
+                   help="sweep native-engine thread counts at each size "
+                        "(the reference tables' second axis); cells are "
+                        "keyed '<n> @<T>t'")
     p.add_argument("--span", choices=("reference", "device"),
                    default="reference",
                    help="timing span for device engines: 'reference' keeps "
@@ -332,8 +357,10 @@ def main(argv=None) -> int:
             print(f"bench-grid: no requested backend applies to {suite}; "
                   f"valid: {valid}", file=sys.stderr)
             continue
+        sweep = ([int(x) for x in args.thread_sweep.split(",") if x.strip()]
+                 if args.thread_sweep else None)
         all_cells += run_suite(suite, keys, suite_backends, args.threads,
-                               span=args.span)
+                               span=args.span, thread_sweep=sweep)
 
     if not all_cells:
         print("bench-grid: nothing ran (no valid suite/backend combination)",

@@ -58,7 +58,20 @@ def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
     return slope
 
 
-def gauss_solve_once(a, b, panel: int, refine_steps: int = 0):
+# Above this size the trace-time-unrolled factorization is not chained: a
+# K=16 chain of 32+ unrolled panel programs exceeds the compile-payload
+# limit of tunneled dev chips (HTTP 413 observed at n=8192), and compile
+# time grows with nb either way. The fori_loop formulation trades ~2x
+# masked GEMM FLOPs for one compiled body — the right trade at this scale.
+UNROLL_MAX_N = 4096
+
+
+def _resolve_chain_unroll(n: int, unroll) -> bool:
+    return n <= UNROLL_MAX_N if unroll == "auto" else bool(unroll)
+
+
+def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
+                     unroll="auto"):
     """One iteration of exactly the configuration :func:`gauss_chain` times:
     blocked f32 factor + solve (+ optional on-device f32 refinement steps).
     Exposed so callers can VERIFY the very computation the slope measures —
@@ -69,7 +82,10 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0):
 
     from gauss_tpu.core import blocked
 
-    fac = blocked.lu_factor_blocked_unrolled(a, panel=panel)
+    factor = (blocked.lu_factor_blocked_unrolled
+              if _resolve_chain_unroll(a.shape[0], unroll)
+              else blocked.lu_factor_blocked)
+    fac = factor(a, panel=panel)
     x = blocked.lu_solve(fac, b)
     for _ in range(refine_steps):
         r = b - jnp.dot(a, x, precision=lax.Precision.HIGHEST)
@@ -77,7 +93,7 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0):
     return x
 
 
-def gauss_chain(a, b, panel: int, refine_steps: int = 0
+def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto"
                 ) -> Tuple[Callable[[int], Callable], tuple]:
     """Chain factory for the blocked gauss solve: each iteration is a full
     factor+solve (+ refine_steps on-device f32 refinement iterations — each
@@ -89,17 +105,20 @@ def gauss_chain(a, b, panel: int, refine_steps: int = 0
 
     def make_chain(k: int):
         @jax.jit
-        def run(x0):
+        def run(a_, b_, x0):
+            # a/b enter as ARGUMENTS, not closure captures: captured arrays
+            # ride along with the compile payload, which breaks tunneled
+            # remote compilation at large n (HTTP 413 at n=8192, 268 MB).
             def body(_, x):
-                a_i = a + x[0] * jnp.asarray(PERTURB, a.dtype)
-                return gauss_solve_once(a_i, b, panel, refine_steps)
+                a_i = a_ + x[0] * jnp.asarray(PERTURB, a_.dtype)
+                return gauss_solve_once(a_i, b_, panel, refine_steps, unroll)
 
             x = lax.fori_loop(0, k, body, x0)
             return jnp.sum(x)  # scalar fetch: completion without bandwidth
 
         return run
 
-    return make_chain, (b,)
+    return make_chain, (a, b, b)
 
 
 def matmul_chain(a, b, mm: Callable) -> Tuple[Callable[[int], Callable], tuple]:
@@ -110,13 +129,13 @@ def matmul_chain(a, b, mm: Callable) -> Tuple[Callable[[int], Callable], tuple]:
 
     def make_chain(k: int):
         @jax.jit
-        def run(c0):
+        def run(a_, b_, c0):
             def body(_, c):
-                return mm(a + c[0, 0] * jnp.asarray(PERTURB, a.dtype), b)
+                return mm(a_ + c[0, 0] * jnp.asarray(PERTURB, a_.dtype), b_)
 
             c = lax.fori_loop(0, k, body, c0)
             return c[0, 0]
 
         return run
 
-    return make_chain, (jnp.zeros((a.shape[0], b.shape[1]), a.dtype),)
+    return make_chain, (a, b, jnp.zeros((a.shape[0], b.shape[1]), a.dtype))

@@ -180,3 +180,14 @@ def test_grid_device_span_gauss_and_matmul():
 def test_grid_rejects_unknown_span():
     with pytest.raises(ValueError, match="span"):
         grid.run_suite("matmul", [16], ["tpu"], span="bogus")
+
+
+def test_grid_thread_sweep_keys_and_device_dedup():
+    cells = grid.run_suite("gauss-internal", [32], ["seq", "tpu-unblocked"],
+                           thread_sweep=[1, 2])
+    labels = [(c.key, c.backend) for c in cells]
+    assert ("32 @1t", "seq") in labels and ("32 @2t", "seq") in labels
+    # device engines have no thread axis: swept once only
+    assert ("32 @1t", "tpu-unblocked") in labels
+    assert ("32 @2t", "tpu-unblocked") not in labels
+    assert all(c.verified for c in cells)
