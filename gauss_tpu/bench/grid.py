@@ -222,17 +222,28 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
         except Exception as e:  # bad key: fail its cells, keep the sweep
             print(f"bench-grid: {suite}/{key} setup failed: {e}",
                   file=sys.stderr)
-            cells += [Cell(suite, str(key), backend, 0.0, False, float("nan"),
-                           baselines.reference_seconds(suite, key, backend))
-                      for backend in backends]
+            for t in sweep:
+                for backend in backends:
+                    if t is not None and backend.startswith("tpu")                             and t != sweep[0]:
+                        continue
+                    label = (str(key) if t is None
+                             or backend.startswith("tpu") else f"{key} @{t}t")
+                    cells.append(Cell(suite, label, backend, 0.0, False,
+                                      float("nan"),
+                                      baselines.reference_seconds(
+                                          suite, key, backend)))
             continue
         for t in sweep:
-            key_label = str(key) if t is None else f"{key} @{t}t"
             run_t = nthreads if t is None else t
             for backend in backends:
-                if (t is not None and t != sweep[0]
-                        and backend.startswith("tpu")):
-                    continue  # device engines have no thread axis
+                # Device engines have no thread axis: swept once, and keyed
+                # by the bare size so scaling fits and tables stay honest.
+                if t is not None and backend.startswith("tpu"):
+                    if t != sweep[0]:
+                        continue
+                    key_label = str(key)
+                else:
+                    key_label = str(key) if t is None else f"{key} @{t}t"
                 # Progress to stderr per cell: sweeps run for minutes behind
                 # slow device dispatch, and a silent hang is
                 # indistinguishable from work without this.
@@ -251,7 +262,7 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                     print(f"bench-grid: {suite}/{key_label}/{backend} -> "
                           f"{cell.seconds:.6f}s verified={cell.verified}",
                           file=sys.stderr, flush=True)
-                if t is not None:
+                if cell.key != key_label:
                     cell = Cell(cell.suite, key_label, cell.backend,
                                 cell.seconds, cell.verified, cell.error,
                                 cell.reference_s, cell.span)
@@ -336,6 +347,13 @@ def main(argv=None) -> int:
     if unknown:
         p.error(f"unknown backend(s) {unknown}; gauss: "
                 f"{_common.GAUSS_BACKENDS}; matmul: {_common.MATMUL_BACKENDS}")
+    sweep = None
+    if args.thread_sweep:
+        raw = [x.strip() for x in args.thread_sweep.split(",") if x.strip()]
+        bad = [x for x in raw if not x.isdigit() or int(x) < 1]
+        if bad or not raw:
+            p.error(f"--thread-sweep must be positive integers, got {bad or args.thread_sweep!r}")
+        sweep = [int(x) for x in raw]
     all_cells: List[Cell] = []
     for suite in suites:
         if args.keys:
@@ -357,8 +375,6 @@ def main(argv=None) -> int:
             print(f"bench-grid: no requested backend applies to {suite}; "
                   f"valid: {valid}", file=sys.stderr)
             continue
-        sweep = ([int(x) for x in args.thread_sweep.split(",") if x.strip()]
-                 if args.thread_sweep else None)
         all_cells += run_suite(suite, keys, suite_backends, args.threads,
                                span=args.span, thread_sweep=sweep)
 

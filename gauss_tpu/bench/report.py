@@ -163,11 +163,20 @@ def _scaling_exponent(cells: Sequence[dict], backend: str) -> Optional[float]:
     return math.log(t1 / t0) / math.log(n1 / n0)
 
 
+def _largest_key(keys: List[str]) -> Optional[str]:
+    """The largest NUMERIC size, falling back to input order for named keys
+    (dataset names, '@Tt' thread-sweep labels must not win by position)."""
+    numeric = [k for k in keys if str(k).isdigit()]
+    if numeric:
+        return max(numeric, key=int)
+    return keys[-1] if keys else None
+
+
 def _inferences(suite: str, cells: Sequence[dict]) -> List[str]:
     """Data-derived bullets — the analog of the reports' 'Inferences'."""
     out: List[str] = []
     keys, grid = _keys_in_order(cells), _grid(cells)
-    largest = keys[-1] if keys else None
+    largest = _largest_key(keys)
     if largest and grid[largest]:
         verified = [c for c in grid[largest].values() if c["verified"]]
         if verified:

@@ -187,7 +187,17 @@ def test_grid_thread_sweep_keys_and_device_dedup():
                            thread_sweep=[1, 2])
     labels = [(c.key, c.backend) for c in cells]
     assert ("32 @1t", "seq") in labels and ("32 @2t", "seq") in labels
-    # device engines have no thread axis: swept once only
-    assert ("32 @1t", "tpu-unblocked") in labels
-    assert ("32 @2t", "tpu-unblocked") not in labels
+    # device engines have no thread axis: swept once, keyed by the bare size
+    assert ("32", "tpu-unblocked") in labels
+    assert not any("@" in k and b == "tpu-unblocked" for k, b in labels)
     assert all(c.verified for c in cells)
+
+
+def test_grid_thread_sweep_prep_failure_keys_consistent():
+    cells = grid.run_suite("gauss-external", ["bogus_matrix"], ["seq", "tpu"],
+                           thread_sweep=[1, 2])
+    labels = [(c.key, c.backend) for c in cells]
+    assert ("bogus_matrix @1t", "seq") in labels
+    assert ("bogus_matrix @2t", "seq") in labels
+    assert ("bogus_matrix", "tpu") in labels
+    assert len(labels) == 3 and not any(c.verified for c in cells)

@@ -104,3 +104,17 @@ def test_report_device_span_labeled_separately():
     # both the reference-span and device-span tpu numbers appear
     assert "0.045000" in text and "0.002400" in text
     assert "K-chain slope" in text
+
+
+def test_report_largest_key_ignores_thread_sweep_labels():
+    """Inference 'largest size' must be the largest numeric n, not whatever
+    key happened to be concatenated last (e.g. '2048 @16t' sweep labels)."""
+    cells = _cells() + [
+        {"suite": "gauss-internal", "key": "8192", "backend": "tpu",
+         "seconds": 0.123, "verified": True, "error": 0.0,
+         "reference_s": None, "span": "device"},
+        {"suite": "gauss-internal", "key": "2048 @16t", "backend": "threads",
+         "seconds": 1.58, "verified": True, "error": 0.0,
+         "reference_s": None}]
+    text = report.compose_report(cells, "t", "hw")
+    assert "At the largest size (8192)" in text
