@@ -1,0 +1,86 @@
+import sys; sys.path.insert(0, "/root/repo")
+"""Ablation: factor cost breakdown (panel kernel / permute / trisolve / GEMM)."""
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from functools import partial
+from gauss_tpu.core import blocked
+from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+from gauss_tpu.io import synthetic
+from gauss_tpu.utils.timing import timed_fetch
+from gauss_tpu.kernels.matmul_pallas import resolve_precision
+
+n, panel = 2048, 256
+a64, b64 = synthetic.internal_matrix(n), synthetic.internal_rhs(n)
+A = jnp.asarray(a64, jnp.float32)
+B = jnp.asarray(b64, jnp.float32)
+
+def factor_ablate(a, *, do_perm=True, do_tri=True, do_gemm=True, do_solve=False):
+    m = a
+    npad = m.shape[0]
+    dtype = m.dtype
+    perm = jnp.arange(npad)
+    gemm_prec = resolve_precision("highest")
+    for kb in range(0, npad, panel):
+        tail = npad - kb
+        p = m[kb:, kb:kb + panel]
+        p, ipiv, perm_local, mp = panel_factor_pallas(p, 0)
+        if do_perm:
+            live = m[kb:][perm_local]
+            perm = perm.at[kb:].set(perm[kb:][perm_local])
+        else:
+            live = m[kb:]
+        live = live.at[:, kb:kb + panel].set(p)
+        if kb + panel < npad:
+            l11 = live[:panel, kb:kb + panel]
+            if do_tri:
+                u12 = lax.linalg.triangular_solve(
+                    l11, live[:panel, kb + panel:],
+                    left_side=True, lower=True, unit_diagonal=True)
+                live = live.at[:panel, kb + panel:].set(u12)
+            else:
+                u12 = live[:panel, kb + panel:]
+            if do_gemm:
+                l21 = live[panel:, kb:kb + panel]
+                trail = live[panel:, kb + panel:]
+                live = live.at[panel:, kb + panel:].set(
+                    trail - jnp.dot(l21, u12, precision=gemm_prec))
+        m = m.at[kb:].set(live)
+    if do_solve:
+        fac = blocked.BlockedLU(m=m, perm=perm, min_abs_pivot=jnp.asarray(1.0, dtype))
+        return blocked.lu_solve(fac, B)
+    return m[:, 0]
+
+def chain(k, **kw):
+    @jax.jit
+    def run(a, x0):
+        def body(_, x):
+            a_i = a + x[0] * jnp.asarray(1e-6, a.dtype)
+            return factor_ablate(a_i, **kw)[:x0.shape[0]]
+        x = lax.fori_loop(0, k, body, x0)
+        return jnp.sum(x)
+    return run
+
+def slope(**kw):
+    fns = {k: chain(k, **kw) for k in (3, 11)}
+    x0 = B
+    for f in fns.values():
+        np.asarray(f(A, x0)); np.asarray(f(A, x0))
+    best = {k: float("inf") for k in fns}
+    for _ in range(4):
+        for k, f in fns.items():
+            t,_ = timed_fetch(f, A, x0, warmup=0, reps=1)
+            best[k] = min(best[k], t)
+    return (best[11]-best[3])/8
+
+full = slope(do_perm=True, do_tri=True, do_gemm=True, do_solve=True)
+fac  = slope(do_perm=True, do_tri=True, do_gemm=True)
+noperm = slope(do_perm=False, do_tri=True, do_gemm=True)
+notri = slope(do_perm=True, do_tri=False, do_gemm=True)
+nogemm = slope(do_perm=True, do_tri=True, do_gemm=False)
+kern_only = slope(do_perm=False, do_tri=False, do_gemm=False)
+print(f"full factor+solve {full*1e3:7.3f} ms")
+print(f"factor only       {fac*1e3:7.3f} ms  (solve = {(full-fac)*1e3:.3f})")
+print(f"  no permute      {noperm*1e3:7.3f} ms  (permute = {(fac-noperm)*1e3:.3f})")
+print(f"  no trisolve     {notri*1e3:7.3f} ms  (trisolve = {(fac-notri)*1e3:.3f})")
+print(f"  no gemm         {nogemm*1e3:7.3f} ms  (gemm = {(fac-nogemm)*1e3:.3f})")
+print(f"  kernels only    {kern_only*1e3:7.3f} ms")

@@ -1,0 +1,12 @@
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from gauss_tpu.bench import slope
+from gauss_tpu.io import synthetic
+from gauss_tpu.utils.timing import timed_fetch
+
+n = 2048
+a = jnp.asarray(synthetic.internal_matrix(n), jnp.float32)
+b = jnp.asarray(synthetic.internal_rhs(n), jnp.float32)
+make, args = slope.gauss_chain(a, b, 256)
+print(f"factor+solve n=2048: {slope.measure_slope(make, args)*1e3:7.3f} ms")

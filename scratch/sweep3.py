@@ -1,0 +1,11 @@
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from gauss_tpu.bench import slope
+from gauss_tpu.io import synthetic
+
+n = 2048
+a = jnp.asarray(synthetic.internal_matrix(n), jnp.float32)
+b = jnp.asarray(synthetic.internal_rhs(n), jnp.float32)
+for panel in (128, 192, 256, 320):
+    make, args = slope.gauss_chain(a, b, panel)
+    print(f"panel={panel:4d}: {slope.measure_slope(make, args)*1e3:7.3f} ms")

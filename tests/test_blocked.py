@@ -1,5 +1,6 @@
 """Blocked LU path vs the unblocked oracle and numpy."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -149,3 +150,37 @@ def test_gauss_solve_blocked_unroll_flag(rng):
     x_t = np.asarray(gauss_solve_blocked(a, b, panel=32, unroll=True))
     x_f = np.asarray(gauss_solve_blocked(a, b, panel=32, unroll=False))
     np.testing.assert_allclose(x_t, x_f, rtol=1e-10, atol=1e-10)
+
+
+def test_triangular_inverses_identity(rng):
+    """unit_lower_inv / upper_inv: recursive TRTRI correctness incl. odd
+    sizes crossing the recursion base."""
+    from gauss_tpu.core.blocked import TRI_INV_BASE, unit_lower_inv, upper_inv
+
+    for p in (1, 7, TRI_INV_BASE, TRI_INV_BASE + 1, 2 * TRI_INV_BASE + 3):
+        l = np.tril(rng.standard_normal((p, p)), -1).astype(np.float32) * 0.3 \
+            + np.eye(p, dtype=np.float32)
+        li = np.asarray(unit_lower_inv(jnp.asarray(l)))
+        np.testing.assert_allclose(li @ l, np.eye(p), atol=5e-4)
+        u = np.triu(rng.standard_normal((p, p))).astype(np.float32) \
+            + np.eye(p, dtype=np.float32) * 4
+        ui = np.asarray(upper_inv(jnp.asarray(u)))
+        np.testing.assert_allclose(ui @ u, np.eye(p), atol=5e-4)
+
+
+def test_lu_solve_substitution_fallback(rng):
+    """A BlockedLU without stored inverses must still solve (substitution
+    path) and agree with the inverse-based solve."""
+    from gauss_tpu.core.blocked import BlockedLU, lu_factor_blocked_unrolled
+
+    n = 96
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    fac = lu_factor_blocked_unrolled(a, panel=32)
+    assert fac.linv is not None and fac.linv.shape == (3, 32, 32)
+    bare = BlockedLU(m=fac.m, perm=fac.perm, min_abs_pivot=fac.min_abs_pivot)
+    x_inv = np.asarray(lu_solve(fac, b), np.float64)
+    x_sub = np.asarray(lu_solve(bare, b), np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x_inv, ref, rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(x_sub, ref, rtol=5e-3, atol=5e-3)
