@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--panel", type=int, default=128)
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="capture a jax.profiler device trace into DIR")
+    p.add_argument("--debug", action="store_true",
+                   help="print parse and pivot diagnostics (the reference's "
+                        "compile-time DEBUG define, gauss_external_input.c:17, "
+                        "as a runtime flag)")
     from gauss_tpu.dist.multihost import add_multihost_args
 
     add_multihost_args(p)
@@ -53,7 +57,18 @@ def main(argv=None) -> int:
     if multihost.maybe_initialize_from_args(args):
         print(multihost.process_banner())
     try:
-        a = datfile.read_dat_dense(args.matrixfile)
+        if args.debug:
+            n_hdr, rows, cols, vals = datfile.read_dat(args.matrixfile)
+            if len(vals):
+                stats = (f"coord range rows [{rows.min()},{rows.max()}] "
+                         f"cols [{cols.min()},{cols.max()}], |value| in "
+                         f"[{abs(vals).min():.3e},{abs(vals).max():.3e}]")
+            else:
+                stats = "no nonzeros (zero matrix)"
+            print(f"DEBUG: parsed header n={n_hdr}, nnz={len(vals)}, {stats}")
+            a = datfile.densify(n_hdr, rows, cols, vals)
+        else:
+            a = datfile.read_dat_dense(args.matrixfile)
     except (OSError, ValueError) as e:
         print(f"gauss_external: cannot read '{args.matrixfile}': {e}", file=sys.stderr)
         return 1
@@ -72,6 +87,27 @@ def main(argv=None) -> int:
             a, b, args.backend, nthreads=args.threads,
             pivoting="partial", refine_iters=args.refine, panel=args.panel,
             refine_tol=args.refine_tol)
+
+    if args.debug and args.backend == "tpu":
+        # Pivot diagnostics (the reference's DEBUG pivot logs print the
+        # chosen row per step): an explicit blocked-LU analysis pass —
+        # costs one extra factorization, only for the exact backend whose
+        # solver is this factorization, and only on process 0 under
+        # multihost. min |pivot| reads the real U diagonal (first n
+        # entries), not min_abs_pivot, which the identity padding clamps
+        # to <= 1 when n is not a panel multiple.
+        import jax
+
+        if jax.process_index() == 0:
+            from gauss_tpu.core.blocked import lu_factor_blocked_unrolled
+
+            fac = lu_factor_blocked_unrolled(
+                np.asarray(a, np.float32), panel=args.panel)
+            perm = np.asarray(fac.perm)[:n]
+            moved = int((perm != np.arange(n)).sum())
+            pivots = np.abs(np.diagonal(np.asarray(fac.m)))[:n]
+            print(f"DEBUG: partial pivoting moved {moved}/{n} rows; "
+                  f"min |pivot| = {pivots.min():.6e}")
 
     print(f"Time: {elapsed:f} seconds")
     err = checks.max_rel_error(x, x_true)

@@ -118,3 +118,47 @@ def test_gauss_internal_tpu_dist2d(capsys):
     assert rc == 0, out
     assert "Application time:" in out
     assert "OK" in out
+
+
+def test_gauss_external_debug_flag(tmp_path, capsys):
+    """--debug: the reference's compile-time DEBUG define as a runtime flag
+    (parse + pivot diagnostics around the normal output lines)."""
+    import numpy as np
+
+    from gauss_tpu.io import datfile
+
+    f = tmp_path / "m.dat"
+    rng = np.random.default_rng(3)
+    datfile.write_dat(f, rng.standard_normal((24, 24)))
+    rc = gauss_external.main([str(f), "--backend", "tpu", "--debug"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DEBUG: parsed header n=24" in out
+    assert "DEBUG: partial pivoting moved" in out
+    assert "Time:" in out and "Error:" in out
+
+
+def test_gauss_external_debug_zero_matrix(tmp_path, capsys):
+    """--debug on a valid nnz=0 file must not crash or misreport a read
+    failure; the solve itself then reports the singular system."""
+    f = tmp_path / "z.dat"
+    f.write_text("4 4 0\n0 0 0\n")
+    gauss_external.main([str(f), "--backend", "tpu-unblocked", "--debug"])
+    out = capsys.readouterr().out
+    assert "DEBUG: parsed header n=4, nnz=0, no nonzeros" in out
+    assert "cannot read" not in out
+
+
+def test_gauss_external_debug_min_pivot_unclamped(tmp_path, capsys):
+    """min |pivot| must come from the real U diagonal, not the identity
+    padding (which clamps min_abs_pivot to <= 1 for n % panel != 0)."""
+    import numpy as np
+
+    from gauss_tpu.io import datfile
+
+    f = tmp_path / "d.dat"
+    datfile.write_dat(f, 10.0 * np.eye(8))
+    rc = gauss_external.main([str(f), "--backend", "tpu", "--debug"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "min |pivot| = 1.000000e+01" in out
