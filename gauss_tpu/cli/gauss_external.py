@@ -82,11 +82,17 @@ def main(argv=None) -> int:
     # solve span includes back-substitution, which is O(n^2) noise against it.
     from gauss_tpu.utils import profiling
 
-    with profiling.trace(args.trace):
-        x, elapsed = _common.solve_with_backend(
-            a, b, args.backend, nthreads=args.threads,
-            pivoting="partial", refine_iters=args.refine, panel=args.panel,
-            refine_tol=args.refine_tol)
+    try:
+        with profiling.trace(args.trace):
+            x, elapsed = _common.solve_with_backend(
+                a, b, args.backend, nthreads=args.threads,
+                pivoting="partial", refine_iters=args.refine, panel=args.panel,
+                refine_tol=args.refine_tol)
+    except np.linalg.LinAlgError:
+        # Native engines raise on a zero pivot; the reference's abort
+        # message (gauss_external_input.c:137 prints to stderr).
+        print("The matrix is singular", file=sys.stderr)
+        return 1
 
     if args.debug and args.backend == "tpu":
         # Pivot diagnostics (the reference's DEBUG pivot logs print the
@@ -112,7 +118,12 @@ def main(argv=None) -> int:
     print(f"Time: {elapsed:f} seconds")
     err = checks.max_rel_error(x, x_true)
     print(f"Error: {err:e}")
-    return 0 if np.isfinite(err) else 1
+    if not np.isfinite(err):
+        # Device engines signal a zero pivot through a NaN solution
+        # (min_abs_pivot == 0 inside jit; SURVEY.md §2 C12 error paths).
+        print("The matrix is singular", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

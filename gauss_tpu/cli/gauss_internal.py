@@ -97,11 +97,17 @@ def main(argv=None) -> int:
     init_elapsed = pt.seconds["initMatrix"]
 
     t0 = time.perf_counter()
-    with profiling.trace(args.trace):
-        x, solve_elapsed = _common.solve_with_backend(
-            a, b, args.backend, nthreads=t, pivoting=args.pivoting,
-            refine_iters=args.refine, panel=args.panel,
-            refine_tol=args.refine_tol)
+    try:
+        with profiling.trace(args.trace):
+            x, solve_elapsed = _common.solve_with_backend(
+                a, b, args.backend, nthreads=t, pivoting=args.pivoting,
+                refine_iters=args.refine, panel=args.panel,
+                refine_tol=args.refine_tol)
+    except np.linalg.LinAlgError:
+        # Native engines raise on a zero pivot; the reference's abort
+        # message (gauss_internal_input.c:96).
+        print("The matrix is singular")
+        return 1
     # solve_with_backend's span excludes the JIT warmup; attribute the rest
     # of the wrapper time to compilation so the profile matches the printed
     # Application time instead of blaming compile time on the compute phase.

@@ -162,3 +162,16 @@ def test_gauss_external_debug_min_pivot_unclamped(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "min |pivot| = 1.000000e+01" in out
+
+
+def test_gauss_external_singular_prints_reference_message(tmp_path, capsys):
+    """Singular systems end with the reference's abort line on stderr
+    (gauss_external_input.c:137) and a nonzero exit — for both native
+    (LinAlgError) and device (NaN solution) engines."""
+    f = tmp_path / "z.dat"
+    f.write_text("4 4 0\n0 0 0\n")
+    for backend in ("seq", "tpu-unblocked"):
+        rc = gauss_external.main([str(f), "--backend", backend])
+        captured = capsys.readouterr()
+        assert rc == 1, backend
+        assert "The matrix is singular" in captured.err, backend
