@@ -30,6 +30,15 @@ ROUNDS = 5
 PERTURB = 1e-6
 
 
+# The chain-time DIFFERENCE must clear the tunnel's jitter floor or the
+# slope is noise: sub-0.1 ms ops at K=4/16 leave ~1 ms of signal against
+# several ms of jitter, and the fallback then reports the ~0.1 s dispatch
+# offset as if it were compute (observed 100x overstatements). Escalate K
+# until the delta clears this floor.
+MIN_DELTA_S = 0.004
+MAX_K = 1024
+
+
 def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
                   k_small: int = K_SMALL, k_large: int = K_LARGE,
                   rounds: int = ROUNDS) -> float:
@@ -37,25 +46,32 @@ def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
 
     ``make_chain(k)`` must return a jitted callable running k data-dependent
     iterations on device and returning a SMALL result (scalar fetch — the
-    completion signal must not measure tunnel bandwidth). Falls back to the
-    whole-chain mean (a conservative overestimate that still contains the
-    dispatch offset) if noise swamps the slope.
+    completion signal must not measure tunnel bandwidth). If the measured
+    chain-time delta is below the jitter floor, the K pair escalates (x4)
+    and remeasures. At MAX_K a positive sub-floor delta is still returned
+    as the slope (the best available estimate); only a non-positive delta
+    falls back to the whole-chain mean — a conservative overestimate that
+    still contains the dispatch offset.
     """
     from gauss_tpu.utils.timing import timed_fetch
 
-    fns = {k: make_chain(k) for k in (k_small, k_large)}
-    for fn in fns.values():  # compile + settle before any timing (untimed)
-        np.asarray(fn(*args))
-        np.asarray(fn(*args))
-    best = {k: float("inf") for k in fns}
-    for _ in range(rounds):
-        for k, fn in fns.items():
-            t, _ = timed_fetch(fn, *args, warmup=0, reps=1)
-            best[k] = min(best[k], t)
-    slope = (best[k_large] - best[k_small]) / (k_large - k_small)
-    if slope <= 0:
+    while True:
+        fns = {k: make_chain(k) for k in (k_small, k_large)}
+        for fn in fns.values():  # compile + settle before any timing
+            np.asarray(fn(*args))
+            np.asarray(fn(*args))
+        best = {k: float("inf") for k in fns}
+        for _ in range(rounds):
+            for k, fn in fns.items():
+                t, _ = timed_fetch(fn, *args, warmup=0, reps=1)
+                best[k] = min(best[k], t)
+        delta = best[k_large] - best[k_small]
+        if delta >= MIN_DELTA_S or k_large * 4 > MAX_K:
+            break
+        k_small, k_large = k_small * 4, k_large * 4
+    if delta <= 0:
         return best[k_large] / k_large
-    return slope
+    return delta / (k_large - k_small)
 
 
 # Above this size the trace-time-unrolled factorization is not chained: a

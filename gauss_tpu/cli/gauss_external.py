@@ -121,7 +121,13 @@ def main(argv=None) -> int:
     if not np.isfinite(err):
         # Device engines signal a zero pivot through a NaN solution
         # (min_abs_pivot == 0 inside jit; SURVEY.md §2 C12 error paths).
-        print("The matrix is singular", file=sys.stderr)
+        # A solution that overflowed f32 without NaN is a range problem,
+        # not singularity — do not misdiagnose it.
+        if np.isnan(np.asarray(x, np.float64)).any():
+            print("The matrix is singular", file=sys.stderr)
+        else:
+            print("Solve overflowed float32 range (matrix scaling problem, "
+                  "not singularity)", file=sys.stderr)
         return 1
     return 0
 

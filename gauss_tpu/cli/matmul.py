@@ -37,21 +37,32 @@ def _inputs(n: int):
     return a, b
 
 
-def _tpu_engine_fn(engine: str):
-    """The device matmul callable behind a tpu* engine name."""
-    if engine == "tpu-pallas":
-        from gauss_tpu.kernels.matmul_pallas import matmul_pallas as mm
-    elif engine == "tpu-pallas-v1":
-        from gauss_tpu.kernels.matmul_pallas import matmul_pallas_stripe as mm
-    else:
-        from gauss_tpu.core.matmul import matmul as mm
-    return mm
+def _tpu_engine_fn(engine: str, precision: str = None):
+    """The device matmul callable behind a tpu* engine name.
+
+    ``precision`` None keeps each engine's default ("high" bf16x3 for the
+    XLA engine, "highest" for the Pallas kernels — Mosaic rejects HIGH
+    inside kernels, so "high" is clamped up to "highest" there).
+    """
+    from functools import partial as _partial
+
+    if engine in ("tpu-pallas", "tpu-pallas-v1"):
+        if engine == "tpu-pallas":
+            from gauss_tpu.kernels.matmul_pallas import matmul_pallas as mm
+        else:
+            from gauss_tpu.kernels.matmul_pallas import (
+                matmul_pallas_stripe as mm)
+        if precision is None or precision == "high":
+            return mm
+        return _partial(mm, precision=precision)
+    from gauss_tpu.core.matmul import matmul as mm
+    return mm if precision is None else _partial(mm, precision=precision)
 
 
-def _run_tpu(a, b, engine: str):
+def _run_tpu(a, b, engine: str, precision: str = None):
     import jax.numpy as jnp
 
-    mm = _tpu_engine_fn(engine)
+    mm = _tpu_engine_fn(engine, precision)
     from gauss_tpu.utils.timing import timed_fetch
 
     np.asarray(mm(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)))  # compile
@@ -80,6 +91,11 @@ def main(argv=None) -> int:
                         "tpu-pallas-v1, seq, omp")
     p.add_argument("-t", "--threads", type=int, default=0,
                    help="threads for the omp engine (default: all)")
+    p.add_argument("--precision", choices=("highest", "high", "default"),
+                   default=None,
+                   help="MXU precision for device engines (default: each "
+                        "engine's own — 'high' bf16x3 for the XLA engine, "
+                        "'highest' f32-emulation for Pallas kernels)")
     args = p.parse_args(argv)
     n = args.nsize
     if n <= 0:
@@ -102,7 +118,7 @@ def main(argv=None) -> int:
     failed = False
     for engine in engines:
         if engine.startswith("tpu"):
-            c, elapsed = _run_tpu(a, b, engine)
+            c, elapsed = _run_tpu(a, b, engine, args.precision)
         else:
             c, elapsed = _run_native(a, b, engine, args.threads)
         ok = checks.elementwise_match(c, truth, epsilon=checks.EPSILON * scale)

@@ -23,7 +23,7 @@ from gauss_tpu.dist.mesh import make_mesh
 
 
 def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
-                precision: str = "highest", replicate_out: bool = True):
+                precision: str = "high", replicate_out: bool = True):
     """C = A @ B with operands sharded over the mesh."""
     if mesh is None:
         mesh = make_mesh()

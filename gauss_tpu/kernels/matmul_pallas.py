@@ -19,20 +19,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Shared precision-name mapping for kernels and the blocked LU.
-PRECISIONS = {
-    "highest": lax.Precision.HIGHEST,
-    "high": lax.Precision.HIGH,
-    "default": lax.Precision.DEFAULT,
-}
-
-
-def resolve_precision(name: str) -> lax.Precision:
-    try:
-        return PRECISIONS[name]
-    except KeyError:
-        raise ValueError(f"unknown precision {name!r}; "
-                         f"options: {tuple(PRECISIONS)}") from None
+# Single shared precision-name mapping lives in core.matmul; re-exported
+# here for existing importers (core.blocked, tests).
+from gauss_tpu.core.matmul import PRECISIONS, resolve_precision  # noqa: F401
 
 
 def _auto_interpret(interpret):
@@ -51,7 +40,10 @@ def _mm_kernel(a_ref, b_ref, o_ref, acc_ref, *, precision, k_axis):
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # Explicit precision: the MXU's default single bf16 pass fails the
-    # reference's eps=1e-4 comparator for f32 inputs at n >= 512.
+    # reference's eps=1e-4 comparator for f32 inputs at n >= 512. The bf16x3
+    # "high" scheme would pass it (see core.matmul, which defaults to it),
+    # but Mosaic rejects precision=HIGH inside kernels ("Unsupported dot
+    # precision"), so these kernels default to the 6-pass "highest".
     acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
                           preferred_element_type=acc_ref.dtype,
                           precision=precision)

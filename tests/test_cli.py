@@ -168,10 +168,24 @@ def test_gauss_external_singular_prints_reference_message(tmp_path, capsys):
     """Singular systems end with the reference's abort line on stderr
     (gauss_external_input.c:137) and a nonzero exit — for both native
     (LinAlgError) and device (NaN solution) engines."""
+    from gauss_tpu import native
+
     f = tmp_path / "z.dat"
     f.write_text("4 4 0\n0 0 0\n")
-    for backend in ("seq", "tpu-unblocked"):
+    backends = ["tpu-unblocked"] + (["seq"] if native.available() else [])
+    for backend in backends:
         rc = gauss_external.main([str(f), "--backend", backend])
         captured = capsys.readouterr()
         assert rc == 1, backend
         assert "The matrix is singular" in captured.err, backend
+
+
+def test_matmul_cli_precision_flag(capsys):
+    """--precision overrides the XLA engine's default and clamps 'high' up
+    for Pallas kernels (Mosaic rejects HIGH inside kernels)."""
+    rc = matmul.main(["64", "--engines", "tpu,tpu-pallas",
+                      "--precision", "highest"])
+    out = capsys.readouterr().out
+    assert rc == 0 and out.count("verify: OK") == 2
+    rc = matmul.main(["64", "--engines", "tpu-pallas", "--precision", "high"])
+    assert rc == 0
