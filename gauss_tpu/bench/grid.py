@@ -64,8 +64,8 @@ def _prep_gauss_internal(n: int):
     return a, b, time.perf_counter() - t0
 
 
-def _gauss_device_cell(a64, b64, refine_steps: int):
-    """Slope-timed per-solve seconds for the blocked TPU engine (operands
+def _gauss_device_cell(a64, b64, refine_steps: int, backend: str = "tpu"):
+    """Slope-timed per-solve seconds for a device gauss engine (operands
     device-resident, dispatch/fetch offset cancelled; see bench.slope),
     plus the float64 solution of EXACTLY the timed configuration — the
     cell's verification must check what the slope measured, not some other
@@ -77,14 +77,23 @@ def _gauss_device_cell(a64, b64, refine_steps: int):
 
     a = jnp.asarray(a64, jnp.float32)
     b = jnp.asarray(b64, jnp.float32)
-    panel = 256 if a.shape[0] >= 1024 else DEFAULT_PANEL
-    x = np.asarray(slope.gauss_solve_once(a, b, panel, refine_steps),
-                   np.float64)
-    make_chain, args = slope.gauss_chain(a, b, panel, refine_steps)
+    if backend == "tpu-rowelim":
+        from gauss_tpu.kernels.rowelim_pallas import gauss_solve_rowelim
+
+        def solve_once(a_, b_):
+            return gauss_solve_rowelim(a_, b_)
+    else:
+        panel = 256 if a.shape[0] >= 1024 else DEFAULT_PANEL
+
+        def solve_once(a_, b_):
+            return slope.gauss_solve_once(a_, b_, panel, refine_steps)
+
+    x = np.asarray(solve_once(a, b), np.float64)
+    make_chain, args = slope.solver_chain(a, b, solve_once)
     return slope.measure_slope(make_chain, args), x
 
 
-DEVICE_SPAN_GAUSS = ("tpu",)
+DEVICE_SPAN_GAUSS = ("tpu", "tpu-rowelim")
 DEVICE_SPAN_MATMUL = ("tpu", "tpu-pallas", "tpu-pallas-v1")
 
 
@@ -101,7 +110,8 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
         # chain runs no refinement — and is verified as-is. The
         # reference-span solve is skipped entirely; the device cell
         # verifies its own configuration.
-        seconds, x_dev = _gauss_device_cell(a, b, refine_steps=0)
+        seconds, x_dev = _gauss_device_cell(a, b, refine_steps=0,
+                                            backend=backend)
         res_dev = checks.residual_norm(a, x_dev, b)
         return Cell("gauss-internal", str(n), backend, seconds,
                     res_dev < RESIDUAL_BAR, res_dev,
@@ -131,7 +141,8 @@ def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
         # triangular solves, O(n^2) against the O(n^3) factor). The timed
         # chain includes those steps, and the cell verifies that exact
         # configuration — no reference-span solve runs.
-        seconds, x_dev = _gauss_device_cell(a, b, refine_steps=2)
+        seconds, x_dev = _gauss_device_cell(a, b, refine_steps=2,
+                                            backend=backend)
         err_dev = checks.max_rel_error(x_dev, x_true)
         return Cell("gauss-external", name, backend, seconds,
                     err_dev < RESIDUAL_BAR, err_dev,

@@ -109,12 +109,11 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
     return x
 
 
-def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto"
-                ) -> Tuple[Callable[[int], Callable], tuple]:
-    """Chain factory for the blocked gauss solve: each iteration is a full
-    factor+solve (+ refine_steps on-device f32 refinement iterations — each
-    one matvec + triangular solves, O(n^2) against the O(n^3) factor) of a
-    freshly perturbed system. Returns (make_chain, args)."""
+def solver_chain(a, b, solve_once: Callable
+                 ) -> Tuple[Callable[[int], Callable], tuple]:
+    """Chain factory for ANY jittable gauss solver ``solve_once(a, b) -> x``:
+    each iteration solves a freshly perturbed system. Returns
+    (make_chain, args)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -127,7 +126,7 @@ def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto"
             # remote compilation at large n (HTTP 413 at n=8192, 268 MB).
             def body(_, x):
                 a_i = a_ + x[0] * jnp.asarray(PERTURB, a_.dtype)
-                return gauss_solve_once(a_i, b_, panel, refine_steps, unroll)
+                return solve_once(a_i, b_)
 
             x = lax.fori_loop(0, k, body, x0)
             return jnp.sum(x)  # scalar fetch: completion without bandwidth
@@ -135,6 +134,18 @@ def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto"
         return run
 
     return make_chain, (a, b, b)
+
+
+def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto"
+                ) -> Tuple[Callable[[int], Callable], tuple]:
+    """Chain factory for the blocked gauss solve (+ refine_steps on-device
+    f32 refinement iterations — each one matvec + triangular solves, O(n^2)
+    against the O(n^3) factor). Returns (make_chain, args)."""
+
+    def solve_once(a_, b_):
+        return gauss_solve_once(a_, b_, panel, refine_steps, unroll)
+
+    return solver_chain(a, b, solve_once)
 
 
 def matmul_chain(a, b, mm: Callable) -> Tuple[Callable[[int], Callable], tuple]:

@@ -201,3 +201,12 @@ def test_grid_thread_sweep_prep_failure_keys_consistent():
     assert ("bogus_matrix @2t", "seq") in labels
     assert ("bogus_matrix", "tpu") in labels
     assert len(labels) == 3 and not any(c.verified for c in cells)
+
+
+def test_grid_device_span_rowelim():
+    """BASELINE config 2's engine (Pallas per-step row elimination) gets
+    slope-timed device cells, verified on the exact timed configuration."""
+    cells = grid.run_suite("gauss-internal", [32], ["tpu-rowelim"],
+                           span="device")
+    assert cells[0].span == "device"
+    assert cells[0].verified and cells[0].seconds > 0

@@ -189,3 +189,18 @@ def test_matmul_cli_precision_flag(capsys):
     assert rc == 0 and out.count("verify: OK") == 2
     rc = matmul.main(["64", "--engines", "tpu-pallas", "--precision", "high"])
     assert rc == 0
+
+
+def test_gauss_external_tpu_dist_backend(tmp_path, capsys):
+    """External flavor through the distributed engine (8 virtual devices)."""
+    import numpy as np
+
+    from gauss_tpu.io import datfile
+
+    f = tmp_path / "m.dat"
+    rng = np.random.default_rng(5)
+    datfile.write_dat(f, rng.standard_normal((48, 48)) + 8 * np.eye(48))
+    rc = gauss_external.main([str(f), "8", "--backend", "tpu-dist"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Time:" in out and "Error:" in out
