@@ -38,7 +38,7 @@ from gauss_tpu.utils.timing import timed_fetch
 
 GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-dist",
                   "tpu-dist2d", "seq", "omp", "threads", "forkjoin", "tiled")
-MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "seq", "omp")
+MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "tpu-dist", "seq", "omp")
 
 
 def _stage(*arrays):

@@ -46,6 +46,12 @@ def _tpu_engine_fn(engine: str, precision: str = None):
     """
     from functools import partial as _partial
 
+    if engine == "tpu-dist":
+        from gauss_tpu.dist.matmul_dist import matmul_dist
+
+        if precision is None:
+            return matmul_dist
+        return _partial(matmul_dist, precision=precision)
     if engine in ("tpu-pallas", "tpu-pallas-v1"):
         if engine == "tpu-pallas":
             from gauss_tpu.kernels.matmul_pallas import matmul_pallas as mm
@@ -88,7 +94,7 @@ def main(argv=None) -> int:
     p.add_argument("nsize", nargs="?", type=int, default=DEFAULT_N)
     p.add_argument("--engines", default="tpu,seq,omp",
                    help="comma-separated subset of: tpu, tpu-pallas, "
-                        "tpu-pallas-v1, seq, omp")
+                        "tpu-pallas-v1, tpu-dist, seq, omp")
     p.add_argument("-t", "--threads", type=int, default=0,
                    help="threads for the omp engine (default: all)")
     p.add_argument("--precision", choices=("highest", "high", "default"),
@@ -113,6 +119,7 @@ def main(argv=None) -> int:
     scale = float(np.abs(truth).max())
     labels = {"tpu": "TPU", "tpu-pallas": "TPU-Pallas",
               "tpu-pallas-v1": "TPU-Pallas-V1",
+              "tpu-dist": "TPU-Dist (sharded)",
               "seq": "Sequential", "omp": "OpenMP"}
 
     failed = False

@@ -27,10 +27,11 @@ def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
     """C = A @ B with operands sharded over the mesh."""
     if mesh is None:
         mesh = make_mesh()
+    from gauss_tpu.core.matmul import resolve_precision
+
     a = jnp.asarray(a)
     b = jnp.asarray(b, dtype=a.dtype)
-    prec = (lax.Precision.HIGHEST if precision == "highest"
-            else lax.Precision.DEFAULT)
+    prec = resolve_precision(precision)
 
     if mesh.devices.ndim == 1:
         axis = mesh.axis_names[0]

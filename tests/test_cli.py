@@ -204,3 +204,10 @@ def test_gauss_external_tpu_dist_backend(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "Time:" in out and "Error:" in out
+
+
+def test_matmul_cli_tpu_dist_engine(capsys):
+    """The pjit-sharded matmul as a CLI engine over the 8-device test mesh."""
+    rc = matmul.main(["96", "--engines", "tpu-dist"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "TPU-Dist (sharded) time:" in out and "verify: OK" in out
