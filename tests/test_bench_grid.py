@@ -211,3 +211,12 @@ def test_grid_device_span_rowelim():
                            span="device")
     assert cells[0].span == "device"
     assert cells[0].verified and cells[0].seconds > 0
+
+
+def test_grid_device_span_ineligible_engine_notice(capsys):
+    """--span device on an engine with no device-span implementation keeps
+    the reference span and says so on stderr (never silently mixes spans)."""
+    cells = grid.run_suite("gauss-external", ["matrix_10"], ["tpu-rowelim"],
+                           span="device")
+    assert cells[0].span == "reference"
+    assert "no device-span implementation" in capsys.readouterr().err
