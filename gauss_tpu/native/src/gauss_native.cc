@@ -24,6 +24,10 @@
 #include <cstdlib>
 #include <cstring>
 #include <thread>
+#ifdef __linux__
+#include <pthread.h>
+#include <sched.h>
+#endif
 #include <vector>
 
 #ifdef _OPENMP
@@ -179,6 +183,22 @@ int gt_gauss_solve_tiled(double* A, double* b, double* x, long n, int nthreads) 
   return 0;
 }
 
+// CPU-affinity pinning for the persistent-pool engine, mirroring the
+// reference C3's pthread_attr_setaffinity_np path: pin thread t to core t
+// only when the pool fits the machine (Version-3/gauss_internal_input.c:
+// 238,278-279,297-301). Linux-only; a no-op elsewhere.
+static void pin_to_core(std::thread& th, int core, int nthreads) {
+#ifdef __linux__
+  if (nthreads > (int)std::thread::hardware_concurrency()) return;
+  cpu_set_t set;
+  CPU_ZERO(&set);
+  CPU_SET(core % std::max(1u, std::thread::hardware_concurrency()), &set);
+  pthread_setaffinity_np(th.native_handle(), sizeof(set), &set);
+#else
+  (void)th; (void)core; (void)nthreads;
+#endif
+}
+
 int gt_gauss_solve_threads(double* A, double* b, double* x, long n, int nthreads) {
   if (!A || !b || !x || n <= 0) return -2;
   if (nthreads < 1) nthreads = 1;
@@ -202,7 +222,10 @@ int gt_gauss_solve_threads(double* A, double* b, double* x, long n, int nthreads
 
   std::vector<std::thread> pool;
   pool.reserve(nthreads);
-  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker, t);
+  for (int t = 0; t < nthreads; ++t) {
+    pool.emplace_back(worker, t);
+    pin_to_core(pool.back(), t, nthreads);
+  }
   for (auto& th : pool) th.join();
   if (singular.load()) return -1;
   back_substitute(A, b, x, n);
