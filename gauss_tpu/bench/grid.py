@@ -100,9 +100,9 @@ DEVICE_SPAN_GAUSS_EXTERNAL = ("tpu",)
 DEVICE_SPAN_MATMUL = ("tpu", "tpu-pallas", "tpu-pallas-v1")
 
 
-def _no_device_span_notice(suite, key, backend):
-    print(f"bench-grid: {suite}/{key}/{backend} has no device-span "
-          f"implementation; cell keeps the reference span", file=sys.stderr)
+def _no_device_span_notice(suite, key, backend, reason):
+    print(f"bench-grid: {suite}/{key}/{backend}: {reason}; cell keeps the "
+          f"reference span", file=sys.stderr)
 
 
 def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
@@ -114,7 +114,8 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
     a, b, init_s = ctx
     if (span == "device" and backend.startswith("tpu")
             and backend not in DEVICE_SPAN_GAUSS):
-        _no_device_span_notice("gauss-internal", n, backend)
+        _no_device_span_notice("gauss-internal", n, backend,
+                               "no device-span implementation")
     if span == "device" and backend in DEVICE_SPAN_GAUSS:
         # The internal system solves exactly in one f32 factor+solve
         # (measured residual 0.0 at every reference size), so the timed
@@ -148,7 +149,11 @@ def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
     a, b, x_true = ctx
     if (span == "device" and backend.startswith("tpu")
             and backend not in DEVICE_SPAN_GAUSS_EXTERNAL):
-        _no_device_span_notice("gauss-external", name, backend)
+        _no_device_span_notice(
+            "gauss-external", name, backend,
+            "no device span for this suite" + (
+                " (no refinement path, cannot meet the 1e-4 bar)"
+                if backend in DEVICE_SPAN_GAUSS else ""))
     if span == "device" and backend in DEVICE_SPAN_GAUSS_EXTERNAL:
         # External datasets need on-device f32 refinement to meet the 1e-4
         # bar (2 steps covers the whole registry; each is one matvec +
@@ -201,7 +206,8 @@ def _run_matmul(ctx, n: int, backend: str, nthreads: int,
     diff = float(np.max(np.abs(c - truth))) / scale
     if (span == "device" and backend.startswith("tpu")
             and backend not in DEVICE_SPAN_MATMUL):
-        _no_device_span_notice("matmul", n, backend)
+        _no_device_span_notice("matmul", n, backend,
+                               "no device-span implementation")
     if span == "device" and backend in DEVICE_SPAN_MATMUL:
         return Cell("matmul", str(n), backend,
                     _matmul_device_seconds(a, b, backend),

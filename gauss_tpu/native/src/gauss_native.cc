@@ -189,10 +189,20 @@ int gt_gauss_solve_tiled(double* A, double* b, double* x, long n, int nthreads) 
 // 238,278-279,297-301). Linux-only; a no-op elsewhere.
 static void pin_to_core(std::thread& th, int core, int nthreads) {
 #ifdef __linux__
-  if (nthreads > (int)std::thread::hardware_concurrency()) return;
+  // Respect the PROCESS affinity mask (taskset/cgroup cpusets), not raw
+  // hardware_concurrency: pin thread t to the t-th ALLOWED core, and only
+  // when the whole pool fits the allowed set — a partial pinning under a
+  // restricted mask would skew measurements asymmetrically.
+  cpu_set_t allowed;
+  CPU_ZERO(&allowed);
+  if (sched_getaffinity(0, sizeof(allowed), &allowed) != 0) return;
+  std::vector<int> cores;
+  for (int c = 0; c < CPU_SETSIZE; ++c)
+    if (CPU_ISSET(c, &allowed)) cores.push_back(c);
+  if (cores.empty() || nthreads > (int)cores.size()) return;
   cpu_set_t set;
   CPU_ZERO(&set);
-  CPU_SET(core % std::max(1u, std::thread::hardware_concurrency()), &set);
+  CPU_SET(cores[core % cores.size()], &set);
   pthread_setaffinity_np(th.native_handle(), sizeof(set), &set);
 #else
   (void)th; (void)core; (void)nthreads;

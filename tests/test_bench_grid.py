@@ -219,4 +219,4 @@ def test_grid_device_span_ineligible_engine_notice(capsys):
     cells = grid.run_suite("gauss-external", ["matrix_10"], ["tpu-rowelim"],
                            span="device")
     assert cells[0].span == "reference"
-    assert "no device-span implementation" in capsys.readouterr().err
+    assert "no device span for this suite" in capsys.readouterr().err
