@@ -382,18 +382,27 @@ def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
     MXU ops instead of an O(n)-step scalar-recurrence chain (measured
     0.42 -> ~0.1 ms at n=2048 on v5e). Falls back to
     lax.linalg.triangular_solve when inverses are absent (only
-    hand-constructed BlockedLU values — both factor paths store them)."""
+    hand-constructed BlockedLU values — both factor paths store them).
+
+    ``b`` may be a single right-hand side (n,) or a block of them (n, k) —
+    one factorization serves many solves (the getrf/getrs split the
+    reference's monolithic programs lack); every dot below is already
+    GEMM-shaped, so the k axis rides along for free."""
     m, perm = factors.m, factors.perm
     npad = m.shape[0]
     b = jnp.asarray(b, dtype=m.dtype)
-    n = b.shape[0]
-    bp = jnp.zeros((npad,), dtype=m.dtype).at[:n].set(b)[perm]
+    was_vector = b.ndim == 1
+    b2 = b[:, None] if was_vector else b
+    if b2.ndim != 2:
+        raise ValueError(f"b must be (n,) or (n, k), got {b.shape}")
+    n, k = b2.shape
+    bp = jnp.zeros((npad, k), dtype=m.dtype).at[:n].set(b2)[perm]
     if factors.linv is None:
         y = lax.linalg.triangular_solve(
-            m, bp[:, None], left_side=True, lower=True, unit_diagonal=True)
+            m, bp, left_side=True, lower=True, unit_diagonal=True)
         x = lax.linalg.triangular_solve(
             m, y, left_side=True, lower=False, unit_diagonal=False)
-        return x[:n, 0]
+        return x[:n, 0] if was_vector else x[:n]
 
     nb, panel, _ = factors.linv.shape
     prec = lax.Precision.HIGHEST
@@ -416,7 +425,8 @@ def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
             r = r - jnp.dot(m[i * panel:(i + 1) * panel, (i + 1) * panel:],
                             x_next, precision=prec)
         xblocks[i] = jnp.dot(factors.uinv[i], r, precision=prec)
-    return jnp.concatenate(xblocks)[:n]
+    x = jnp.concatenate(xblocks)[:n]
+    return x[:, 0] if was_vector else x
 
 
 def _resolve_unroll(unroll) -> bool:
