@@ -74,33 +74,22 @@ def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
     return delta / (k_large - k_small)
 
 
-# Above this size the trace-time-unrolled factorization is not chained: a
-# K=16 chain of 32+ unrolled panel programs exceeds the compile-payload
-# limit of tunneled dev chips (HTTP 413 observed at n=8192), and compile
-# time grows with nb either way. The fori_loop formulation trades ~2x
-# masked GEMM FLOPs for one compiled body — the right trade at this scale.
-UNROLL_MAX_N = 4096
-
-
-def _resolve_chain_unroll(n: int, unroll) -> bool:
-    return n <= UNROLL_MAX_N if unroll == "auto" else bool(unroll)
-
-
 def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
                      unroll="auto"):
     """One iteration of exactly the configuration :func:`gauss_chain` times:
     blocked f32 factor + solve (+ optional on-device f32 refinement steps).
     Exposed so callers can VERIFY the very computation the slope measures —
     a timed cell whose verification ran on a different configuration would
-    be meaningless."""
+    be meaningless. The factorization policy (core.blocked.resolve_factor)
+    keeps chain compile payloads bounded: a K=16 chain of 32+ fully unrolled
+    panel programs exceeded the tunneled remote-compile limit (HTTP 413 at
+    n=8192); the chunked form caps traced programs per group."""
     import jax.numpy as jnp
     from jax import lax
 
     from gauss_tpu.core import blocked
 
-    factor = (blocked.lu_factor_blocked_unrolled
-              if _resolve_chain_unroll(a.shape[0], unroll)
-              else blocked.lu_factor_blocked)
+    factor = blocked.resolve_factor(a.shape[0], unroll)
     fac = factor(a, panel=panel)
     x = blocked.lu_solve(fac, b)
     for _ in range(refine_steps):

@@ -105,9 +105,9 @@ def main(argv=None) -> int:
         import jax
 
         if jax.process_index() == 0:
-            from gauss_tpu.core.blocked import lu_factor_blocked_unrolled
+            from gauss_tpu.core.blocked import resolve_factor
 
-            fac = lu_factor_blocked_unrolled(
+            fac = resolve_factor(n, "auto")(
                 np.asarray(a, np.float32), panel=args.panel)
             perm = np.asarray(fac.perm)[:n]
             moved = int((perm != np.arange(n)).sum())

@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 
 DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
+CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16)
 
 
 class BlockedLU(NamedTuple):
@@ -181,6 +182,62 @@ def _resolve_panel_impl(panel_impl):
     return panel_impl
 
 
+def _factor_panel(sub, kb, h: int, panel: int, panel_impl: str):
+    """Slice and factor the (h, panel) column block of ``sub`` whose diagonal
+    sits at row offset ``kb``. Returns (p, ipiv, perm_local_or_None, mp).
+    Single source for every blocked-factorization loop."""
+    p = lax.dynamic_slice(sub, (0, kb), (h, panel))
+    if panel_impl == "pallas":
+        from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+
+        p, ipiv, perm_local, mp = panel_factor_pallas(p, kb)
+        return p, ipiv, perm_local, mp
+    p, ipiv, mp = _panel_factor_jax(p, kb)
+    return p, ipiv, None, mp
+
+
+def _fold_transpositions(ipiv, kb, h: int, panel: int):
+    """Fold a jax-panel transposition sequence into one gather permutation."""
+    def fold(j, pl):
+        x, y = pl[kb + j], pl[ipiv[j]]
+        return pl.at[kb + j].set(y).at[ipiv[j]].set(x)
+
+    return lax.fori_loop(0, panel, fold, jnp.arange(h))
+
+
+def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype):
+    """Install the factored panel at column kb of the (row-permuted) ``sub``,
+    compute the diagonal-block inverses, apply U12 = L11^-1 A12, and the
+    masked trailing GEMM. Returns (sub, linv_k, uinv_k). Shared by the
+    fori_loop and chunked factorizations — they must stay in numerical
+    lockstep."""
+    rows = jnp.arange(h)
+    cols = jnp.arange(h)
+    sub = lax.dynamic_update_slice(sub, p, (0, kb))
+
+    # Diagonal-block inverses (TRTRI+GEMM): U12 and lu_solve become GEMMs
+    # instead of substitution chains.
+    d = lax.dynamic_slice(sub, (kb, kb), (panel, panel))
+    linv_k, uinv_k = _diag_block_invs(d, panel, dtype)
+
+    # Block row of U: U12 = L11^-1 A12, masked so finished columns
+    # (multipliers left of the panel, the panel itself) stay untouched.
+    block_row = lax.dynamic_slice(sub, (kb, 0), (panel, h))
+    solved = jnp.dot(linv_k, block_row, precision=gemm_prec)
+    right = cols >= kb + panel
+    block_row = jnp.where(right[None, :], solved, block_row)
+    sub = lax.dynamic_update_slice(sub, block_row, (kb, 0))
+
+    # Trailing GEMM on the MXU: A22 -= L21 @ U12, masked operands — the
+    # finished region multiplies by zero and stays bit-identical.
+    l21 = jnp.where((rows >= kb + panel)[:, None],
+                    lax.dynamic_slice(sub, (0, kb), (h, panel)),
+                    jnp.zeros((), dtype))
+    u12 = jnp.where(right[None, :], block_row, jnp.zeros((), dtype))
+    sub = sub - jnp.dot(l21, u12, precision=gemm_prec)
+    return sub, linv_k, uinv_k
+
+
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision",
                                    "swap_impl"))
 def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
@@ -222,14 +279,8 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
     def outer(k, carry):
         m, perm, min_piv, linvs, uinvs = carry
         kb = k * panel
-        p = lax.dynamic_slice(m, (0, kb), (npad, panel))
-        perm_local = None
-        if panel_impl == "pallas":
-            from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
-
-            p, ipiv, perm_local, mp = panel_factor_pallas(p, kb)
-        else:
-            p, ipiv, mp = _panel_factor_jax(p, kb)
+        p, ipiv, perm_local, mp = _factor_panel(m, kb, npad, panel,
+                                                panel_impl)
         min_piv = jnp.minimum(min_piv, mp)
 
         # Apply the panel's pivot permutation to the rest of the matrix. Two
@@ -255,39 +306,14 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
             m, perm = lax.fori_loop(0, panel, swapj, (m, perm))
         else:
             if perm_local is None:
-                def fold(j, pl):
-                    x, y = pl[kb + j], pl[ipiv[j]]
-                    return pl.at[kb + j].set(y).at[ipiv[j]].set(x)
-
-                perm_local = lax.fori_loop(0, panel, fold, jnp.arange(npad))
+                perm_local = _fold_transpositions(ipiv, kb, npad, panel)
             m = m[perm_local]
             perm = perm[perm_local]
-        m = lax.dynamic_update_slice(m, p, (0, kb))
 
-        # Diagonal-block inverses (TRTRI+GEMM scheme, same as the unrolled
-        # path): U12 and lu_solve become GEMMs instead of substitution
-        # chains.
-        d = lax.dynamic_slice(m, (kb, kb), (panel, panel))
-        linv_k, uinv_k = _diag_block_invs(d, panel, dtype)
+        m, linv_k, uinv_k = _install_and_update(m, kb, npad, panel, p,
+                                                gemm_prec, dtype)
         linvs = lax.dynamic_update_slice(linvs, linv_k[None], (k, 0, 0))
         uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (k, 0, 0))
-
-        # Block row of U: U12 = L11^{-1} A12, masked so finished columns
-        # (multipliers left of the panel, the panel itself) stay untouched.
-        block_row = lax.dynamic_slice(m, (kb, 0), (panel, npad))
-        solved = jnp.dot(linv_k, block_row, precision=gemm_prec)
-        right = cols >= kb + panel
-        block_row = jnp.where(right[None, :], solved, block_row)
-        m = lax.dynamic_update_slice(m, block_row, (kb, 0))
-
-        # Trailing GEMM on the MXU: A22 -= L21 @ U12. Full-size matmul with
-        # masked operands — rows above the trailing block and columns left of
-        # it multiply by zero, so the finished region is bit-identical.
-        l21 = jnp.where((rows >= kb + panel)[:, None],
-                        lax.dynamic_slice(m, (0, kb), (npad, panel)),
-                        jnp.zeros((), dtype))
-        u12 = jnp.where(right[None, :], block_row, jnp.zeros((), dtype))
-        m = m - jnp.dot(l21, u12, precision=gemm_prec)
         return m, perm, min_piv, linvs, uinvs
 
     m, perm, min_piv, linvs, uinvs = lax.fori_loop(
@@ -429,15 +455,112 @@ def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
     return x[:, 0] if was_vector else x
 
 
-def _resolve_unroll(unroll) -> bool:
+@partial(jax.jit, static_argnames=("panel", "chunk", "panel_impl",
+                                   "gemm_precision"))
+def lu_factor_blocked_chunked(a: jax.Array, panel: int = DEFAULT_PANEL,
+                              chunk: int = CHUNK_DEFAULT,
+                              panel_impl: str = "auto",
+                              gemm_precision: str = "highest") -> BlockedLU:
+    """Blocked LU with the panel loop unrolled in GROUPS of ``chunk`` panels.
+
+    The middle point between :func:`lu_factor_blocked` (one fori_loop, flat
+    compile time, but full-size masked work every panel) and
+    :func:`lu_factor_blocked_unrolled` (true triangular work, but one traced
+    program per panel — compile payload grows with n/panel and breaks
+    tunneled remote compilation around n=8192). Groups are unrolled at trace
+    time with STATIC shrinking bounds; panels within a group run under one
+    fori_loop over the group's (gh, gh) trailing submatrix. Work is
+    triangular at group granularity (overhead ~ (1 + panel*chunk/n)x), and
+    the compile payload scales with n/(panel*chunk), not n/panel.
+
+    The group's left L-multiplier columns are realigned ONCE per group after
+    its local permutations compose — per-panel realignment measured slower
+    (gathers are per-op latency-bound), per-group is chunk x fewer ops.
+    """
+    from gauss_tpu.core.matmul import resolve_precision
+
+    panel_impl = _resolve_panel_impl(panel_impl)
+    gemm_prec = resolve_precision(gemm_precision)
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    m = _pad_to_panel(a, panel)
+    npad = m.shape[0]
+    nb = npad // panel
+    dtype = m.dtype
+    perm = jnp.arange(npad)
+    min_piv = jnp.asarray(jnp.inf, dtype)
+    linvs_all, uinvs_all = [], []
+
+    for g0 in range(0, nb, chunk):
+        gs = g0 * panel              # group start row/col (static)
+        gh = npad - gs               # static trailing size
+        gpanels = min(chunk, nb - g0)
+        sub = m[gs:, gs:]            # (gh, gh) trailing submatrix
+
+        def body(j, carry, gh=gh):
+            sub, gperm, min_piv, linvs, uinvs = carry
+            kb = j * panel           # panel offset WITHIN the group
+            p, ipiv, perm_local, mp = _factor_panel(sub, kb, gh, panel,
+                                                    panel_impl)
+            if perm_local is None:
+                perm_local = _fold_transpositions(ipiv, kb, gh, panel)
+            min_piv = jnp.minimum(min_piv, mp)
+            sub = sub[perm_local]
+            gperm = gperm[perm_local]
+
+            sub, linv_k, uinv_k = _install_and_update(sub, kb, gh, panel, p,
+                                                      gemm_prec, dtype)
+            linvs = lax.dynamic_update_slice(linvs, linv_k[None], (j, 0, 0))
+            uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (j, 0, 0))
+            return sub, gperm, min_piv, linvs, uinvs
+
+        gperm0 = jnp.arange(gh)
+        linvs0 = jnp.zeros((gpanels, panel, panel), dtype)
+        uinvs0 = jnp.zeros((gpanels, panel, panel), dtype)
+        sub, gperm, min_piv, linvs, uinvs = lax.fori_loop(
+            0, gpanels, body, (sub, gperm0, min_piv, linvs0, uinvs0))
+
+        # One fix-up per group: realign the left L-multiplier columns
+        # (written by earlier groups) with this group's composed permutation.
+        if gs:
+            left = m[gs:, :gs][gperm]
+            m = m.at[gs:, :gs].set(left)
+        m = m.at[gs:, gs:].set(sub)
+        perm = perm.at[gs:].set(perm[gs:][gperm])
+        linvs_all.append(linvs)
+        uinvs_all.append(uinvs)
+
+    return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
+                     linv=jnp.concatenate(linvs_all),
+                     uinv=jnp.concatenate(uinvs_all))
+
+
+UNROLL_MAX_N = 4096  # above this, full unroll costs too much compile payload
+
+
+def resolve_factor(n: int, unroll):
+    """The factorization for (size, unroll policy): "auto" picks fully
+    unrolled on TPU up to UNROLL_MAX_N (true triangular work; measured
+    6.1 -> 3.9 ms at n=2048 on v5e), group-chunked above it (triangular at
+    group granularity, bounded compile payload; 121 -> 59 ms at n=8192),
+    and the flat fori_loop on CPU (compile time matters more than FLOPs
+    there). True/False force unrolled/fori; "chunked" forces the middle."""
     if unroll == "auto":
-        # Unrolling trades nb extra traced GEMM shapes for the true
-        # triangular FLOP count (measured 6.1 -> 3.9 ms at n=2048 on v5e);
-        # on the CPU test platform compile time matters more than FLOPs.
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() != "tpu":
+            return lu_factor_blocked
+        if n > UNROLL_MAX_N:
+            return lu_factor_blocked_chunked
+        return lu_factor_blocked_unrolled
+    if unroll == "chunked":
+        return lu_factor_blocked_chunked
     if isinstance(unroll, str):
-        raise ValueError(f"unknown unroll {unroll!r}; options: (True, False, 'auto')")
-    return bool(unroll)
+        raise ValueError(f"unknown unroll {unroll!r}; options: "
+                         "(True, False, 'auto', 'chunked')")
+    return lu_factor_blocked_unrolled if unroll else lu_factor_blocked
 
 
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "unroll"))
@@ -445,8 +568,7 @@ def gauss_solve_blocked(a: jax.Array, b: jax.Array, panel: int = DEFAULT_PANEL,
                         panel_impl: str = "auto",
                         unroll: bool | str = "auto") -> jax.Array:
     """Factor + solve in one jitted program (the fast single-chip solver)."""
-    factor = (lu_factor_blocked_unrolled if _resolve_unroll(unroll)
-              else lu_factor_blocked)
+    factor = resolve_factor(a.shape[0], unroll)
     return lu_solve(factor(a, panel=panel, panel_impl=panel_impl), b)
 
 
@@ -484,8 +606,7 @@ def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
         a_dev = jnp.asarray(a64, dtype=dtype)
     if b_dev is None:
         b_dev = jnp.asarray(b64, dtype=dtype)
-    factor = (lu_factor_blocked_unrolled if _resolve_unroll(unroll)
-              else lu_factor_blocked)
+    factor = resolve_factor(len(b64), unroll)
     fac = factor(a_dev, panel=panel, panel_impl=panel_impl)
     x = np.asarray(lu_solve(fac, b_dev), dtype=np.float64)
     tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0

@@ -224,3 +224,44 @@ def test_gauss_solve_blocked_vmap(rng):
     for i in range(nb):
         ref = np.linalg.solve(a[i].astype(np.float64), b[i].astype(np.float64))
         np.testing.assert_allclose(xs[i], ref, rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 3, 8])
+@pytest.mark.parametrize("panel_impl", ["jax", "pallas"])
+def test_chunked_matches_unrolled(rng, chunk, panel_impl):
+    """Group-chunked factorization: same solve as the other formulations,
+    stored inverses present, for aligned and ragged group counts, on BOTH
+    panel implementations (pallas in interpret mode is the production
+    TPU path: resolve_factor auto at n > UNROLL_MAX_N)."""
+    from gauss_tpu.core.blocked import lu_factor_blocked_chunked
+
+    n = 150  # pads to 5 panels of 32; chunk=2/3 exercise ragged groups
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    fac = lu_factor_blocked_chunked(a, panel=32, chunk=chunk,
+                                    panel_impl=panel_impl)
+    assert fac.linv.shape == (5, 32, 32)
+    x = np.asarray(lu_solve(fac, b), np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_resolve_factor_policy():
+    from gauss_tpu.core import blocked
+
+    # CPU backend (the test platform): auto is the flat fori_loop.
+    assert blocked.resolve_factor(2048, "auto") is blocked.lu_factor_blocked
+    assert blocked.resolve_factor(64, True) is blocked.lu_factor_blocked_unrolled
+    assert blocked.resolve_factor(64, False) is blocked.lu_factor_blocked
+    assert (blocked.resolve_factor(64, "chunked")
+            is blocked.lu_factor_blocked_chunked)
+    with pytest.raises(ValueError, match="unroll"):
+        blocked.resolve_factor(64, "bogus")
+
+
+def test_chunked_rejects_bad_chunk():
+    from gauss_tpu.core.blocked import lu_factor_blocked_chunked
+
+    with pytest.raises(ValueError, match="chunk"):
+        lu_factor_blocked_chunked(np.eye(8, dtype=np.float32), panel=8,
+                                  chunk=0)
