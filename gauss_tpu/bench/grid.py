@@ -73,7 +73,7 @@ def _gauss_device_cell(a64, b64, refine_steps: int, backend: str = "tpu"):
     import jax.numpy as jnp
 
     from gauss_tpu.bench import slope
-    from gauss_tpu.core.blocked import DEFAULT_PANEL
+    from gauss_tpu.core.blocked import auto_panel
 
     a = jnp.asarray(a64, jnp.float32)
     b = jnp.asarray(b64, jnp.float32)
@@ -82,7 +82,7 @@ def _gauss_device_cell(a64, b64, refine_steps: int, backend: str = "tpu"):
 
         solve_once = gauss_solve_rowelim
     else:
-        panel = 256 if a.shape[0] >= 1024 else DEFAULT_PANEL
+        panel = auto_panel(a.shape[0])
 
         def solve_once(a_, b_):
             return slope.gauss_solve_once(a_, b_, panel, refine_steps)

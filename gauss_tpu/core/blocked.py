@@ -35,6 +35,26 @@ from jax import lax
 DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
 CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16)
 
+# The Pallas panel kernel holds one transposed (panel, npad) block in VMEM;
+# keep it under the ~16 MB budget with headroom for its per-step vectors
+# (observed OOM: 19.12 M requested at panel=256, npad=17920).
+PANEL_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def auto_panel(n: int, itemsize: int = 4) -> int:
+    """The widest panel in {256, 128, 64} whose kernel block fits VMEM.
+
+    256 wins on v5e for n >= 1024 (fewer XLA glue steps beat the extra VPU
+    work); narrower panels extend the reachable n (128 to ~28k, 64 to ~57k).
+    """
+    for panel in (256, 128, 64):
+        npad = -(-n // panel) * panel
+        if panel * npad * itemsize <= PANEL_VMEM_BUDGET:
+            return panel if n >= 1024 else min(panel, DEFAULT_PANEL)
+    raise ValueError(
+        f"n={n} exceeds the single-kernel panel budget even at panel=64; "
+        "shard the problem (dist engines) instead")
+
 
 class BlockedLU(NamedTuple):
     """P @ A = L @ U factorization state (padded to a panel multiple).

@@ -265,3 +265,18 @@ def test_chunked_rejects_bad_chunk():
     with pytest.raises(ValueError, match="chunk"):
         lu_factor_blocked_chunked(np.eye(8, dtype=np.float32), panel=8,
                                   chunk=0)
+
+
+def test_auto_panel_vmem_budget():
+    from gauss_tpu.core.blocked import PANEL_VMEM_BUDGET, auto_panel
+
+    assert auto_panel(2048) == 256
+    assert auto_panel(512) == 128          # below the 1024 crossover
+    assert auto_panel(17758) == 128        # 256 would blow the kernel VMEM
+    assert auto_panel(40000) == 64
+    with pytest.raises(ValueError, match="dist engines"):
+        auto_panel(60000)
+    for n in (100, 1024, 17758, 40000):
+        p = auto_panel(n)
+        npad = -(-n // p) * p
+        assert p * npad * 4 <= PANEL_VMEM_BUDGET
