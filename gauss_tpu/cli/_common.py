@@ -161,7 +161,7 @@ def _solve_native(a64, b64, backend, nthreads):
 
 def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
                        nthreads: int = 0, pivoting: str = "partial",
-                       refine_iters: int = 2, panel: int = 128,
+                       refine_iters: int = 2, panel: int | None = None,
                        refine_tol: float = 1e-5):
     """Dispatch a solve; returns (x_float64, elapsed_seconds).
 

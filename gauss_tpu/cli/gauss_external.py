@@ -37,7 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
                    help="stop refining once ||Ax-b|| <= TOL*min(1, ||b||); "
                         "0 always runs exactly --refine steps")
-    p.add_argument("--panel", type=int, default=128)
+    p.add_argument("--panel", type=int, default=None,
+                   help="panel width for the blocked tpu backend "
+                        "(default: auto — VMEM-aware)")
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="capture a jax.profiler device trace into DIR")
     p.add_argument("--debug", action="store_true",

@@ -59,8 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
                    help="stop refining once ||Ax-b|| <= TOL*min(1, ||b||); "
                         "0 always runs exactly --refine steps (default 1e-5)")
-    p.add_argument("--panel", type=int, default=128,
-                   help="panel width for the blocked tpu backend")
+    p.add_argument("--panel", type=int, default=None,
+                   help="panel width for the blocked tpu backend "
+                        "(default: auto — VMEM-aware)")
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="capture a jax.profiler device trace into DIR "
                         "(the gprof analog; view in TensorBoard/Perfetto)")

@@ -46,14 +46,21 @@ def auto_panel(n: int, itemsize: int = 4) -> int:
 
     256 wins on v5e for n >= 1024 (fewer XLA glue steps beat the extra VPU
     work); narrower panels extend the reachable n (128 to ~28k, 64 to ~57k).
+    Every factorization entry point resolves panel=None through this.
     """
+    if n < 1024:
+        return DEFAULT_PANEL  # crossover heuristic; VMEM is never binding
     for panel in (256, 128, 64):
         npad = -(-n // panel) * panel
         if panel * npad * itemsize <= PANEL_VMEM_BUDGET:
-            return panel if n >= 1024 else min(panel, DEFAULT_PANEL)
+            return panel
     raise ValueError(
         f"n={n} exceeds the single-kernel panel budget even at panel=64; "
         "shard the problem (dist engines) instead")
+
+
+def _resolve_panel(n: int, panel) -> int:
+    return auto_panel(n) if panel is None else panel
 
 
 class BlockedLU(NamedTuple):
@@ -260,7 +267,7 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype):
 
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision",
                                    "swap_impl"))
-def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
+def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
                       panel_impl: str = "auto",
                       gemm_precision: str = "highest",
                       swap_impl: str = "gather") -> BlockedLU:
@@ -289,6 +296,7 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
+    panel = _resolve_panel(n, panel)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
@@ -345,7 +353,8 @@ def lu_factor_blocked(a: jax.Array, panel: int = DEFAULT_PANEL,
 
 
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision"))
-def lu_factor_blocked_unrolled(a: jax.Array, panel: int = DEFAULT_PANEL,
+def lu_factor_blocked_unrolled(a: jax.Array,
+                               panel: int | None = DEFAULT_PANEL,
                                panel_impl: str = "auto",
                                gemm_precision: str = "highest") -> BlockedLU:
     """Blocked LU with the panel loop unrolled at trace time.
@@ -368,6 +377,7 @@ def lu_factor_blocked_unrolled(a: jax.Array, panel: int = DEFAULT_PANEL,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
+    panel = _resolve_panel(n, panel)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     dtype = m.dtype
@@ -477,7 +487,8 @@ def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
 
 @partial(jax.jit, static_argnames=("panel", "chunk", "panel_impl",
                                    "gemm_precision"))
-def lu_factor_blocked_chunked(a: jax.Array, panel: int = DEFAULT_PANEL,
+def lu_factor_blocked_chunked(a: jax.Array,
+                              panel: int | None = DEFAULT_PANEL,
                               chunk: int = CHUNK_DEFAULT,
                               panel_impl: str = "auto",
                               gemm_precision: str = "highest") -> BlockedLU:
@@ -507,6 +518,7 @@ def lu_factor_blocked_chunked(a: jax.Array, panel: int = DEFAULT_PANEL,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
+    panel = _resolve_panel(n, panel)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
@@ -584,7 +596,8 @@ def resolve_factor(n: int, unroll):
 
 
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "unroll"))
-def gauss_solve_blocked(a: jax.Array, b: jax.Array, panel: int = DEFAULT_PANEL,
+def gauss_solve_blocked(a: jax.Array, b: jax.Array,
+                        panel: int | None = None,
                         panel_impl: str = "auto",
                         unroll: bool | str = "auto") -> jax.Array:
     """Factor + solve in one jitted program (the fast single-chip solver)."""
@@ -592,7 +605,7 @@ def gauss_solve_blocked(a: jax.Array, b: jax.Array, panel: int = DEFAULT_PANEL,
     return lu_solve(factor(a, panel=panel, panel_impl=panel_impl), b)
 
 
-def solve_refined(a: np.ndarray, b: np.ndarray, panel: int = DEFAULT_PANEL,
+def solve_refined(a: np.ndarray, b: np.ndarray, panel: int | None = None,
                   iters: int = 2, dtype=jnp.float32, panel_impl: str = "auto",
                   a_dev: jax.Array | None = None,
                   b_dev: jax.Array | None = None,

@@ -271,6 +271,11 @@ def test_auto_panel_vmem_budget():
     from gauss_tpu.core.blocked import PANEL_VMEM_BUDGET, auto_panel
 
     assert auto_panel(2048) == 256
+    # panel=None resolves through auto_panel at every entry point
+    from gauss_tpu.core.blocked import lu_factor_blocked_unrolled
+
+    fac = lu_factor_blocked_unrolled(np.eye(64, dtype=np.float32), panel=None)
+    assert fac.linv.shape[1] == 128 or fac.m.shape[0] == 128
     assert auto_panel(512) == 128          # below the 1024 crossover
     assert auto_panel(17758) == 128        # 256 would blow the kernel VMEM
     assert auto_panel(40000) == 64
