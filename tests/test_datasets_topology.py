@@ -91,3 +91,31 @@ def test_meshfile_drives_dist_solve(tmp_path, rng):
     b = rng.standard_normal(n)
     x = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh))
     np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-9, atol=1e-9)
+
+
+def test_dataset_golden_checksums():
+    """The stand-in matrices are part of the framework's contract: coordinate
+    streams must be bitwise reproducible across runs, machines, and numpy
+    versions (golden CRCs pinned from the first release). A mismatch means
+    benchmark results stop being comparable across rounds."""
+    import zlib
+
+    import numpy as np
+
+    golden = {
+        "matrix_10": 0x478aae81,
+        "jpwh_991": 0xa671c8b9,
+        "orsreg_1": 0x6da9a493,
+        "sherman5": 0xb82e3b38,
+        "saylr4": 0x3023f777,
+        "sherman3": 0x209f7c59,
+        "memplus": 0x5dc57880,
+        "matrix_2000": 0x816c8578,
+    }
+    for name, want in golden.items():
+        n, r, c, v = datasets.dataset_coords(name)
+        crc = zlib.crc32(np.ascontiguousarray(r).tobytes())
+        crc = zlib.crc32(np.ascontiguousarray(c).tobytes(), crc)
+        crc = zlib.crc32(
+            np.ascontiguousarray(np.asarray(v, np.float64)).tobytes(), crc)
+        assert crc == want, f"{name}: dataset stream drifted (0x{crc:08x})"
