@@ -94,6 +94,27 @@ def test_scaling_exponent_cubic():
     assert p == pytest.approx(3.0, abs=0.01)
 
 
+def test_scaling_exponent_ignores_latency_floor():
+    """The fit uses the two largest sizes: a flat small-n latency floor must
+    not drag a cubic engine's exponent toward zero."""
+    cells = [{"suite": "s", "key": str(n), "backend": "b",
+              "seconds": max(1e-4, (n / 2048) ** 3 * 0.002), "verified": True,
+              "error": 0.0, "reference_s": None}
+             for n in (128, 256, 4096, 8192)]
+    p = report._scaling_exponent(cells, "b")
+    assert p == pytest.approx(3.0, abs=0.01)
+
+
+def test_reference_table_excludes_thread_sweep_rows():
+    cells = _cells() + [
+        {"suite": "gauss-internal", "key": "2048 @16t", "backend": "seq",
+         "seconds": 1.5, "verified": True, "error": 0.0,
+         "reference_s": 0.509428}]
+    text = report.compose_report(cells, "t", "hw")
+    ref_section = text.split("Comparison with the reference")[1].split("###")[0]
+    assert "@16t" not in ref_section
+
+
 def test_report_device_span_labeled_separately():
     cells = _cells() + [
         {"suite": "gauss-internal", "key": "2048", "backend": "tpu",
