@@ -127,18 +127,28 @@ def _speedup_table(cells: Sequence[dict], base_backend: str = "seq"
     return lines
 
 
+def _base_key(key: str) -> str:
+    """Thread-sweep labels '<n> @Tt' fold back to their base size."""
+    return str(key).split(" @")[0]
+
+
 def _reference_table(cells: Sequence[dict]) -> Optional[List[str]]:
     """Best verified engine per size vs the reference's best recorded time.
 
-    Thread-sweep rows ('<n> @Tt') are excluded: they exist to show the
-    thread axis of the native engines, and the bare size rows already carry
-    the best-vs-reference comparison for those sizes."""
-    keys, grid = _keys_in_order(cells), _grid(cells)
-    keys = [k for k in keys if "@" not in str(k)]
+    Thread-sweep rows ('<n> @Tt') fold into their base size so every
+    engine's best — including sweep-only native cells — competes in one
+    row per size."""
+    grid: Dict[str, List[dict]] = defaultdict(list)
+    keys: List[str] = []
+    for c in cells:
+        k = _base_key(c["key"])
+        if k not in keys:
+            keys.append(k)
+        grid[k].append(c)
     rows = []
     for k in keys:
-        verified = [c for c in grid[k].values() if c["verified"]]
-        with_ref = [c for c in grid[k].values()
+        verified = [c for c in grid[k] if c["verified"]]
+        with_ref = [c for c in grid[k]
                     if c.get("reference_s") is not None]
         if not verified or not with_ref:
             continue
@@ -157,17 +167,19 @@ def _scaling_exponent(cells: Sequence[dict], backend: str) -> Optional[float]:
     """Fitted exponent p of t ~ n^p across this backend's verified cells."""
     import math
 
-    pts = sorted((float(c["key"]), c["seconds"]) for c in cells
-                 if c["backend"] == backend and c["verified"]
-                 and str(c["key"]).isdigit() and c["seconds"] > 0)
-    if len(pts) < 2:
+    best: Dict[float, float] = {}
+    for c in cells:
+        if (c["backend"] == backend and c["verified"]
+                and str(c["key"]).isdigit() and c["seconds"] > 0):
+            nval = float(c["key"])
+            best[nval] = min(best.get(nval, float("inf")), c["seconds"])
+    if len(best) < 2:
         return None
-    # Fit over the two LARGEST sizes: small sizes sit on the dispatch/launch
+    # Fit over the two LARGEST distinct sizes (best time per size — merged
+    # cell files can repeat a size): small sizes sit on the dispatch/launch
     # latency floor and would drag the exponent toward 0 for engines that
     # are genuinely cubic at scale.
-    (n0, t0), (n1, t1) = pts[-2], pts[-1]
-    if n0 == n1:
-        return None
+    (n0, t0), (n1, t1) = sorted(best.items())[-2:]
     return math.log(t1 / t0) / math.log(n1 / n0)
 
 

@@ -59,8 +59,8 @@ def auto_panel(n: int, itemsize: int = 4) -> int:
         "shard the problem (dist engines) instead")
 
 
-def _resolve_panel(n: int, panel) -> int:
-    return auto_panel(n) if panel is None else panel
+def _resolve_panel(n: int, panel, itemsize: int = 4) -> int:
+    return auto_panel(n, itemsize) if panel is None else panel
 
 
 class BlockedLU(NamedTuple):
@@ -296,12 +296,10 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
-    panel = _resolve_panel(n, panel)
+    panel = _resolve_panel(n, panel, jnp.dtype(a.dtype).itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
-    rows = jnp.arange(npad)
-    cols = jnp.arange(npad)
     dtype = m.dtype
 
     def outer(k, carry):
@@ -377,7 +375,7 @@ def lu_factor_blocked_unrolled(a: jax.Array,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
-    panel = _resolve_panel(n, panel)
+    panel = _resolve_panel(n, panel, jnp.dtype(a.dtype).itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     dtype = m.dtype
@@ -518,7 +516,7 @@ def lu_factor_blocked_chunked(a: jax.Array,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
-    panel = _resolve_panel(n, panel)
+    panel = _resolve_panel(n, panel, jnp.dtype(a.dtype).itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel

@@ -247,10 +247,20 @@ def test_chunked_matches_unrolled(rng, chunk, panel_impl):
 
 
 def test_resolve_factor_policy():
+    import jax
+
     from gauss_tpu.core import blocked
 
-    # CPU backend (the test platform): auto is the flat fori_loop.
-    assert blocked.resolve_factor(2048, "auto") is blocked.lu_factor_blocked
+    if jax.default_backend() == "tpu":
+        # TPU: unrolled up to UNROLL_MAX_N, chunked above.
+        assert (blocked.resolve_factor(2048, "auto")
+                is blocked.lu_factor_blocked_unrolled)
+        assert (blocked.resolve_factor(8192, "auto")
+                is blocked.lu_factor_blocked_chunked)
+    else:
+        # CPU (the test platform): auto is the flat fori_loop.
+        assert (blocked.resolve_factor(2048, "auto")
+                is blocked.lu_factor_blocked)
     assert blocked.resolve_factor(64, True) is blocked.lu_factor_blocked_unrolled
     assert blocked.resolve_factor(64, False) is blocked.lu_factor_blocked
     assert (blocked.resolve_factor(64, "chunked")

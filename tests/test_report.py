@@ -139,3 +139,26 @@ def test_report_largest_key_ignores_thread_sweep_labels():
          "reference_s": None}]
     text = report.compose_report(cells, "t", "hw")
     assert "At the largest size (8192)" in text
+
+
+def test_reference_table_folds_sweep_rows_into_base_size():
+    """Sweep-only native cells must compete in their base-size row (not be
+    hidden), and repeated sizes from merged files must not break the fit."""
+    cells = [
+        {"suite": "gauss-internal", "key": "4096", "backend": "tpu",
+         "seconds": 0.5, "verified": True, "error": 0.0,
+         "reference_s": 2.0, "span": "device"},
+        {"suite": "gauss-internal", "key": "4096 @16t", "backend": "seq",
+         "seconds": 0.1, "verified": True, "error": 0.0, "reference_s": 2.0},
+    ]
+    text = report.compose_report(cells, "t", "hw")
+    ref_section = text.split("Comparison with the reference")[1].split("###")[0]
+    assert "0.100000 (seq)" in ref_section and "20.0x" in ref_section
+
+
+def test_scaling_exponent_tolerates_duplicate_sizes():
+    cells = [{"suite": "s", "key": k, "backend": "b", "seconds": s,
+              "verified": True, "error": 0.0, "reference_s": None}
+             for k, s in (("1024", 0.001), ("2048", 0.004), ("2048", 0.0041))]
+    p = report._scaling_exponent(cells, "b")
+    assert p == pytest.approx(2.0, abs=0.01)
