@@ -1,0 +1,25 @@
+"""Timing helper tests (utils/timing.py — the gettimeofday-span analog)."""
+
+import numpy as np
+
+from gauss_tpu.utils import timing
+
+
+def test_timed_returns_best_and_result():
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return np.asarray(x) * 2
+
+    best, result = timing.timed(fn, 21, warmup=2, reps=3)
+    assert result == 42
+    assert best >= 0.0
+    assert len(calls) == 5  # 2 warmups + 3 reps
+
+
+def test_timed_fetch_fetches_tree():
+    best, result = timing.timed_fetch(lambda: {"a": np.ones(3)}, warmup=0,
+                                      reps=2)
+    assert isinstance(result["a"], np.ndarray)
+    assert best >= 0.0
