@@ -1,0 +1,37 @@
+"""Cross-engine agreement: the reference's strongest testing idea (SURVEY.md
+§4.2-4.3 — identical results across all parallel versions) applied across
+EVERY gauss engine in this framework on one random system."""
+
+import numpy as np
+import pytest
+
+from gauss_tpu import native
+from gauss_tpu.cli import _common
+from gauss_tpu.verify import checks
+
+
+def test_all_gauss_engines_agree():
+    rng = np.random.default_rng(11)
+    n = 72
+    a = rng.standard_normal((n, n)) + n * np.eye(n)  # well-conditioned
+    x_true = rng.standard_normal(n)
+    b = a @ x_true
+
+    backends = ["tpu", "tpu-unblocked", "tpu-rowelim", "tpu-dist",
+                "tpu-dist2d"]
+    if native.available():
+        backends += ["seq", "omp", "threads", "forkjoin", "tiled"]
+
+    solutions = {}
+    for backend in backends:
+        x, _ = _common.solve_with_backend(a, b, backend, nthreads=4,
+                                          pivoting="partial")
+        solutions[backend] = np.asarray(x, np.float64)
+        err = checks.max_rel_error(solutions[backend], x_true)
+        assert err < 1e-3, (backend, err)
+
+    # Pairwise epsilon agreement vs the oracle engine (the reference's
+    # cross-version comparison, run across ten engines instead of eyeballs).
+    ref = solutions["tpu-unblocked"]
+    for backend, x in solutions.items():
+        assert checks.elementwise_match(x, ref, epsilon=1e-3), backend

@@ -70,3 +70,15 @@ def test_internal_equals_generator():
 def test_duplicate_coordinates_last_wins():
     dense = datfile.read_dat_dense(io.StringIO("2 2 2\n1 1 3\n1 1 9\n0 0 0\n"))
     assert dense[0, 0] == 9.0
+
+
+def test_read_dat_fscanf_whitespace_tolerance():
+    """The reference parses with fscanf, which accepts arbitrary inter-token
+    whitespace (spaces, tabs, blank lines); parity requires the same."""
+    from io import StringIO
+
+    text = ("  3   3\t9\n1 1 2.0\n  1\t2   4.0\n1 3 6.0\n2 1 1.0\n"
+            "2 2 5.0\n\n2 3 1.5\n3 1 7.0\n3 2 0.5\n3 3 9.0\n0 0 0\n")
+    n, r, c, v = datfile.read_dat(StringIO(text))
+    assert n == 3 and len(v) == 9
+    assert v[1] == 4.0 and (r[1], c[1]) == (0, 1)
