@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Reproduce reports/REPORT.md and graphs/ from scratch (run on the TPU host;
+# the full sweep takes ~20-30 min behind a tunneled dev chip).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m gauss_tpu.bench.grid --suite gauss-internal \
+    --backends tpu,tpu-unblocked,seq,omp,threads,forkjoin,tiled \
+    --json /tmp/gi.json
+python -m gauss_tpu.bench.grid --suite gauss-internal --backends tpu \
+    --span device --json /tmp/gid.json
+python -m gauss_tpu.bench.grid --suite gauss-external --backends tpu,seq,omp \
+    --json /tmp/ge.json
+python -m gauss_tpu.bench.grid --suite gauss-external --backends tpu \
+    --span device --json /tmp/ged.json
+python -m gauss_tpu.bench.grid --suite matmul \
+    --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp --json /tmp/mm.json
+python -m gauss_tpu.bench.grid --suite matmul \
+    --backends tpu,tpu-pallas,tpu-pallas-v1 --span device --json /tmp/mmd.json
+
+python -m gauss_tpu.bench.report /tmp/gi.json /tmp/gid.json /tmp/ge.json \
+    /tmp/ged.json /tmp/mm.json /tmp/mmd.json \
+    --title "gauss-tpu benchmark report" --out reports/REPORT.md --profile 1024
+python -m gauss_tpu.bench.plots /tmp/gi.json /tmp/gid.json /tmp/mmd.json \
+    --outdir graphs

@@ -1,0 +1,26 @@
+"""The examples must actually run (small sizes, CPU)."""
+
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args):
+    env = dict(os.environ)
+    return subprocess.run([sys.executable] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_library_quickstart_runs():
+    r = _run(["examples/library_quickstart.py", "64"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pattern ok: True" in r.stdout
+    assert "multi-RHS" in r.stdout
+
+
+def test_distributed_example_runs():
+    r = _run(["examples/distributed_solve.py", "64", "4"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pattern ok = True" in r.stdout
