@@ -15,10 +15,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))) 
 
 
 def main(n: int = 256, shards: int = 8) -> None:
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + f" --xla_force_host_platform_device_count={shards}").strip()
+    from gauss_tpu.utils.env import force_host_device_count
+
+    force_host_device_count(shards)
 
     import jax
     import numpy as np

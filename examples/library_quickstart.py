@@ -28,10 +28,15 @@ def main(n: int = 512) -> None:
 
     # 2. The external file flavor (reference *_external_input): write a .dat,
     #    read it back, solve against a manufactured solution X__[i] = i+1.
+    import tempfile
+
     rng = np.random.default_rng(0)
     m = rng.standard_normal((n, n)) + n * np.eye(n)
-    write_dat("/tmp/example.dat", m)
-    m2 = read_dat_dense("/tmp/example.dat")
+    with tempfile.NamedTemporaryFile(suffix=".dat", mode="w",
+                                     delete=False) as f:
+        write_dat(f, m)
+    m2 = read_dat_dense(f.name)
+    os.unlink(f.name)
     x_true = manufactured_solution(n)
     r = manufactured_rhs(m2, x_true)
     x2, _ = solve_refined(m2, r)

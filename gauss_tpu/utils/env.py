@@ -1,0 +1,26 @@
+"""Environment helpers usable BEFORE any jax import (no jax dependency)."""
+
+from __future__ import annotations
+
+import os
+
+FORCE_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_host_device_count(n: int) -> bool:
+    """Ensure XLA_FLAGS forces >= n virtual host devices.
+
+    Returns True if the flag was set (or already requested >= n); False if a
+    pre-existing flag requests FEWER devices — callers should surface that,
+    because the earlier value wins once the backend initializes. Must run
+    before the first jax backend initialization to have any effect.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if FORCE_FLAG in flags:
+        try:
+            current = int(flags.split(FORCE_FLAG + "=")[1].split()[0])
+        except (IndexError, ValueError):
+            return False
+        return current >= n
+    os.environ["XLA_FLAGS"] = (flags + f" --{FORCE_FLAG}={n}").strip()
+    return True

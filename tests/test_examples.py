@@ -8,8 +8,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(args):
-    env = dict(os.environ)
-    return subprocess.run([sys.executable] + args, cwd=REPO, env=env,
+    return subprocess.run([sys.executable] + args, cwd=REPO,
                           capture_output=True, text=True, timeout=300)
 
 

@@ -23,3 +23,17 @@ def test_timed_fetch_fetches_tree():
                                       reps=2)
     assert isinstance(result["a"], np.ndarray)
     assert best >= 0.0
+
+
+def test_force_host_device_count_flag_logic(monkeypatch):
+    from gauss_tpu.utils import env
+
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert env.force_host_device_count(8) is True
+    assert "--xla_force_host_platform_device_count=8" in \
+        __import__("os").environ["XLA_FLAGS"]
+    # existing larger request: fine; smaller: reported
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=16")
+    assert env.force_host_device_count(8) is True
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    assert env.force_host_device_count(8) is False
