@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gauss_tpu.dist.mesh import ROWS_AXIS, make_mesh
 
@@ -128,22 +128,78 @@ def _build_solver(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
     return jax.jit(mapped)
 
 
-def _prepare(a, b, nshards: int):
-    """Pad to a shard multiple (identity pad, as in core.blocked) and apply
-    the cyclic row permutation to both the matrix and the RHS."""
-    a = jnp.asarray(a)
+def _input_dtype(a) -> np.dtype:
+    """Canonical dtype of an array-like WITHOUT materializing it (respects
+    jax x64 mode)."""
+    dt = getattr(a, "dtype", None)
+    # np.result_type misreads nested lists as dtype specs; materialize only
+    # when there is no dtype attribute (plain lists/tuples — cheap, host-side).
+    dt = np.dtype(dt) if dt is not None else np.asarray(a).dtype
+    return np.dtype(jax.dtypes.canonicalize_dtype(dt))
+
+
+def _host_dtype(a) -> np.dtype:
+    """Canonical FLOAT dtype for staging a linear system (gauss divides by
+    pivots, so integer inputs are promoted to float32)."""
+    dt = _input_dtype(a)
+    if not np.issubdtype(dt, np.floating):
+        dt = np.dtype(jax.dtypes.canonicalize_dtype(np.float32))
+    return dt
+
+
+def _prepare(a, b, mesh: jax.sharding.Mesh):
+    """Pad to a shard multiple (identity pad, as in core.blocked), apply the
+    cyclic row permutation, and stage the shards DIRECTLY onto the mesh's
+    devices.
+
+    All preparation is host-side numpy followed by one explicit
+    ``device_put`` per operand with the mesh's NamedSharding — the default
+    jax backend is never touched, so a present-but-broken default platform
+    (e.g. a tunneled TPU client with a libtpu version mismatch) cannot poison
+    a CPU-mesh run. This mirrors the reference's staging model, where rank 0
+    holds host memory and ships shards out explicitly
+    (OpenMP_and_MPI/gauss_mpi/gauss_internal_input.c:149-155) — except here
+    the placement happens once, not per pivot step.
+    """
+    nshards = mesh.devices.shape[0]
+    axis = mesh.axis_names[0]
+    dtype = _host_dtype(a)
+    a = np.asarray(a, dtype)
+    b = np.asarray(b, dtype)
     n = a.shape[0]
-    b = jnp.asarray(b, dtype=a.dtype)
     npad = -(-n // nshards) * nshards
     if npad != n:
-        ap = jnp.zeros((npad, npad), a.dtype).at[:n, :n].set(a)
-        ap = ap.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(
-            jnp.asarray(1.0, a.dtype))
-        bp = jnp.zeros((npad,), a.dtype).at[:n].set(b)
+        ap = np.zeros((npad, npad), dtype)
+        ap[:n, :n] = a
+        ap[np.arange(n, npad), np.arange(n, npad)] = 1.0
+        bp = np.zeros((npad,), dtype)
+        bp[:n] = b
     else:
         ap, bp = a, b
     perm = _cyclic_perm(npad, nshards)
-    return ap[perm], bp[perm], npad
+    a_c = jax.device_put(ap[perm], NamedSharding(mesh, P(axis, None)))
+    b_c = jax.device_put(bp[perm], NamedSharding(mesh, P(axis)))
+    return a_c, b_c, npad
+
+
+def prepare_dist(a, b, mesh: jax.sharding.Mesh):
+    """Stage a system onto the mesh (pad + cyclic-permute + shard) and return
+    an opaque handle for :func:`solve_dist_staged`.
+
+    Splitting staging from solving lets callers time the solve alone — the
+    reference's external flavor likewise times computeGauss only, after
+    parse/init (gauss_external_input.c:300-302).
+    """
+    n = np.shape(a)[0]
+    a_c, b_c, npad = _prepare(a, b, mesh)
+    return (a_c, b_c, n, npad)
+
+
+def solve_dist_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
+    """Solve a system previously staged by :func:`prepare_dist`."""
+    a_c, b_c, n, npad = staged
+    solver = _build_solver(mesh, npad, str(a_c.dtype))
+    return solver(a_c, b_c)[:n]
 
 
 def gauss_solve_dist(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:
@@ -155,12 +211,7 @@ def gauss_solve_dist(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:
     """
     if mesh is None:
         mesh = make_mesh()
-    nshards = mesh.devices.shape[0]
-    a_c, b_c, npad = _prepare(a, b, nshards)
-    n = jnp.asarray(a).shape[0]
-    solver = _build_solver(mesh, npad, str(a_c.dtype))
-    x = solver(a_c, b_c)
-    return x[:n]
+    return solve_dist_staged(prepare_dist(a, b, mesh), mesh)
 
 
 def eliminate_dist(a, b, mesh: jax.sharding.Mesh = None):

@@ -41,7 +41,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from gauss_tpu.dist.gauss_dist import _cyclic_perm
+from jax.sharding import NamedSharding
+
+from gauss_tpu.dist.gauss_dist import _cyclic_perm, _host_dtype
 from gauss_tpu.dist.mesh import make_mesh_2d_auto
 
 
@@ -142,45 +144,63 @@ def _build_solver_2d(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
     return jax.jit(mapped)
 
 
-def _prepare_2d(a, b, R: int, C: int):
-    """Identity-pad to a multiple of lcm(R, C), then apply the cyclic
-    permutation to rows and columns so contiguous 2-D sharding yields the
-    cyclic layout. Returns (a_c, b_c, npad, col_perm)."""
-    a = jnp.asarray(a)
+def _prepare_2d(a, b, mesh: jax.sharding.Mesh):
+    """Identity-pad to a multiple of lcm(R, C), apply the cyclic permutation
+    to rows and columns, and stage the tiles DIRECTLY onto the mesh's devices
+    (host-side numpy prep + one explicit device_put per operand; the default
+    jax backend is never touched — see gauss_dist._prepare).
+    Returns (a_c, b_c, npad, col_perm)."""
+    R, C = mesh.devices.shape
+    rax, cax = mesh.axis_names
+    dtype = _host_dtype(a)
+    a = np.asarray(a, dtype)
+    b = np.asarray(b, dtype)
     n = a.shape[0]
-    b = jnp.asarray(b, dtype=a.dtype)
     blk = math.lcm(R, C)
     npad = -(-n // blk) * blk
     if npad != n:
-        ap = jnp.zeros((npad, npad), a.dtype).at[:n, :n].set(a)
-        ap = ap.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(
-            jnp.asarray(1.0, a.dtype))
-        bp = jnp.zeros((npad,), a.dtype).at[:n].set(b)
+        ap = np.zeros((npad, npad), dtype)
+        ap[:n, :n] = a
+        ap[np.arange(n, npad), np.arange(n, npad)] = 1.0
+        bp = np.zeros((npad,), dtype)
+        bp[:n] = b
     else:
         ap, bp = a, b
     rperm = _cyclic_perm(npad, R)
     cperm = _cyclic_perm(npad, C)
-    return ap[rperm][:, cperm], bp[rperm], npad, cperm
+    a_c = jax.device_put(ap[rperm][:, cperm], NamedSharding(mesh, P(rax, cax)))
+    b_c = jax.device_put(bp[rperm], NamedSharding(mesh, P(rax)))
+    return a_c, b_c, npad, cperm
+
+
+def prepare_dist2d(a, b, mesh: jax.sharding.Mesh):
+    """Stage a system onto a 2-D mesh; handle for :func:`solve_dist2d_staged`
+    (same staging/solve split rationale as gauss_dist.prepare_dist)."""
+    if mesh.devices.ndim != 2:
+        raise ValueError(f"gauss_solve_dist2d needs a 2-D mesh; got shape "
+                         f"{mesh.devices.shape} (use gauss_solve_dist for 1-D)")
+    n = np.shape(a)[0]
+    a_c, b_c, npad, cperm = _prepare_2d(a, b, mesh)
+    return (a_c, b_c, n, npad, cperm)
+
+
+def solve_dist2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
+    """Solve a system previously staged by :func:`prepare_dist2d`."""
+    a_c, b_c, n, npad, cperm = staged
+    solver = _build_solver_2d(mesh, npad, str(a_c.dtype))
+    x_cyc = solver(a_c, b_c)
+    # x_cyc[k] = x[cperm[k]]; undo (gather runs on the mesh's backend).
+    inv = np.empty(npad, dtype=np.int64)
+    inv[cperm] = np.arange(npad)
+    return x_cyc[inv][:n]
 
 
 def gauss_solve_dist2d(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:
     """Distributed dense solve over a 2-D mesh; returns x in natural order.
 
     The solver's output is column-cyclic-ordered (it comes back sharded along
-    the mesh's cols axis); the inverse permutation is applied here on host.
+    the mesh's cols axis); the inverse permutation is undone before returning.
     """
     if mesh is None:
         mesh = make_mesh_2d_auto()
-    if mesh.devices.ndim != 2:
-        raise ValueError(f"gauss_solve_dist2d needs a 2-D mesh; got shape "
-                         f"{mesh.devices.shape} (use gauss_solve_dist for 1-D)")
-    R, C = mesh.devices.shape
-    a = jnp.asarray(a)
-    n = a.shape[0]
-    a_c, b_c, npad, cperm = _prepare_2d(a, b, R, C)
-    solver = _build_solver_2d(mesh, npad, str(a_c.dtype))
-    x_cyc = solver(a_c, b_c)
-    # x_cyc[k] = x[cperm[k]]; undo on host.
-    inv = np.empty(npad, dtype=np.int64)
-    inv[cperm] = np.arange(npad)
-    return x_cyc[inv][:n]
+    return solve_dist2d_staged(prepare_dist2d(a, b, mesh), mesh)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -28,18 +29,41 @@ def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
     if mesh is None:
         mesh = make_mesh()
     from gauss_tpu.core.matmul import resolve_precision
+    from gauss_tpu.dist.gauss_dist import _input_dtype
 
-    a = jnp.asarray(a)
-    b = jnp.asarray(b, dtype=a.dtype)
+    # Host-side prep + explicit device_put below: the default backend is
+    # never touched (see gauss_tpu.dist.gauss_dist._prepare for why).
+    # Unlike gauss, matmul keeps the input dtype (integer products stay exact).
+    dtype = _input_dtype(a)
+    a = np.asarray(a, dtype)
+    b = np.asarray(b, dtype)
+    vec_rhs = b.ndim == 1  # matrix-vector: lift to (k, 1), squeeze at the end
+    if vec_rhs:
+        b = b[:, None]
     prec = resolve_precision(precision)
+    m, n = a.shape[0], b.shape[1]
+
+    def _pad(x, mult0, mult1):
+        """Zero-pad each dim up to the next multiple (sharding divisibility)."""
+        p0 = -(-x.shape[0] // mult0) * mult0
+        p1 = -(-x.shape[1] // mult1) * mult1
+        if (p0, p1) == x.shape:
+            return x
+        xp = np.zeros((p0, p1), x.dtype)
+        xp[: x.shape[0], : x.shape[1]] = x
+        return xp
 
     if mesh.devices.ndim == 1:
         axis = mesh.axis_names[0]
+        (nrows,) = mesh.devices.shape
+        a, b = _pad(a, nrows, 1), b
         in_shardings = (NamedSharding(mesh, P(axis, None)),
                         NamedSharding(mesh, P()))
         out_spec = P() if replicate_out else P(axis, None)
     else:
         r, c = mesh.axis_names
+        R, C = mesh.devices.shape
+        a, b = _pad(a, R, 1), _pad(b, 1, C)
         in_shardings = (NamedSharding(mesh, P(r, None)),
                         NamedSharding(mesh, P(None, c)))
         out_spec = P() if replicate_out else P(r, c)
@@ -51,4 +75,9 @@ def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
 
     a = jax.device_put(a, in_shardings[0])
     b = jax.device_put(b, in_shardings[1])
-    return run(a, b)
+    out = run(a, b)
+    if out.shape != (m, n):
+        out = out[:m, :n]
+    if vec_rhs:
+        out = out[:, 0]
+    return out

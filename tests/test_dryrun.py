@@ -1,0 +1,113 @@
+"""Regression tests for the driver's multi-chip dryrun (VERDICT round 1 #1).
+
+Round-1 failure mode: the driver's independent 8-device dryrun crashed with a
+libtpu client/terminal version mismatch because ``jnp.asarray`` in the dist
+engines staged operands through the *default* backend (the tunneled TPU) even
+though the mesh was CPU-only. The fix stages all dist operands host-side and
+``device_put``s them directly onto the mesh's devices, and the dryrun pins the
+default device to the fallback platform.
+
+The poisoned test emulates a present-but-broken non-CPU default backend by
+monkeypatching jax's batched_device_put to raise whenever staging targets a
+non-CPU device — the exact failure shape of MULTICHIP_r01.json — and asserts
+the dryrun still completes on the virtual CPU mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_POISON_SCRIPT = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+
+import jax  # noqa: F401  (initialize config before poisoning)
+from jax._src.interpreters import pxla
+
+_orig = pxla.batched_device_put
+_calls = [0]
+
+
+def _poisoned(aval, sharding, xs, devices, *a, **k):
+    _calls[0] += 1
+    bad = [d for d in devices if getattr(d, "platform", "cpu") != "cpu"]
+    if bad:
+        raise RuntimeError(
+            "poisoned: staging to non-cpu default backend %%r" %% (bad[:1],))
+    return _orig(aval, sharding, xs, devices, *a, **k)
+
+
+pxla.batched_device_put = _poisoned
+
+import __graft_entry__
+
+__graft_entry__.dryrun_multichip(8)
+# Prove the hook is live on the staging path (on CPU-only hosts the poison
+# cannot fire, but staging must still have flowed through it).
+assert _calls[0] > 0, "poison hook never saw a device_put"
+print("POISON-DRYRUN-OK")
+"""
+
+
+@pytest.mark.slow
+def test_dryrun_survives_poisoned_default_backend():
+    """dryrun_multichip(8) must succeed even when every non-CPU device_put
+    raises — i.e. a broken default TPU client cannot poison a CPU-mesh run."""
+    env = dict(os.environ)
+    # Mimic the driver environment: do NOT pin the platform; whatever default
+    # the image's sitecustomize selects (possibly a tunneled TPU) must be
+    # irrelevant to the outcome.
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("GAUSS_TPU_TEST_PLATFORM", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _POISON_SCRIPT % {"repo": REPO}],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"dryrun died under poisoned default backend:\n{proc.stderr[-4000:]}")
+    assert "POISON-DRYRUN-OK" in proc.stdout
+
+
+def test_dist_operands_committed_to_mesh_devices():
+    """_prepare must return arrays committed to the mesh's devices with the
+    row-sharded NamedSharding — never uncommitted default-device arrays."""
+    import jax
+    import numpy as np
+
+    from gauss_tpu.dist import make_mesh
+    from gauss_tpu.dist.gauss_dist import _prepare
+    from gauss_tpu.io import synthetic
+
+    mesh = make_mesh(4)
+    n = 12
+    a = synthetic.internal_matrix(n, dtype=np.float32)
+    b = synthetic.internal_rhs(n, dtype=np.float32)
+    a_c, b_c, npad = _prepare(a, b, mesh)
+    assert npad % 4 == 0
+    P = jax.sharding.PartitionSpec
+    for arr, spec in ((a_c, P("rows", None)), (b_c, P("rows"))):
+        sh = arr.sharding
+        assert isinstance(sh, jax.sharding.NamedSharding)
+        assert sh.mesh.devices.tolist() == mesh.devices.tolist()
+        assert sh.spec == spec
+        assert arr.committed
+
+
+def test_prepare_2d_committed_to_mesh_devices():
+    import jax
+    import numpy as np
+
+    from gauss_tpu.dist.gauss_dist2d import _prepare_2d
+    from gauss_tpu.dist.mesh import make_mesh_2d
+    from gauss_tpu.io import synthetic
+
+    mesh = make_mesh_2d(2, 2)
+    n = 10
+    a = synthetic.internal_matrix(n, dtype=np.float32)
+    b = synthetic.internal_rhs(n, dtype=np.float32)
+    a_c, b_c, npad, cperm = _prepare_2d(a, b, mesh)
+    assert a_c.committed and b_c.committed
+    assert a_c.sharding.mesh.devices.tolist() == mesh.devices.tolist()
