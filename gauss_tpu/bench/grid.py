@@ -23,7 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, replace
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -46,6 +46,7 @@ class Cell:
     error: float      # residual (internal) / max rel error (external) / max abs diff (matmul)
     reference_s: Optional[float]
     span: str = "reference"   # "reference" parity span or "device" slope span
+    note: str = ""            # provenance, e.g. external dataset source
 
     @property
     def speedup(self) -> Optional[float]:
@@ -139,14 +140,20 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
 def _prep_gauss_external(name: str):
     from gauss_tpu.io import datasets
 
-    a = datasets.dataset_dense(name)
+    # The REAL reference matrix when a checkout is present — the reference's
+    # external tables (BASELINE.md) are defined on these exact files, so only
+    # then is the vs-reference column apples-to-apples. Falls back to the
+    # deterministic stand-in elsewhere; every cell records which one ran.
+    source = datasets.resolve_source(name, "auto")
+    a = datasets.dataset_dense(name, source=source)
     x_true = np.arange(1, a.shape[0] + 1, dtype=np.float64)  # X__[i] = i+1
-    return a, a @ x_true, x_true                             # R = A . X__
+    return a, a @ x_true, x_true, source                     # R = A . X__
 
 
 def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
                         span: str = "reference") -> Cell:
-    a, b, x_true = ctx
+    a, b, x_true, source = ctx
+    note = f"source={source}"
     if (span == "device" and backend.startswith("tpu")
             and backend not in DEVICE_SPAN_GAUSS_EXTERNAL):
         _no_device_span_notice(
@@ -166,12 +173,14 @@ def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
         return Cell("gauss-external", name, backend, seconds,
                     err_dev < RESIDUAL_BAR, err_dev,
                     baselines.reference_seconds("gauss-external", name,
-                                                backend), span="device")
+                                                backend), span="device",
+                    note=note)
     x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads)
     err = checks.max_rel_error(x, x_true)
     return Cell("gauss-external", name, backend, elapsed,
                 err < RESIDUAL_BAR, err,
-                baselines.reference_seconds("gauss-external", name, backend))
+                baselines.reference_seconds("gauss-external", name, backend),
+                note=note)
 
 
 def _prep_matmul(n: int):
@@ -225,6 +234,40 @@ _SUITE_FNS = {
     "matmul": (_prep_matmul, _run_matmul),
 }
 
+# Which backends actually get the device slope span per suite — used both to
+# run cells and to label FAILED cells, so a failed device-span cell renders
+# in the marked [device-span] column, never the unmarked reference column.
+_DEVICE_ELIGIBLE = {
+    "gauss-internal": DEVICE_SPAN_GAUSS,
+    "gauss-external": DEVICE_SPAN_GAUSS_EXTERNAL,
+    "matmul": DEVICE_SPAN_MATMUL,
+}
+
+
+def _cell_span(suite: str, backend: str, span: str) -> str:
+    return ("device" if span == "device"
+            and backend in _DEVICE_ELIGIBLE[suite] else "reference")
+
+
+def _ctx_note(suite: str, ctx) -> str:
+    """Provenance note carried by every cell of a prepared key — including
+    cells whose run() later fails (the source is known the moment prep
+    succeeds)."""
+    return f"source={ctx[3]}" if suite == "gauss-external" else ""
+
+
+def _sweep_skip(backend: str, t, sweep) -> bool:
+    """Device engines have no thread axis (the mesh, not a thread pool, is
+    their parallelism): in a thread sweep they run once, at the first entry."""
+    return t is not None and backend.startswith("tpu") and t != sweep[0]
+
+
+def _sweep_label(key, backend: str, t) -> str:
+    """Cell key within a thread sweep; device engines keep the bare size so
+    scaling fits and tables stay honest."""
+    return (str(key) if t is None or backend.startswith("tpu")
+            else f"{key} @{t}t")
+
 
 def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
               nthreads: int = 0, span: str = "reference",
@@ -258,26 +301,20 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                   file=sys.stderr)
             for t in sweep:
                 for backend in backends:
-                    if t is not None and backend.startswith("tpu")                             and t != sweep[0]:
+                    if _sweep_skip(backend, t, sweep):
                         continue
-                    label = (str(key) if t is None
-                             or backend.startswith("tpu") else f"{key} @{t}t")
-                    cells.append(Cell(suite, label, backend, 0.0, False,
-                                      float("nan"),
+                    cells.append(Cell(suite, _sweep_label(key, backend, t),
+                                      backend, 0.0, False, float("nan"),
                                       baselines.reference_seconds(
-                                          suite, key, backend)))
+                                          suite, key, backend),
+                                      span=_cell_span(suite, backend, span)))
             continue
         for t in sweep:
             run_t = nthreads if t is None else t
             for backend in backends:
-                # Device engines have no thread axis: swept once, and keyed
-                # by the bare size so scaling fits and tables stay honest.
-                if t is not None and backend.startswith("tpu"):
-                    if t != sweep[0]:
-                        continue
-                    key_label = str(key)
-                else:
-                    key_label = str(key) if t is None else f"{key} @{t}t"
+                if _sweep_skip(backend, t, sweep):
+                    continue
+                key_label = _sweep_label(key, backend, t)
                 # Progress to stderr per cell: sweeps run for minutes behind
                 # slow device dispatch, and a silent hang is
                 # indistinguishable from work without this.
@@ -291,15 +328,15 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                     cell = Cell(suite, str(key), backend, 0.0, False,
                                 float("nan"),
                                 baselines.reference_seconds(suite, key,
-                                                            backend))
+                                                            backend),
+                                span=_cell_span(suite, backend, span),
+                                note=_ctx_note(suite, ctx))
                 else:
                     print(f"bench-grid: {suite}/{key_label}/{backend} -> "
                           f"{cell.seconds:.6f}s verified={cell.verified}",
                           file=sys.stderr, flush=True)
                 if cell.key != key_label:
-                    cell = Cell(cell.suite, key_label, cell.backend,
-                                cell.seconds, cell.verified, cell.error,
-                                cell.reference_s, cell.span)
+                    cell = replace(cell, key=key_label)
                 cells.append(cell)
     return cells
 
@@ -340,6 +377,14 @@ def format_table(cells: List[Cell]) -> str:
                         s += f" ({c.speedup:.1f}xR)"
                     row.append(s)
             out.append("| " + " | ".join(row) + " |")
+        notes = {c.key: c.note for c in suite_cells if c.note}
+        if notes:
+            vals = set(notes.values())
+            if len(vals) == 1:
+                out.append(f"\nAll rows: {vals.pop()}.")
+            else:
+                out.append("\n" + "; ".join(f"{k}: {v}"
+                                            for k, v in notes.items()) + ".")
         out.append("")
     return "\n".join(out)
 

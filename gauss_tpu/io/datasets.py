@@ -14,6 +14,12 @@ grid — runs against the same shapes and sparsity budgets.
 Stand-ins are strictly diagonally dominant (diag = 1 + sum |row off-diag|),
 hence nonsingular and well-conditioned, with entries from a name-seeded
 PCG64 stream — bitwise reproducible across runs and machines.
+
+When a reference checkout is present (see :mod:`gauss_tpu.io.reference_data`),
+:func:`dataset_dense` can read the REAL matrices in place instead
+(``source="reference"`` or ``"auto"``) — the real Harwell-Boeing conditioning,
+not the deliberately easy stand-ins, is what the external benchmark grid and
+golden tests exercise on this machine.
 """
 
 from __future__ import annotations
@@ -88,9 +94,47 @@ def dataset_coords(name: str):
     return n, all_rows[order], all_cols[order], all_vals[order]
 
 
-def dataset_dense(name: str, dtype=np.float64) -> np.ndarray:
+def resolve_source(name: str, source: str = "standin") -> str:
+    """Resolve a requested dataset source to the one that will be used.
+
+    "standin"   — the deterministic regenerated matrix (always available).
+    "reference" — the real reference .dat file, read in place (raises if the
+                  reference checkout or the file is absent).
+    "auto"      — "reference" when the real file exists, else "standin".
+    """
+    if source not in ("standin", "reference", "auto"):
+        raise ValueError(f"unknown source {source!r}; options: "
+                         "('standin', 'reference', 'auto')")
+    if name not in REGISTRY:
+        raise KeyError(f"unknown dataset {name!r}; options: {sorted(REGISTRY)}")
+    if source == "standin":
+        return "standin"
+    from gauss_tpu.io import reference_data
+
+    if reference_data.find_dat(name) is not None:
+        return "reference"
+    if source == "reference":
+        detail = (f"checkout at {reference_data.reference_root()} does not "
+                  f"ship {name}.dat" if reference_data.available() else
+                  f"no reference checkout under "
+                  f"{reference_data.reference_root()} "
+                  f"(set ${reference_data.ROOT_ENV})")
+        raise KeyError(f"real reference matrix {name!r} not available: {detail}")
+    return "standin"
+
+
+def dataset_dense(name: str, dtype=np.float64,
+                  source: str = "standin") -> np.ndarray:
     """Densified registry matrix (memplus at f64 is ~2.5 GB — mind the RAM,
-    exactly as with the reference's external-input programs)."""
+    exactly as with the reference's external-input programs).
+
+    ``source``: see :func:`resolve_source`; "standin" (the default) keeps
+    results bitwise reproducible on machines without a reference checkout.
+    """
+    if resolve_source(name, source) == "reference":
+        from gauss_tpu.io import reference_data
+
+        return reference_data.load_dense(name, dtype=dtype)
     n, rows, cols, vals = dataset_coords(name)
     return datfile.densify(n, rows, cols, vals, dtype=dtype)
 

@@ -1,0 +1,148 @@
+"""Golden tests on the REAL reference dataset matrices, read in place.
+
+VERDICT round 1 #2: the reference's entire external-input evaluation runs on
+actual Harwell-Boeing matrices; round 1 only exercised same-shape synthetic
+stand-ins. These tests parse the real files from the read-only reference
+checkout (never copied into the repo) and assert that every solver meets the
+external programs' always-on oracle (max relative error vs the manufactured
+solution X__[i] = i+1; reference gauss_external_input.c:304-315) at the
+BASELINE.json 1e-4 bar on the real conditioning, not the deliberately easy
+stand-ins.
+
+On machines without a reference checkout the whole module skips.
+"""
+
+import numpy as np
+import pytest
+
+from gauss_tpu.io import datasets, reference_data
+from gauss_tpu.verify import checks
+
+pytestmark = pytest.mark.skipif(
+    not reference_data.available(),
+    reason="no reference checkout (set GAUSS_TPU_REFERENCE_ROOT)")
+
+BAR = 1e-4  # BASELINE.json / reference EPSILON acceptance bar
+
+
+def _system(name, dtype=np.float64):
+    a = reference_data.load_dense(name, dtype=dtype)
+    x_true = np.arange(1, a.shape[0] + 1, dtype=np.float64)
+    return a, a @ x_true, x_true
+
+
+def test_all_seven_real_files_found():
+    for name in reference_data.REAL_NAMES:
+        path = reference_data.find_dat(name)
+        assert path is not None, name
+        assert path.startswith(str(reference_data.reference_root()))
+
+
+def test_real_headers_match_registry():
+    """The registry's (n, nnz) rows were transcribed from the real headers;
+    parse each real file's header and confirm (guards both directions)."""
+    for name in reference_data.REAL_NAMES:
+        with open(reference_data.find_dat(name)) as f:
+            n, n2, nnz = (int(t) for t in f.readline().split()[:3])
+        assert (n, nnz) == datasets.REGISTRY[name], name
+        assert n == n2
+
+
+def test_dataset_dense_source_resolution():
+    assert datasets.resolve_source("jpwh_991", "auto") == "reference"
+    assert datasets.resolve_source("jpwh_991", "standin") == "standin"
+    # matrix_2000 is stripped from the mirror: auto falls back to stand-in.
+    assert datasets.resolve_source("matrix_2000", "auto") == "standin"
+    with pytest.raises(KeyError):
+        datasets.resolve_source("matrix_2000", "reference")
+    with pytest.raises(ValueError):
+        datasets.resolve_source("jpwh_991", "bogus")
+    a_ref = datasets.dataset_dense("matrix_10", source="reference")
+    a_std = datasets.dataset_dense("matrix_10", source="standin")
+    # matrix_10 is the generator family in both worlds: identical content.
+    np.testing.assert_array_equal(a_ref, a_std)
+
+
+def test_real_matrix_10_is_generator_output():
+    """matrix_10.dat is matrix_gen output: value = row<col ? 2*row : 2*col
+    with 1-indexed loop variables (matrix_gen.cc:15-19), i.e.
+    a[i, j] = 2 * (min(i, j) + 1) in 0-indexed terms."""
+    a = reference_data.load_dense("matrix_10")
+    n = a.shape[0]
+    i, j = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    np.testing.assert_array_equal(a, 2.0 * (np.minimum(i, j) + 1))
+
+
+@pytest.mark.parametrize("name", ["matrix_10", "jpwh_991"])
+def test_oracle_solve_real_matrix(name):
+    """The pure-JAX oracle (f64 on CPU) reproduces the manufactured solution
+    on the real matrices — the reference's sequential-program bar."""
+    from gauss_tpu.core.gauss import gauss_solve
+
+    a, b, x_true = _system(name)
+    x = np.asarray(gauss_solve(a, b, pivoting="partial"), np.float64)
+    assert checks.max_rel_error(x, x_true) < BAR
+
+
+@pytest.mark.parametrize("name", ["matrix_10", "jpwh_991", "orsreg_1"])
+def test_refined_solve_real_matrix(name):
+    """f32 blocked factorization + refinement meets the 1e-4 bar on real
+    conditioning (the round-1 stand-ins could not test this)."""
+    from gauss_tpu.core.blocked import solve_refined
+
+    a, b, x_true = _system(name)
+    x, _ = solve_refined(a, b, iters=3)
+    assert checks.max_rel_error(x, x_true) < BAR
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["sherman5", "saylr4", "sherman3"])
+def test_refined_solve_real_matrix_large(name):
+    from gauss_tpu.core.blocked import solve_refined
+
+    a, b, x_true = _system(name)
+    x, _ = solve_refined(a, b, iters=5, tol=1e-6)
+    assert checks.max_rel_error(x, x_true) < BAR
+
+
+@pytest.mark.slow
+def test_dist_engines_real_matrix():
+    """The distributed engines on the 8-virtual-device mesh solve a real
+    matrix to the same bar (round 1 ran them only on synthetics)."""
+    from gauss_tpu.dist import gauss_dist, gauss_dist2d, make_mesh
+    from gauss_tpu.dist.mesh import make_mesh_2d
+
+    a, b, x_true = _system("jpwh_991")
+    x = np.asarray(gauss_dist.gauss_solve_dist(
+        a.astype(np.float64), b.astype(np.float64), mesh=make_mesh(8)))
+    assert checks.max_rel_error(x, x_true) < BAR
+    x2 = np.asarray(gauss_dist2d.gauss_solve_dist2d(
+        a.astype(np.float64), b.astype(np.float64), mesh=make_mesh_2d(4, 2)))
+    assert checks.max_rel_error(x2, x_true) < BAR
+
+
+@pytest.mark.slow
+def test_cross_engine_agreement_real_matrix():
+    """SURVEY §4.2's bar on a real matrix: every engine reproduces the
+    external oracle at 1e-4, and all engines agree pairwise on normalized
+    solutions within 2x that bar — the triangle-inequality implication of
+    the per-engine oracle bar, which holds across precision families (f32
+    device engines vs f64 native engines follow different rounding paths,
+    so exact agreement is only guaranteed vs the shared truth)."""
+    from gauss_tpu import native
+    from gauss_tpu.cli import _common
+
+    a, b, x_true = _system("jpwh_991")
+    backends = ["tpu", "tpu-unblocked", "tpu-dist", "tpu-dist2d"]
+    if native.available():
+        backends += ["seq", "omp", "threads", "forkjoin", "tiled"]
+    sols = {}
+    for backend in backends:
+        x, _ = _common.solve_with_backend(a, b, backend, nthreads=4)
+        sols[backend] = np.asarray(x, np.float64)
+        assert checks.max_rel_error(sols[backend], x_true) < BAR, backend
+    ref = sols["tpu-unblocked"]
+    scale = float(np.abs(ref).max())
+    for backend, x in sols.items():
+        assert checks.elementwise_match(x / scale, ref / scale,
+                                        epsilon=2 * BAR), backend
