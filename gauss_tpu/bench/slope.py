@@ -98,6 +98,51 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
     return x
 
 
+def gauss_solve_once_ds(a, at_ds, b_ds, panel: int, refine_steps: int,
+                        unroll="auto"):
+    """One f32 factor + solve + double-single on-device refinement — the
+    external-suite device-span configuration (VERDICT round 1 #3: the f32
+    refinement floor failed memplus; double-single residuals clear the 1e-4
+    bar fully on device). Thin timing-chain wrapper over the single
+    assembly point, core.dsfloat.solve_once_ds."""
+    from gauss_tpu.core import dsfloat
+
+    x, _ = dsfloat.solve_once_ds(a, at_ds, b_ds, panel, iters=refine_steps,
+                                 unroll=unroll)
+    return x
+
+
+def ds_solver_chain(a, at_ds, b_ds, panel: int, refine_steps: int,
+                    unroll="auto") -> Tuple[Callable[[int], Callable], tuple]:
+    """Chain factory for the ds-refined solve. The factor operand is
+    perturbed per iteration (defeats CSE); the residual operands stay fixed,
+    so every iteration converges to the same (verified) solution — the
+    correction operator tolerates a 1e-6-perturbed factorization exactly the
+    way refinement tolerates its f32 rounding."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gauss_tpu.core.dsfloat import DS
+
+    def make_chain(k: int):
+        @jax.jit
+        def run(a_, at_hi, at_lo, b_hi, b_lo, x0):
+            def body(_, xc):
+                a_i = a_ + xc[0] * jnp.asarray(PERTURB, a_.dtype)
+                x = gauss_solve_once_ds(a_i, DS(at_hi, at_lo),
+                                        DS(b_hi, b_lo), panel, refine_steps,
+                                        unroll)
+                return x.hi + x.lo
+
+            x = lax.fori_loop(0, k, body, x0)
+            return jnp.sum(x)
+
+        return run
+
+    return make_chain, (a, at_ds.hi, at_ds.lo, b_ds.hi, b_ds.lo, b_ds.hi)
+
+
 def solver_chain(a, b, solve_once: Callable
                  ) -> Tuple[Callable[[int], Callable], tuple]:
     """Chain factory for ANY jittable gauss solver ``solve_once(a, b) -> x``:
