@@ -65,6 +65,7 @@ BACKEND_CLASS: Dict[str, str] = {
     "tiled": "pthreads-v2",
     "tpu-dist": "mpi",
     "tpu-dist2d": "mpi",
+    "tpu-dist-blocked": "mpi",
     "tpu": "openmp",
     "tpu-unblocked": "seq",
     "tpu-rowelim": "openmp",

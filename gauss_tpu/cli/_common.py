@@ -9,10 +9,14 @@ Backend taxonomy (maps the reference's 12-binary grid onto one flag):
     tpu-rowelim   per-pivot-step Pallas row-elimination kernel (the
                   BASELINE.json north-star kernel; subtractElim analog)
     tpu-dist      row-cyclic shard_map over the device mesh (reference MPI
-                  gauss_mpi analog); -t selects the shard count
+                  gauss_mpi analog, per-pivot-step protocol); -t selects the
+                  shard count
     tpu-dist2d    2-D block-cyclic shard_map (ScaLAPACK layout; BASELINE
                   config 5); -t selects the total device count, factored
                   into the squarest R x C grid
+    tpu-dist-blocked  panel-blocked distributed factorization (collectives
+                  per panel, local MXU trailing GEMMs — the formulation
+                  that scales; dist.gauss_dist_blocked); -t as tpu-dist
     seq|omp|threads|forkjoin|tiled  native C++ host engines (reference CPU
                   baselines: sequential, OpenMP C4, persistent-pool C3,
                   fork-join-per-step C1, cache-tiled C2)
@@ -37,7 +41,8 @@ import numpy as np
 from gauss_tpu.utils.timing import timed_fetch
 
 GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-dist",
-                  "tpu-dist2d", "seq", "omp", "threads", "forkjoin", "tiled")
+                  "tpu-dist2d", "tpu-dist-blocked", "seq", "omp", "threads",
+                  "forkjoin", "tiled")
 MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "tpu-dist", "seq", "omp")
 
 
@@ -93,52 +98,57 @@ def _solve_tpu_unblocked(a64, b64, pivoting):
     return np.asarray(x, np.float64), elapsed
 
 
-def _solve_tpu_dist(a64, b64, nthreads):
+def _solve_dist_generic(a64, b64, prepare_fn, solve_fn):
+    """Shared distributed-engine timing protocol: warm up the jit cache with
+    a staged identity (same cache key as the timed call), free the warmup
+    shards, stage the real system OUTSIDE the timed span (like _stage for
+    the single-chip engines), then time solve+fetch alone."""
+    n = len(b64)
+    warm = prepare_fn(np.eye(n, dtype=np.float32),
+                      np.zeros(n, dtype=np.float32))
+    np.asarray(solve_fn(warm))
+    del warm  # free the warmup shards before staging the real system
+    staged = prepare_fn(a64.astype(np.float32), b64.astype(np.float32))
+    elapsed, x = timed_fetch(lambda: solve_fn(staged), warmup=0, reps=1)
+    return np.asarray(x, np.float64), elapsed
+
+
+def _dist_device_count(nthreads: int) -> int:
     import jax
 
+    ndev = len(jax.devices())
+    return max(1, min(nthreads or ndev, ndev))
+
+
+def _solve_tpu_dist(a64, b64, nthreads):
     from gauss_tpu.dist import gauss_dist
 
-    ndev = len(jax.devices())
-    shards = max(1, min(nthreads or ndev, ndev))
-    mesh = gauss_dist.make_mesh(shards)
-    n = len(b64)
-
-    # Warmup with a staged identity (same jit cache key as the timed call).
-    warm = gauss_dist.prepare_dist(np.eye(n, dtype=np.float32),
-                                   np.zeros(n, dtype=np.float32), mesh)
-    np.asarray(gauss_dist.solve_dist_staged(warm, mesh))
-    del warm  # free the warmup shards before staging the real system
-    # Staging (host pad/permute + shard upload) happens OUTSIDE the timed
-    # span, like _stage for the single-chip engines.
-    staged = gauss_dist.prepare_dist(a64.astype(np.float32),
-                                     b64.astype(np.float32), mesh)
-    elapsed, x = timed_fetch(
-        lambda: gauss_dist.solve_dist_staged(staged, mesh),
-        warmup=0, reps=1)
-    return np.asarray(x, np.float64), elapsed
+    mesh = gauss_dist.make_mesh(_dist_device_count(nthreads))
+    return _solve_dist_generic(
+        a64, b64,
+        lambda a, b: gauss_dist.prepare_dist(a, b, mesh),
+        lambda staged: gauss_dist.solve_dist_staged(staged, mesh))
 
 
 def _solve_tpu_dist2d(a64, b64, nthreads):
-    import jax
-
     from gauss_tpu.dist import gauss_dist2d
     from gauss_tpu.dist.mesh import make_mesh_2d_auto
 
-    ndev = len(jax.devices())
-    total = max(1, min(nthreads or ndev, ndev))
-    mesh = make_mesh_2d_auto(total)
-    n = len(b64)
-    # Warmup with a staged identity (same jit cache key as the timed call).
-    warm = gauss_dist2d.prepare_dist2d(np.eye(n, dtype=np.float32),
-                                       np.zeros(n, dtype=np.float32), mesh)
-    np.asarray(gauss_dist2d.solve_dist2d_staged(warm, mesh))
-    del warm  # free the warmup shards before staging the real system
-    staged = gauss_dist2d.prepare_dist2d(a64.astype(np.float32),
-                                         b64.astype(np.float32), mesh)
-    elapsed, x = timed_fetch(
-        lambda: gauss_dist2d.solve_dist2d_staged(staged, mesh),
-        warmup=0, reps=1)
-    return np.asarray(x, np.float64), elapsed
+    mesh = make_mesh_2d_auto(_dist_device_count(nthreads))
+    return _solve_dist_generic(
+        a64, b64,
+        lambda a, b: gauss_dist2d.prepare_dist2d(a, b, mesh),
+        lambda staged: gauss_dist2d.solve_dist2d_staged(staged, mesh))
+
+
+def _solve_tpu_dist_blocked(a64, b64, nthreads):
+    from gauss_tpu.dist import gauss_dist_blocked as gdb
+
+    mesh = gdb.make_mesh(_dist_device_count(nthreads))
+    return _solve_dist_generic(
+        a64, b64,
+        lambda a, b: gdb.prepare_dist_blocked(a, b, mesh),
+        lambda staged: gdb.solve_dist_blocked_staged(staged, mesh))
 
 
 def _solve_tpu_rowelim(a64, b64):
@@ -185,6 +195,8 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
         return _solve_tpu_dist(a64, b64, nthreads)
     if backend == "tpu-dist2d":
         return _solve_tpu_dist2d(a64, b64, nthreads)
+    if backend == "tpu-dist-blocked":
+        return _solve_tpu_dist_blocked(a64, b64, nthreads)
     if backend == "tpu-rowelim":
         return _solve_tpu_rowelim(a64, b64)
     if backend in ("seq", "omp", "threads", "forkjoin", "tiled"):

@@ -193,9 +193,14 @@ def _panel_factor_jax(p: jax.Array, kb):
         p = p - mult[:, None] * urow[None, :]
         return p, ipiv, min_piv
 
-    ipiv0 = jnp.zeros((panel,), dtype=jnp.int32)
-    return lax.fori_loop(0, panel, step,
-                         (p, ipiv0, jnp.asarray(jnp.inf, dtype)))
+    # Carry inits inherit p's varying-manual-axes type (shard_map vma), so
+    # this factorizer can run replicated inside a sharded solver
+    # (dist.gauss_dist_blocked) — a compiled no-op everywhere else. The
+    # NaN-proof zero: cast to int first (integer x * 0 is always 0).
+    vma0 = p[0, 0].astype(jnp.int32) * 0
+    ipiv0 = jnp.zeros((panel,), dtype=jnp.int32) + vma0
+    minpiv0 = jnp.asarray(jnp.inf, dtype) + vma0.astype(dtype)
+    return lax.fori_loop(0, panel, step, (p, ipiv0, minpiv0))
 
 
 def _resolve_panel_impl(panel_impl):
@@ -229,7 +234,8 @@ def _fold_transpositions(ipiv, kb, h: int, panel: int):
         x, y = pl[kb + j], pl[ipiv[j]]
         return pl.at[kb + j].set(y).at[ipiv[j]].set(x)
 
-    return lax.fori_loop(0, panel, fold, jnp.arange(h))
+    # Init inherits ipiv's varying-manual-axes type (see _panel_factor_jax).
+    return lax.fori_loop(0, panel, fold, jnp.arange(h) + ipiv[0] * 0)
 
 
 def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype):

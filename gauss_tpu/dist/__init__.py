@@ -13,4 +13,5 @@ and the SPMD program order replaces MPI_Barrier.
 from gauss_tpu.dist.mesh import make_mesh, make_mesh_2d  # noqa: F401
 from gauss_tpu.dist.gauss_dist import gauss_solve_dist, eliminate_dist  # noqa: F401
 from gauss_tpu.dist.gauss_dist2d import gauss_solve_dist2d  # noqa: F401
+from gauss_tpu.dist.gauss_dist_blocked import gauss_solve_dist_blocked  # noqa: F401
 from gauss_tpu.dist.matmul_dist import matmul_dist  # noqa: F401

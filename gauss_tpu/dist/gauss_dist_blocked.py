@@ -1,0 +1,259 @@
+"""Blocked distributed factorization: collectives per PANEL, not per row.
+
+VERDICT round 1 #4 / docs/SCALING.md: the per-step engines (gauss_dist,
+gauss_dist2d) faithfully re-express the reference's per-pivot-step MPI
+protocol (reference OpenMP_and_MPI/gauss_mpi/gauss_internal_input.c:124-206
+— barrier + bcast + scatter/gather EVERY step) with ~3-4 collectives per
+pivot step x n steps; latency-bound on any interconnect. This engine is the
+formulation that actually scales: right-looking blocked LU where the O(n^3)
+work is local MXU GEMMs and the interconnect carries O(panel)-amortized
+messages:
+
+- **Layout**: panel-block-cyclic rows — global row block k (rows
+  k*panel..(k+1)*panel) lives on shard k % P, so late panels still touch
+  every shard (the reference's cyclic striping argument at block granularity).
+- **Panel factorization is replicated, not negotiated**: each shard
+  all-gathers the (npad, panel) column strip (ONE collective) and factors it
+  redundantly with the same partial-pivoting panel kernel the single-chip
+  blocked path uses (core.blocked._panel_factor_jax). Every shard derives
+  identical pivots — cross-shard pivot agreement costs ZERO collectives,
+  where ScaLAPACK's pdgetf2 pays one amax-reduction per column. The
+  redundant flops are sum_k npad*panel^2 = n^2*panel total, ~100x below the
+  2/3 n^3 GEMM work at the BASELINE config-5 scale.
+- **Row swaps route in ONE psum per panel**: the panel's folded permutation
+  touches at most 2*panel rows (incoming pivot rows + displaced diagonal
+  block); both sets ride a single (2*panel, npad+1) psum, and every shard
+  rewrites only the rows it owns. The reference ships the whole O(n^2)
+  working set per step; the per-step engines ship O(n); this ships
+  O(panel * n / panel) = O(n) per PANEL.
+- **Trailing update is a local GEMM** per shard: A_own -= L21_own @ U12,
+  with U12 = L11^{-1} (post-swap block row) computed redundantly from the
+  replicated panel factor. The RHS rides as an augmented column through the
+  same GEMM.
+- **Back-substitution is blockwise**: the owner of block k solves the
+  (panel, panel) upper-triangular system locally and one psum broadcasts
+  x_k — n/panel collectives, vs n for the per-step engines.
+
+Collective budget per solve: 3 per panel (all_gather + routing psum +
+back-sub psum) x n/panel, vs ~4 x n for gauss_dist — a panel-width (~128x)
+reduction, asserted from the compiled jaxpr in tests/test_dist_blocked.py.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gauss_tpu.core.blocked import (_fold_transpositions, _panel_factor_jax,
+                                    unit_lower_inv)
+from gauss_tpu.dist.gauss_dist import _host_dtype
+from gauss_tpu.dist.mesh import make_mesh
+
+DEFAULT_PANEL_DIST = 128
+
+
+def _block_cyclic_perm(npad: int, nshards: int, panel: int) -> np.ndarray:
+    """perm[d * m + l] = global row of shard d's local row l under
+    panel-block-cyclic layout: local block lb is global block lb * P + d."""
+    m = npad // nshards
+    perm = np.empty(npad, dtype=np.int64)
+    for d in range(nshards):
+        for l in range(m):
+            g = ((l // panel) * nshards + d) * panel + (l % panel)
+            perm[d * m + l] = g
+    return perm
+
+
+@lru_cache(maxsize=32)
+def _gather_order(npad: int, nshards: int, panel: int) -> np.ndarray:
+    """Static index array reordering an all-gathered (P*m, panel) strip into
+    global row order: ORDER[g] = d(g) * m + l(g). Plain numpy — it traces
+    into the jitted shard_fn as a constant; an eager jnp array here would
+    touch the DEFAULT backend at build time, which this module must never do
+    (a broken default platform must not poison an explicit-mesh solve)."""
+    m = npad // nshards
+    g = np.arange(npad)
+    blk = g // panel
+    d = blk % nshards
+    l = (blk // nshards) * panel + (g % panel)
+    return d * m + l
+
+
+@lru_cache(maxsize=32)
+def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
+                          dtype_name: str):
+    axis = mesh.axis_names[0]
+    nshards = mesh.devices.shape[0]
+    m = npad // nshards
+    nblocks = npad // panel
+    w = npad + 1  # augmented: RHS rides as the last column
+    dtype = jnp.dtype(dtype_name)
+    order = _gather_order(npad, nshards, panel)
+
+    def shard_fn(a_loc):
+        """a_loc: (m, npad+1) — this shard's block-cyclic rows, augmented."""
+        d = lax.axis_index(axis)
+        l = jnp.arange(m)
+        g_loc = ((l // panel) * nshards + d) * panel + (l % panel)
+        zero = jnp.zeros((), dtype)
+
+        def panel_step(carry, k):
+            A, min_piv = carry
+            kb = k * panel
+            own_k = (k % nshards) == d          # owner of diagonal block k
+            lb = (k // nshards) * panel         # its local row offset there
+
+            # --- ONE all_gather: the global (npad, panel) column strip ---
+            strip_loc = lax.dynamic_slice(A, (0, kb), (m, panel))
+            strip = lax.all_gather(strip_loc, axis)          # (P, m, panel)
+            strip = strip.reshape(nshards * m, panel)[order]  # global order
+
+            # --- replicated panel factorization: identical on every shard,
+            # so pivot agreement needs no communication at all ---
+            pfac, ipiv, mp = _panel_factor_jax(strip, kb)
+            min_piv = jnp.minimum(min_piv, mp)
+            perm_g = _fold_transpositions(ipiv, kb, npad, panel)
+            src = lax.dynamic_slice(perm_g, (kb,), (panel,))  # incoming rows
+
+            # --- ONE routing psum: incoming pivot rows + displaced diagonal
+            # block, each shard contributing the rows it owns ---
+            src_blk = src // panel
+            src_mine = (src_blk % nshards) == d
+            src_li = (src_blk // nshards) * panel + (src % panel)
+            incoming = jnp.where(src_mine[:, None], A[src_li], zero)
+            outgoing = jnp.where(own_k,
+                                 lax.dynamic_slice(A, (lb, 0), (panel, w)),
+                                 zero)
+            buf = lax.psum(jnp.concatenate([incoming, outgoing]), axis)
+            new_diag = buf[:panel]   # post-swap diagonal block rows (pre-elim)
+            old_diag = buf[panel:]   # the rows they displaced
+
+            # --- each shard rewrites only the rows it owns ---
+            tau = perm_g[g_loc]                    # where my new content lives
+            moved = tau != g_loc
+            is_diag = (g_loc >= kb) & (g_loc < kb + panel)
+            diag_off = jnp.clip(g_loc - kb, 0, panel - 1)
+            disp_off = jnp.clip(tau - kb, 0, panel - 1)
+            A = jnp.where(is_diag[:, None], new_diag[diag_off], A)
+            A = jnp.where((moved & ~is_diag)[:, None], old_diag[disp_off], A)
+
+            # Panel columns from the replicated factor (multipliers below the
+            # diagonal, U11 on/above; rows < kb pass through unchanged).
+            strip_mine = pfac[g_loc]               # (m, panel)
+            A = lax.dynamic_update_slice(A, strip_mine, (0, kb))
+
+            # --- U12 (replicated small GEMM) + local trailing GEMM ---
+            dblk = lax.dynamic_slice(pfac, (kb, 0), (panel, panel))
+            rows_p = jnp.arange(panel)
+            lmask = rows_p[:, None] > rows_p[None, :]
+            l11 = jnp.where(lmask, dblk, zero) + jnp.eye(panel, dtype=dtype)
+            linv = unit_lower_inv(l11)
+            cols = jnp.arange(w)
+            right = cols >= kb + panel             # trailing cols + RHS col
+            u12 = jnp.where(right[None, :],
+                            jnp.dot(linv, new_diag,
+                                    precision=lax.Precision.HIGHEST),
+                            zero)
+            # Owner installs the eliminated block row's trailing columns.
+            A = jnp.where((is_diag & own_k)[:, None],
+                          jnp.where(right[None, :], u12[diag_off], A), A)
+            # Everyone eliminates its rows below the block: one MXU GEMM.
+            below = g_loc >= kb + panel
+            f_own = jnp.where(below[:, None], strip_mine, zero)
+            A = A - jnp.dot(f_own, u12, precision=lax.Precision.HIGHEST)
+            return (A, min_piv), k
+
+        # min_piv init inherits a_loc's varying type (shard_map vma);
+        # NaN-proof zero via the integer domain (int x * 0 is always 0).
+        vma0 = (a_loc[0, 0].astype(jnp.int32) * 0).astype(dtype)
+        (A, min_piv), _ = lax.scan(
+            panel_step, (a_loc, jnp.asarray(jnp.inf, dtype) + vma0),
+            jnp.arange(nblocks))
+
+        # --- blockwise back-substitution: one psum per block ---
+        def back_step(x, k):
+            kb = k * panel
+            own_k = (k % nshards) == d
+            lb = (k // nshards) * panel
+            rows = lax.dynamic_slice(A, (lb, 0), (panel, w))
+            # x is nonzero only for solved suffix columns (> kb+panel-1), so
+            # the full-width dot picks up exactly U_{k,>k} @ x_{>k}.
+            r = rows[:, npad] - rows[:, :npad] @ x
+            ukk = lax.dynamic_slice(rows, (0, kb), (panel, panel))
+            rows_p = jnp.arange(panel)
+            umask = rows_p[:, None] <= rows_p[None, :]
+            ukk = jnp.where(umask, ukk, zero)
+            xk = lax.linalg.triangular_solve(
+                ukk, r[:, None], left_side=True, lower=False)[:, 0]
+            xk = lax.psum(jnp.where(own_k, xk, zero), axis)
+            return lax.dynamic_update_slice(x, xk, (kb,)), k
+
+        x, _ = lax.scan(back_step, jnp.zeros((npad,), dtype),
+                        jnp.arange(nblocks - 1, -1, -1))
+        # min_piv is numerically identical on every shard (replicated panel
+        # factorization) but typed varying; one scalar pmin makes the
+        # replication provable for out_specs.
+        return x, lax.pmin(min_piv, axis)
+
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=(P(None), P()))
+    return jax.jit(mapped)
+
+
+def _prepare_blocked(a, b, mesh: jax.sharding.Mesh, panel: int):
+    """Identity-pad to a multiple of panel*P, apply the panel-block-cyclic
+    row permutation, augment with the RHS column, and stage the shards
+    DIRECTLY onto the mesh's devices (host numpy + one explicit device_put;
+    the default backend is never touched — same rule as gauss_dist)."""
+    nshards = mesh.devices.shape[0]
+    axis = mesh.axis_names[0]
+    dtype = _host_dtype(a)
+    a = np.asarray(a, dtype)
+    b = np.asarray(b, dtype)
+    n = a.shape[0]
+    blk = panel * nshards
+    npad = -(-n // blk) * blk
+    aug = np.zeros((npad, npad + 1), dtype)
+    aug[:n, :n] = a
+    aug[np.arange(n, npad), np.arange(n, npad)] = 1.0
+    aug[:n, npad] = b
+    perm = _block_cyclic_perm(npad, nshards, panel)
+    a_c = jax.device_put(aug[perm], NamedSharding(mesh, P(axis, None)))
+    return a_c, npad
+
+
+def prepare_dist_blocked(a, b, mesh: jax.sharding.Mesh,
+                         panel: int = DEFAULT_PANEL_DIST):
+    """Stage a system; returns an opaque handle for
+    :func:`solve_dist_blocked_staged` (staging/solve split as in gauss_dist)."""
+    n = np.shape(a)[0]
+    a_c, npad = _prepare_blocked(a, b, mesh, panel)
+    return (a_c, n, npad, panel)
+
+
+def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
+    a_c, n, npad, panel = staged
+    solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
+    x, _ = solver(a_c)
+    return x[:n]
+
+
+def gauss_solve_dist_blocked(a, b, mesh: jax.sharding.Mesh = None,
+                             panel: int = DEFAULT_PANEL_DIST) -> jax.Array:
+    """Distributed blocked dense solve; returns x replicated on every shard.
+
+    The performance formulation of the distributed axis (the per-step
+    gauss_dist stays as the reference-shape parity engine). Columns are
+    never permuted, so x returns in natural order.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    return solve_dist_blocked_staged(
+        prepare_dist_blocked(a, b, mesh, panel=panel), mesh)

@@ -1,0 +1,135 @@
+"""Tests for the panel-blocked distributed factorization (VERDICT r1 #4).
+
+Covers: oracle agreement on the 8-virtual-device mesh (incl. systems that
+REQUIRE pivoting), padding and dtype paths, singular detection, and the
+collective-count reduction proof — counted from the compiled jaxpr (scan
+lengths are static), not asserted from prose.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gauss_tpu.dist import gauss_dist, gauss_dist_blocked as gdb
+from gauss_tpu.dist.mesh import make_mesh
+from gauss_tpu.verify import checks
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _system(n, rng, dominant=True):
+    a = rng.standard_normal((n, n))
+    if dominant:
+        a = a + n * np.eye(n)
+    x_true = rng.standard_normal(n)
+    return a, a @ x_true, x_true
+
+
+@pytest.mark.parametrize("n,panel", [(24, 4), (64, 8), (100, 8), (192, 16)])
+def test_matches_truth(mesh, rng, n, panel):
+    a, b, x_true = _system(n, rng)
+    x = np.asarray(gdb.gauss_solve_dist_blocked(a, b, mesh=mesh, panel=panel))
+    assert checks.max_rel_error(x, x_true) < 1e-10
+
+
+def test_pivoting_required(mesh, rng):
+    """Zero diagonal entries: without partial pivoting this system is
+    unsolvable; the replicated panel factorization must pick the same
+    off-diagonal pivots on every shard."""
+    n = 48
+    a = rng.standard_normal((n, n))
+    np.fill_diagonal(a, 0.0)
+    x_true = rng.standard_normal(n)
+    b = a @ x_true
+    assert np.isfinite(np.linalg.cond(a))
+    x = np.asarray(gdb.gauss_solve_dist_blocked(a, b, mesh=mesh, panel=8))
+    assert checks.max_rel_error(x, x_true) < 1e-9
+
+
+def test_agrees_with_per_step_engine(mesh, rng):
+    """Blocked and per-step distributed engines solve the same system to the
+    same answer (both f64 here)."""
+    a, b, x_true = _system(72, rng)
+    xb = np.asarray(gdb.gauss_solve_dist_blocked(a, b, mesh=mesh, panel=8))
+    xs = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh))
+    assert checks.elementwise_match(xb, xs, epsilon=1e-9)
+    assert checks.max_rel_error(xb, x_true) < 1e-10
+
+
+def test_float32_path(mesh, rng):
+    a, b, x_true = _system(64, rng)
+    x = np.asarray(gdb.gauss_solve_dist_blocked(
+        a.astype(np.float32), b.astype(np.float32), mesh=mesh, panel=8))
+    assert checks.max_rel_error(x, x_true) < 1e-3
+
+
+def test_singular_detected(mesh):
+    """A singular matrix must produce a zero min-pivot (not a crash/hang)."""
+    n = 32
+    a = np.ones((n, n))  # rank 1
+    b = np.ones(n)
+    staged = gdb.prepare_dist_blocked(a, b, mesh, panel=8)
+    solver = gdb._build_solver_blocked(mesh, staged[2], staged[3],
+                                       str(staged[0].dtype))
+    _, min_piv = solver(staged[0])
+    assert float(min_piv) == 0.0
+
+
+def test_block_cyclic_perm_roundtrip():
+    perm = gdb._block_cyclic_perm(64, 8, 4)
+    assert sorted(perm.tolist()) == list(range(64))
+    # shard 0's first block is global block 0; shard 1's is global block 1.
+    assert perm[0] == 0 and perm[8] == 4  # m = 8 rows/shard, panel = 4
+
+
+COLLECTIVE_NAMES = ("psum", "all_gather", "ppermute", "all_to_all", "pmin",
+                    "pmax")
+
+
+def _count_collectives(jaxpr, mult=1):
+    """Total collective ops per execution, weighting scan bodies by their
+    static lengths (fori_loop with static bounds lowers to scan)."""
+    from jax._src import core as jcore
+
+    total = 0
+    for eqn in jaxpr.eqns:
+        if any(c in eqn.primitive.name for c in COLLECTIVE_NAMES):
+            total += mult
+        inner_mult = mult * eqn.params.get("length", 1)
+        for v in eqn.params.values():
+            if isinstance(v, jcore.ClosedJaxpr):
+                total += _count_collectives(v.jaxpr, inner_mult)
+            elif isinstance(v, jcore.Jaxpr):
+                total += _count_collectives(v, inner_mult)
+    return total
+
+
+def test_collective_count_reduction(mesh):
+    """THE design claim: collectives per panel, not per row. Counted from
+    the traced jaxprs of both engines on the same padded size."""
+    n, panel = 256, 32
+    a = np.eye(n, dtype=np.float32)
+    b = np.zeros(n, dtype=np.float32)
+
+    staged_b = gdb.prepare_dist_blocked(a, b, mesh, panel=panel)
+    solver_b = gdb._build_solver_blocked(mesh, staged_b[2], staged_b[3],
+                                         str(staged_b[0].dtype))
+    jaxpr_b = jax.make_jaxpr(solver_b)(staged_b[0])
+    count_b = _count_collectives(jaxpr_b.jaxpr)
+
+    staged_s = gauss_dist.prepare_dist(a, b, mesh)
+    solver_s = gauss_dist._build_solver(mesh, staged_s[3],
+                                        str(staged_s[0].dtype))
+    jaxpr_s = jax.make_jaxpr(solver_s)(staged_s[0], staged_s[1])
+    count_s = _count_collectives(jaxpr_s.jaxpr)
+
+    nblocks = staged_b[2] // panel
+    # Blocked: ~3 per panel (+1 closing pmin). Per-step: >= 3 per pivot row.
+    assert count_b <= 4 * nblocks + 2, (count_b, nblocks)
+    assert count_s >= 3 * staged_s[3], (count_s, staged_s[3])
+    # The headline: at least a panel-width-order reduction.
+    assert count_b * 8 <= count_s, (count_b, count_s)
