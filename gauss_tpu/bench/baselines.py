@@ -69,6 +69,7 @@ BACKEND_CLASS: Dict[str, str] = {
     "tpu": "openmp",
     "tpu-unblocked": "seq",
     "tpu-rowelim": "openmp",
+    "tpu-rowelim-step": "openmp",
 }
 
 _MATMUL_CLASS: Dict[str, str] = {

@@ -42,7 +42,8 @@ ENGINE_STYLE = {"tpu": (0, "-"), "tpu-unblocked": (1, "-"),
                 "tpu-dist2d": (4, "-"),
                 "tpu-pallas": (5, "-"), "tpu-pallas-v1": (6, "-"),
                 "seq": (7, "--"), "omp": (0, "--"), "threads": (1, "--"),
-                "forkjoin": (2, "--"), "tiled": (3, "--")}
+                "forkjoin": (2, "--"), "tiled": (3, "--"),
+                "tpu-rowelim-step": (2, ":"), "tpu-dist-blocked": (5, "-.")}
 
 
 def _color(engine: str) -> str:

@@ -6,8 +6,11 @@ Backend taxonomy (maps the reference's 12-binary grid onto one flag):
                   (the headline engine; reference CUDA/OpenMP analog)
     tpu-unblocked pure-JAX rank-1 fori_loop elimination (reference sequential
                   semantics on device; oracle path)
-    tpu-rowelim   per-pivot-step Pallas row-elimination kernel (the
-                  BASELINE.json north-star kernel; subtractElim analog)
+    tpu-rowelim   Pallas row-elimination kernel engine (the BASELINE.json
+                  north-star kernel; subtractElim analog), batched form —
+                  k pivot steps per launch, rank-k MXU update
+    tpu-rowelim-step  the same engine one pivot step per launch (the
+                  reference's exact algorithmic shape; HBM-bound, didactic)
     tpu-dist      row-cyclic shard_map over the device mesh (reference MPI
                   gauss_mpi analog, per-pivot-step protocol); -t selects the
                   shard count
@@ -40,9 +43,9 @@ import numpy as np
 
 from gauss_tpu.utils.timing import timed_fetch
 
-GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-dist",
-                  "tpu-dist2d", "tpu-dist-blocked", "seq", "omp", "threads",
-                  "forkjoin", "tiled")
+GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-rowelim-step",
+                  "tpu-dist", "tpu-dist2d", "tpu-dist-blocked", "seq", "omp",
+                  "threads", "forkjoin", "tiled")
 MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "tpu-dist", "seq", "omp")
 
 
@@ -151,18 +154,18 @@ def _solve_tpu_dist_blocked(a64, b64, nthreads):
         lambda staged: gdb.solve_dist_blocked_staged(staged, mesh))
 
 
-def _solve_tpu_rowelim(a64, b64):
+def _solve_tpu_rowelim(a64, b64, batched: bool = True):
     import jax.numpy as jnp
 
-    from gauss_tpu.kernels.rowelim_pallas import gauss_solve_rowelim
+    from gauss_tpu.kernels import rowelim_pallas
 
+    solve = (rowelim_pallas.gauss_solve_rowelim_batched if batched
+             else rowelim_pallas.gauss_solve_rowelim)
     n = len(b64)
-    np.asarray(gauss_solve_rowelim(jnp.eye(n, dtype=jnp.float32),
-                                   jnp.zeros(n, dtype=jnp.float32)))  # warmup
+    np.asarray(solve(jnp.eye(n, dtype=jnp.float32),
+                     jnp.zeros(n, dtype=jnp.float32)))  # warmup
     a_dev, b_dev = _stage(a64, b64)
-    elapsed, x = timed_fetch(
-        lambda: gauss_solve_rowelim(a_dev, b_dev),
-        warmup=0, reps=1)
+    elapsed, x = timed_fetch(lambda: solve(a_dev, b_dev), warmup=0, reps=1)
     return np.asarray(x, np.float64), elapsed
 
 
@@ -199,6 +202,8 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
         return _solve_tpu_dist_blocked(a64, b64, nthreads)
     if backend == "tpu-rowelim":
         return _solve_tpu_rowelim(a64, b64)
+    if backend == "tpu-rowelim-step":
+        return _solve_tpu_rowelim(a64, b64, batched=False)
     if backend in ("seq", "omp", "threads", "forkjoin", "tiled"):
         return _solve_native(a64, b64, backend, nthreads)
     raise ValueError(f"unknown backend {backend!r}; options: {GAUSS_BACKENDS}")
