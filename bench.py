@@ -23,13 +23,14 @@ BASELINE_GAUSS_2048_S = 0.509428  # reference OpenMP best, node2x18a
 N = 2048
 
 
-def _measure_slope(a, b, panel: int) -> float:
-    """Per-solve seconds via the two-chain slope (see gauss_tpu.bench.slope
-    for the method, its K/rounds parameters, and its noise hardening)."""
+def _measure_slope(a, b, panel: int):
+    """(per-solve seconds, k_small, k_large) via the two-chain slope (see
+    gauss_tpu.bench.slope for the method and its noise hardening); the K
+    pair is the one actually measured after any jitter-floor escalation."""
     from gauss_tpu.bench import slope
 
     make_chain, args = slope.gauss_chain(a, b, panel)
-    return slope.measure_slope(make_chain, args)
+    return slope.measure_slope_info(make_chain, args)
 
 
 def main() -> None:
@@ -46,7 +47,7 @@ def main() -> None:
     # passes/step): fewer XLA glue steps now outweigh the extra VPU work.
     panel = 256
 
-    per_solve = _measure_slope(a, b, panel)
+    per_solve, k_small, k_large = _measure_slope(a, b, panel)
 
     # Correctness gate on EXACTLY the timed configuration (one f32 blocked
     # factor+solve, no refinement — it solves the internal system exactly;
@@ -57,7 +58,7 @@ def main() -> None:
     residual = checks.residual_norm(a64, x, b64)
     pattern_ok = checks.internal_pattern_ok(x, atol=1e-4)
 
-    from gauss_tpu.bench.slope import K_LARGE, K_SMALL, ROUNDS
+    from gauss_tpu.bench.slope import ROUNDS
 
     print(json.dumps({
         "metric": "gauss_n2048_wallclock",
@@ -68,7 +69,7 @@ def main() -> None:
         "residual_ok": bool(residual < 1e-4),
         "pattern_ok": bool(pattern_ok),
         "baseline_s": BASELINE_GAUSS_2048_S,
-        "method": (f"slope of K={K_SMALL} vs K={K_LARGE} on-device chains, "
+        "method": (f"slope of K={k_small} vs K={k_large} on-device chains, "
                    f"interleaved best of {ROUNDS}"),
     }))
 

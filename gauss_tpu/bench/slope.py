@@ -39,10 +39,14 @@ MIN_DELTA_S = 0.004
 MAX_K = 1024
 
 
-def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
-                  k_small: int = K_SMALL, k_large: int = K_LARGE,
-                  rounds: int = ROUNDS) -> float:
-    """Per-iteration seconds via the two-chain slope.
+def measure_slope_info(make_chain: Callable[[int], Callable],
+                       args: Sequence = (), k_small: int = K_SMALL,
+                       k_large: int = K_LARGE, rounds: int = ROUNDS
+                       ) -> Tuple[float, int, int]:
+    """Per-iteration seconds via the two-chain slope, plus the K pair that
+    was ACTUALLY measured (the pair escalates when the chain delta is under
+    the jitter floor, so reporting the requested pair would misstate the
+    measurement configuration — ADVICE round 1).
 
     ``make_chain(k)`` must return a jitted callable running k data-dependent
     iterations on device and returning a SMALL result (scalar fetch — the
@@ -70,8 +74,15 @@ def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
             break
         k_small, k_large = k_small * 4, k_large * 4
     if delta <= 0:
-        return best[k_large] / k_large
-    return delta / (k_large - k_small)
+        return best[k_large] / k_large, k_small, k_large
+    return delta / (k_large - k_small), k_small, k_large
+
+
+def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
+                  k_small: int = K_SMALL, k_large: int = K_LARGE,
+                  rounds: int = ROUNDS) -> float:
+    """:func:`measure_slope_info` without the K-pair bookkeeping."""
+    return measure_slope_info(make_chain, args, k_small, k_large, rounds)[0]
 
 
 def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,

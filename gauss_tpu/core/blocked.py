@@ -432,22 +432,36 @@ def lu_factor_blocked_unrolled(a: jax.Array,
                      linv=jnp.stack(linvs), uinv=jnp.stack(uinvs))
 
 
-@jax.jit
-def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("method",))
+def lu_solve(factors: BlockedLU, b: jax.Array,
+             method: str = "auto") -> jax.Array:
     """Solve A x = b given a BlockedLU of A: permute, L-solve, U-solve.
 
     With stored diagonal-block inverses (unrolled factorization), both
     substitutions run blockwise — per block one small-matvec against the
     off-diagonal strip plus one inverse multiply — an O(nb)-step chain of
     MXU ops instead of an O(n)-step scalar-recurrence chain (measured
-    0.42 -> ~0.1 ms at n=2048 on v5e). Falls back to
-    lax.linalg.triangular_solve when inverses are absent (only
-    hand-constructed BlockedLU values — both factor paths store them).
+    0.42 -> ~0.1 ms at n=2048 on v5e).
+
+    ``method``: "auto" uses the stored inverses when present, else
+    substitution; "substitution" forces ``lax.linalg.triangular_solve``
+    even when inverses exist. The trade-off (ADVICE round 1): explicit
+    TRTRI+GEMM inverses trade substitution's backward stability for speed —
+    unit-lower inverses can grow up to 2^(panel-1) on Wilkinson-type
+    adversarial matrices, and an ill-conditioned U diagonal block loses
+    accuracy its substitution would keep. Partial pivoting keeps |L| <= 1
+    so real inputs sit far from the bound (every verified report cell
+    passes the 1e-4 gate, and solve_refined's refinement absorbs the
+    difference), but callers with adversarial or very ill-conditioned
+    systems should pass method="substitution".
 
     ``b`` may be a single right-hand side (n,) or a block of them (n, k) —
     one factorization serves many solves (the getrf/getrs split the
     reference's monolithic programs lack); every dot below is already
     GEMM-shaped, so the k axis rides along for free."""
+    if method not in ("auto", "substitution"):
+        raise ValueError(f"unknown method {method!r}; options: "
+                         "('auto', 'substitution')")
     m, perm = factors.m, factors.perm
     npad = m.shape[0]
     b = jnp.asarray(b, dtype=m.dtype)
@@ -457,7 +471,7 @@ def lu_solve(factors: BlockedLU, b: jax.Array) -> jax.Array:
         raise ValueError(f"b must be (n,) or (n, k), got {b.shape}")
     n, k = b2.shape
     bp = jnp.zeros((npad, k), dtype=m.dtype).at[:n].set(b2)[perm]
-    if factors.linv is None:
+    if factors.linv is None or method == "substitution":
         y = lax.linalg.triangular_solve(
             m, bp, left_side=True, lower=True, unit_diagonal=True)
         x = lax.linalg.triangular_solve(

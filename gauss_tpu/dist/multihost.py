@@ -34,7 +34,9 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-_INITIALIZED = False
+# None until initialize() succeeds, then the (coordinator, num_processes,
+# process_id) topology it was called with (for the idempotence check).
+_INITIALIZED = None
 
 
 def initialize(coordinator: Optional[str] = None,
@@ -45,7 +47,10 @@ def initialize(coordinator: Optional[str] = None,
     Arguments fall back to the standard environment variables
     (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), then to
     JAX's own cluster auto-detection (TPU pod metadata, SLURM, ...).
-    Safe to call once; raises on re-initialization with different topology.
+    Idempotent for an identical topology (a repeated identical call is a
+    no-op, like MPI_Initialized-guarded MPI_Init); raises on
+    re-initialization with DIFFERENT topology, which jax.distributed cannot
+    honor within one process.
     """
     global _INITIALIZED
     import jax
@@ -55,12 +60,17 @@ def initialize(coordinator: Optional[str] = None,
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
-    if _INITIALIZED:
-        raise RuntimeError("multihost.initialize() already called")
+    requested = (coordinator, num_processes, process_id)
+    if _INITIALIZED is not None:
+        if requested == _INITIALIZED:
+            return
+        raise RuntimeError(
+            f"multihost.initialize() already called with topology "
+            f"{_INITIALIZED}; cannot re-initialize as {requested}")
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
-    _INITIALIZED = True
+    _INITIALIZED = requested
 
 
 def is_multihost() -> bool:

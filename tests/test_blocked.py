@@ -295,3 +295,24 @@ def test_auto_panel_vmem_budget():
         p = auto_panel(n)
         npad = -(-n // p) * p
         assert p * npad * 4 <= PANEL_VMEM_BUDGET
+
+
+def test_lu_solve_substitution_method(rng):
+    """method='substitution' must agree with the inverse-based route (the
+    stability escape hatch for adversarial systems, ADVICE round 1)."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    n = 100
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal(n)
+    fac = blocked.lu_factor_blocked(jnp.asarray(a), panel=16)
+    x_inv = np.asarray(blocked.lu_solve(fac, jnp.asarray(b)))
+    x_sub = np.asarray(blocked.lu_solve(fac, jnp.asarray(b),
+                                        method="substitution"))
+    ref = np.linalg.solve(a, b)
+    np.testing.assert_allclose(x_inv, ref, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(x_sub, ref, rtol=1e-9, atol=1e-9)
+    with pytest.raises(ValueError):
+        blocked.lu_solve(fac, jnp.asarray(b), method="bogus")

@@ -84,12 +84,32 @@ def test_two_process_distributed_solve():
         assert "local / 8 global devices" in out
 
 
-def test_initialize_rejects_double_init(monkeypatch):
+def test_initialize_rejects_double_init_different_topology(monkeypatch):
     from gauss_tpu.dist import multihost
 
-    monkeypatch.setattr(multihost, "_INITIALIZED", True)
+    monkeypatch.setattr(multihost, "_INITIALIZED", ("127.0.0.1:9", 2, 1))
     with pytest.raises(RuntimeError, match="already"):
         multihost.initialize("127.0.0.1:1", 1, 0)
+
+
+def test_initialize_idempotent_same_topology(monkeypatch):
+    """A repeated identical call is a no-op (MPI_Initialized-guarded
+    MPI_Init semantics) — jax.distributed.initialize must NOT run again."""
+    from gauss_tpu.dist import multihost
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    topo = ("127.0.0.1:9", 2, 1)
+    monkeypatch.setattr(multihost, "_INITIALIZED", topo)
+
+    import jax
+
+    def boom(**kwargs):
+        raise AssertionError("jax.distributed.initialize re-invoked")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    multihost.initialize(*topo)  # must return silently
 
 
 def test_maybe_initialize_noop_without_coordinates(monkeypatch):

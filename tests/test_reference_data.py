@@ -133,7 +133,8 @@ def test_cross_engine_agreement_real_matrix():
     from gauss_tpu.cli import _common
 
     a, b, x_true = _system("jpwh_991")
-    backends = ["tpu", "tpu-unblocked", "tpu-dist", "tpu-dist2d"]
+    backends = ["tpu", "tpu-unblocked", "tpu-dist", "tpu-dist2d",
+                "tpu-dist-blocked"]
     if native.available():
         backends += ["seq", "omp", "threads", "forkjoin", "tiled"]
     sols = {}
