@@ -46,6 +46,16 @@ GAUSS_EXTERNAL_BEST: Dict[str, Dict[str, float]] = {
                  "openmp": 11.584218},
 }
 
+# Gauss internal input, MPI over the real 6-node Ethernet cluster
+# ("Results on node01 to node06 (Distributed MPI Program)") — the
+# reference's ONLY multi-node data; columns are mpirun -np rank counts.
+GAUSS_DIST_MPI: Dict[int, Dict[int, float]] = {
+    128: {2: 1.29592, 16: 0.167949, 32: 0.127643, 70: 0.162209},
+    256: {2: 7.218069, 16: 0.763665, 32: 0.638781, 70: 0.720387},
+    512: {2: 31.57587, 16: 3.805018, 32: 3.65404, 70: 3.889204},
+    1024: {2: 154.7341, 16: 24.26487, 32: 23.72897, 70: 28.7057},
+}
+
 # Matmul, gpu-node1 (GTX 1080 / i7-7700K), "Performance Comparisons" time table.
 MATMUL: Dict[str, Dict[int, float]] = {
     "seq": {1001: 1.02894, 1024: 1.39945, 2001: 22.3342, 2048: 66.4837},
@@ -106,6 +116,11 @@ def reference_seconds(suite: str, key, backend: str) -> Optional[float]:
         cls = _MATMUL_CLASS.get(backend)
         table = MATMUL.get(cls) if cls else None
         return table.get(key) if table else None
+    if suite == "gauss-dist":
+        # Best across rank counts for the size — the reference's strongest
+        # distributed result is the anchor (hardware differs on both sides).
+        table = GAUSS_DIST_MPI.get(key)
+        return min(table.values()) if table else None
     raise ValueError(f"unknown suite {suite!r}")
 
 
@@ -117,4 +132,6 @@ def suite_keys(suite: str) -> Tuple:
         return tuple(GAUSS_EXTERNAL_BEST)
     if suite == "matmul":
         return (1001, 1024, 2001, 2048)
+    if suite == "gauss-dist":
+        return tuple(GAUSS_DIST_MPI)
     raise ValueError(f"unknown suite {suite!r}")

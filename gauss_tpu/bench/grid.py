@@ -33,7 +33,15 @@ from gauss_tpu.cli import _common
 from gauss_tpu.verify import checks
 
 SUITES = ("gauss-internal", "gauss-external", "matmul")
+# The distributed suite is opt-in (not part of --suite all): it sweeps the
+# SHARD count over a forced virtual CPU mesh — scaling shape + correctness,
+# explicitly NOT an ICI measurement (VERDICT round 1 #7).
+EXTRA_SUITES = ("gauss-dist",)
 RESIDUAL_BAR = 1e-4  # BASELINE.json acceptance bar
+
+DIST_BACKENDS = ("tpu-dist", "tpu-dist2d", "tpu-dist-blocked")
+DIST_SHARD_SWEEP = (2, 4, 8)   # reference sweep is mpirun -np {2,16,32,70}
+DIST_NOTE = "virtual CPU mesh (scaling shape + correctness; NOT ICI)"
 
 
 @dataclass
@@ -261,10 +269,83 @@ def _run_matmul(ctx, n: int, backend: str, nthreads: int,
                 baselines.reference_seconds("matmul", n, backend))
 
 
+def _cpu_mesh_devices(k: int):
+    """k virtual CPU devices for the distributed suite, independent of the
+    default platform (the tunneled single TPU cannot host a shard sweep)."""
+    from gauss_tpu.utils.env import force_host_device_count
+
+    flag_ok = force_host_device_count(k)
+    import jax
+
+    devs = list(jax.devices("cpu"))
+    if len(devs) < k:
+        hint = ("a pre-existing XLA_FLAGS --xla_force_host_platform_"
+                "device_count requests fewer devices" if not flag_ok else
+                "the CPU backend initialized before the forced device count "
+                "could apply — run --suite gauss-dist in its own process")
+        raise RuntimeError(f"need {k} CPU devices, have {len(devs)}; {hint}")
+    return devs[:k]
+
+
+def _prep_gauss_dist(n: int):
+    from gauss_tpu.io import synthetic
+
+    a64 = synthetic.internal_matrix(n)
+    b64 = synthetic.internal_rhs(n)
+    return a64.astype(np.float32), b64.astype(np.float32), a64, b64
+
+
+def _run_gauss_dist(ctx, n: int, backend: str, shards: int,
+                    span: str = "reference") -> Cell:
+    """One (size, engine, shard-count) cell on the virtual CPU mesh.
+
+    Timing is plain best-of-3 wall-clock around solve+fetch with staging
+    outside the span (no tunnel between host and the CPU mesh, so the slope
+    method is unnecessary); every cell verifies the 1e-4 residual bar. The
+    reference comparator is the best Distributed-MPI cell for the size
+    (BASELINE.md node01-06 table) — different hardware on both sides, kept
+    only to anchor the scale."""
+    from gauss_tpu.utils.timing import timed_fetch
+
+    a32, b32, a64, b64 = ctx
+    shards = shards or DIST_SHARD_SWEEP[-1]
+    devs = _cpu_mesh_devices(shards)
+    if backend == "tpu-dist":
+        from gauss_tpu.dist import gauss_dist as eng
+        from gauss_tpu.dist.mesh import make_mesh
+
+        mesh = make_mesh(shards, devices=devs)
+        staged = eng.prepare_dist(a32, b32, mesh)
+        solve = lambda: eng.solve_dist_staged(staged, mesh)  # noqa: E731
+    elif backend == "tpu-dist2d":
+        from gauss_tpu.dist import gauss_dist2d as eng
+        from gauss_tpu.dist.mesh import make_mesh_2d_auto
+
+        mesh = make_mesh_2d_auto(shards, devices=devs)
+        staged = eng.prepare_dist2d(a32, b32, mesh)
+        solve = lambda: eng.solve_dist2d_staged(staged, mesh)  # noqa: E731
+    elif backend == "tpu-dist-blocked":
+        from gauss_tpu.dist import gauss_dist_blocked as eng
+        from gauss_tpu.dist.mesh import make_mesh
+
+        mesh = make_mesh(shards, devices=devs)
+        staged = eng.prepare_dist_blocked(a32, b32, mesh)
+        solve = lambda: eng.solve_dist_blocked_staged(staged, mesh)  # noqa: E731
+    else:
+        raise ValueError(f"backend {backend!r} is not a distributed engine; "
+                         f"options: {DIST_BACKENDS}")
+    seconds, x = timed_fetch(solve, warmup=1, reps=3)
+    res = checks.residual_norm(a64, np.asarray(x, np.float64), b64)
+    return Cell("gauss-dist", str(n), backend, seconds, res < RESIDUAL_BAR,
+                res, baselines.reference_seconds("gauss-dist", n, backend),
+                note=DIST_NOTE)
+
+
 _SUITE_FNS = {
     "gauss-internal": (_prep_gauss_internal, _run_gauss_internal),
     "gauss-external": (_prep_gauss_external, _run_gauss_external),
     "matmul": (_prep_matmul, _run_matmul),
+    "gauss-dist": (_prep_gauss_dist, _run_gauss_dist),
 }
 
 # Which backends actually get the device slope span per suite — used both to
@@ -274,6 +355,7 @@ _DEVICE_ELIGIBLE = {
     "gauss-internal": DEVICE_SPAN_GAUSS,
     "gauss-external": DEVICE_SPAN_GAUSS_EXTERNAL,
     "matmul": DEVICE_SPAN_MATMUL,
+    "gauss-dist": (),  # CPU-mesh wall-clock; slope spans do not apply
 }
 
 
@@ -289,15 +371,21 @@ def _ctx_note(suite: str, ctx) -> str:
     return f"source={ctx[3]}" if suite == "gauss-external" else ""
 
 
-def _sweep_skip(backend: str, t, sweep) -> bool:
+def _sweep_skip(suite: str, backend: str, t, sweep) -> bool:
     """Device engines have no thread axis (the mesh, not a thread pool, is
-    their parallelism): in a thread sweep they run once, at the first entry."""
+    their parallelism): in a thread sweep they run once, at the first entry.
+    EXCEPT in the distributed suite, where the sweep axis IS the mesh's
+    shard count."""
+    if suite == "gauss-dist":
+        return False
     return t is not None and backend.startswith("tpu") and t != sweep[0]
 
 
-def _sweep_label(key, backend: str, t) -> str:
-    """Cell key within a thread sweep; device engines keep the bare size so
-    scaling fits and tables stay honest."""
+def _sweep_label(suite: str, key, backend: str, t) -> str:
+    """Cell key within a sweep; device engines keep the bare size so scaling
+    fits and tables stay honest, and distributed cells key on shards."""
+    if suite == "gauss-dist":
+        return f"{key} @{t}sh" if t is not None else str(key)
     return (str(key) if t is None or backend.startswith("tpu")
             else f"{key} @{t}t")
 
@@ -318,12 +406,20 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
     the thread count (the mesh, not a thread pool, is their parallelism), so
     they are swept only once, at the first entry.
     """
-    if suite not in SUITES:
-        raise ValueError(f"unknown suite {suite!r}; options: {SUITES}")
+    if suite not in SUITES + EXTRA_SUITES:
+        raise ValueError(f"unknown suite {suite!r}; options: "
+                         f"{SUITES + EXTRA_SUITES}")
     if span not in ("reference", "device"):
         raise ValueError(f"unknown span {span!r}; options: "
                          "('reference', 'device')")
     prep, run = _SUITE_FNS[suite]
+    if suite == "gauss-dist":
+        if not thread_sweep:
+            thread_sweep = DIST_SHARD_SWEEP
+        # Force the LARGEST shard count before the CPU backend initializes:
+        # the forced-device-count flag is latched at first backend init, so
+        # asking for 2 first would cap the whole sweep at 2.
+        _cpu_mesh_devices(max(max(thread_sweep), nthreads or 0))
     sweep = list(thread_sweep) if thread_sweep else [None]
     cells = []
     for key in keys:
@@ -334,9 +430,10 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                   file=sys.stderr)
             for t in sweep:
                 for backend in backends:
-                    if _sweep_skip(backend, t, sweep):
+                    if _sweep_skip(suite, backend, t, sweep):
                         continue
-                    cells.append(Cell(suite, _sweep_label(key, backend, t),
+                    cells.append(Cell(suite,
+                                      _sweep_label(suite, key, backend, t),
                                       backend, 0.0, False, float("nan"),
                                       baselines.reference_seconds(
                                           suite, key, backend),
@@ -345,9 +442,9 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
         for t in sweep:
             run_t = nthreads if t is None else t
             for backend in backends:
-                if _sweep_skip(backend, t, sweep):
+                if _sweep_skip(suite, backend, t, sweep):
                     continue
-                key_label = _sweep_label(key, backend, t)
+                key_label = _sweep_label(suite, key, backend, t)
                 # Progress to stderr per cell: sweeps run for minutes behind
                 # slow device dispatch, and a silent hang is
                 # indistinguishable from work without this.
@@ -391,7 +488,7 @@ def format_table(cells: List[Cell]) -> str:
         backends = list(dict.fromkeys(_span_label(c) for c in suite_cells))
         keys = list(dict.fromkeys(c.key for c in suite_cells))
         label = {"gauss-internal": "n", "gauss-external": "matrix",
-                 "matmul": "n"}[suite]
+                 "matmul": "n", "gauss-dist": "n"}[suite]
         out.append(f"## {suite} (seconds; xR = speedup vs reference cell)\n")
         out.append("| " + label + " | " + " | ".join(backends) + " |")
         out.append("|" + "---|" * (len(backends) + 1))
@@ -426,7 +523,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="bench-grid",
         description="Reproduce the reference reports' benchmark grids.")
-    p.add_argument("--suite", choices=SUITES + ("all",), default="all")
+    p.add_argument("--suite", choices=SUITES + EXTRA_SUITES + ("all",),
+                   default="all",
+                   help="'all' runs the three reference suites; gauss-dist "
+                        "(shard sweep on a virtual CPU mesh) is opt-in")
     p.add_argument("--keys", default="",
                    help="comma-separated sizes / dataset names "
                         "(default: the reference reports' sweep)")
@@ -454,6 +554,12 @@ def main(argv=None) -> int:
                 "do not apply across suites)")
     suites = list(SUITES) if args.suite == "all" else [args.suite]
     backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    if (args.suite == "gauss-dist"
+            and args.backends == p.get_default("backends")):
+        # Only the untouched default is rewritten; an explicit non-dist
+        # request falls through to the per-suite validity filter and its
+        # "no requested backend applies" notice.
+        backends = list(DIST_BACKENDS)
     known = set(_common.GAUSS_BACKENDS) | set(_common.MATMUL_BACKENDS)
     unknown = [b for b in backends if b not in known]
     if unknown:
@@ -480,8 +586,12 @@ def main(argv=None) -> int:
                 keys = [int(k) for k in raw]
         else:
             keys = list(baselines.suite_keys(suite))
-        valid = (_common.MATMUL_BACKENDS if suite == "matmul"
-                 else _common.GAUSS_BACKENDS)
+        if suite == "matmul":
+            valid = _common.MATMUL_BACKENDS
+        elif suite == "gauss-dist":
+            valid = DIST_BACKENDS
+        else:
+            valid = _common.GAUSS_BACKENDS
         suite_backends = [b for b in backends if b in valid]
         if not suite_backends:
             print(f"bench-grid: no requested backend applies to {suite}; "

@@ -42,11 +42,14 @@ MAX_K = 1024
 def measure_slope_info(make_chain: Callable[[int], Callable],
                        args: Sequence = (), k_small: int = K_SMALL,
                        k_large: int = K_LARGE, rounds: int = ROUNDS
-                       ) -> Tuple[float, int, int]:
-    """Per-iteration seconds via the two-chain slope, plus the K pair that
-    was ACTUALLY measured (the pair escalates when the chain delta is under
-    the jitter floor, so reporting the requested pair would misstate the
-    measurement configuration — ADVICE round 1).
+                       ) -> Tuple[float, int, int, bool]:
+    """(seconds-per-iteration, k_small, k_large, is_slope): the two-chain
+    slope plus the K pair that was ACTUALLY measured (the pair escalates
+    when the chain delta is under the jitter floor, so reporting the
+    requested pair would misstate the measurement configuration — ADVICE
+    round 1). ``is_slope`` is False when the measurement fell back to the
+    whole-chain mean (non-positive delta at MAX_K) — that number still
+    contains the dispatch offset and must not be labeled a slope.
 
     ``make_chain(k)`` must return a jitted callable running k data-dependent
     iterations on device and returning a SMALL result (scalar fetch — the
@@ -74,14 +77,14 @@ def measure_slope_info(make_chain: Callable[[int], Callable],
             break
         k_small, k_large = k_small * 4, k_large * 4
     if delta <= 0:
-        return best[k_large] / k_large, k_small, k_large
-    return delta / (k_large - k_small), k_small, k_large
+        return best[k_large] / k_large, k_small, k_large, False
+    return delta / (k_large - k_small), k_small, k_large, True
 
 
 def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
                   k_small: int = K_SMALL, k_large: int = K_LARGE,
                   rounds: int = ROUNDS) -> float:
-    """:func:`measure_slope_info` without the K-pair bookkeeping."""
+    """:func:`measure_slope_info` without the configuration bookkeeping."""
     return measure_slope_info(make_chain, args, k_small, k_large, rounds)[0]
 
 

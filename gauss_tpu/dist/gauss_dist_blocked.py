@@ -57,6 +57,18 @@ from gauss_tpu.dist.mesh import make_mesh
 DEFAULT_PANEL_DIST = 128
 
 
+def auto_panel_dist(n: int, nshards: int,
+                    panel_max: int = DEFAULT_PANEL_DIST) -> int:
+    """Widest power-of-two panel (<= panel_max, >= 8) with panel * P <= n,
+    so small systems are not identity-padded to panel * P (a n=128 solve on
+    8 shards at panel=128 would pad 8x and spend 87% of its time on
+    padding)."""
+    p = panel_max
+    while p > 8 and p * nshards > n:
+        p //= 2
+    return p
+
+
 def _block_cyclic_perm(npad: int, nshards: int, panel: int) -> np.ndarray:
     """perm[d * m + l] = global row of shard d's local row l under
     panel-block-cyclic layout: local block lb is global block lb * P + d."""
@@ -230,10 +242,13 @@ def _prepare_blocked(a, b, mesh: jax.sharding.Mesh, panel: int):
 
 
 def prepare_dist_blocked(a, b, mesh: jax.sharding.Mesh,
-                         panel: int = DEFAULT_PANEL_DIST):
+                         panel: int | None = None):
     """Stage a system; returns an opaque handle for
-    :func:`solve_dist_blocked_staged` (staging/solve split as in gauss_dist)."""
+    :func:`solve_dist_blocked_staged` (staging/solve split as in gauss_dist).
+    panel=None resolves through :func:`auto_panel_dist`."""
     n = np.shape(a)[0]
+    if panel is None:
+        panel = auto_panel_dist(n, mesh.devices.shape[0])
     a_c, npad = _prepare_blocked(a, b, mesh, panel)
     return (a_c, n, npad, panel)
 
@@ -246,7 +261,7 @@ def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
 
 
 def gauss_solve_dist_blocked(a, b, mesh: jax.sharding.Mesh = None,
-                             panel: int = DEFAULT_PANEL_DIST) -> jax.Array:
+                             panel: int | None = None) -> jax.Array:
     """Distributed blocked dense solve; returns x replicated on every shard.
 
     The performance formulation of the distributed axis (the per-step

@@ -220,3 +220,30 @@ def test_grid_device_span_ineligible_engine_notice(capsys):
                            span="device")
     assert cells[0].span == "reference"
     assert "no device span for this suite" in capsys.readouterr().err
+
+
+def test_gauss_dist_suite():
+    """The distributed shard-sweep suite (VERDICT r1 #7): every cell runs on
+    the virtual CPU mesh, verifies the residual bar, keys on shards, and
+    carries the not-ICI provenance note."""
+    from gauss_tpu.bench import grid
+
+    cells = grid.run_suite("gauss-dist", [64],
+                           ["tpu-dist", "tpu-dist-blocked"],
+                           thread_sweep=[2, 4])
+    assert len(cells) == 4
+    assert {c.key for c in cells} == {"64 @2sh", "64 @4sh"}
+    for c in cells:
+        assert c.verified, (c.backend, c.key, c.error)
+        assert c.seconds > 0
+        assert c.note == grid.DIST_NOTE
+        assert c.span == "reference"
+    table = grid.format_table(cells)
+    assert "@2sh" in table and grid.DIST_NOTE in table
+
+
+def test_gauss_dist_suite_rejects_non_dist_backend():
+    from gauss_tpu.bench import grid
+
+    cells = grid.run_suite("gauss-dist", [32], ["seq"], thread_sweep=[2])
+    assert len(cells) == 1 and not cells[0].verified

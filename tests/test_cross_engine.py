@@ -13,17 +13,14 @@ from gauss_tpu.cli import _common
 from gauss_tpu.verify import checks
 
 
-NATIVE_BACKENDS = ("seq", "omp", "threads", "forkjoin", "tiled")
-
-
 def _all_backends():
     """Derived from the CLI's authoritative list so an engine added there is
-    automatically covered here (device engines always; native ones when the
-    C++ library is built)."""
+    automatically covered here (device engines always; non-tpu ones are the
+    native C++ engines, included when the library is built)."""
     backends = [b for b in _common.GAUSS_BACKENDS if b.startswith("tpu")]
     if native.available():
         backends += [b for b in _common.GAUSS_BACKENDS
-                     if b in NATIVE_BACKENDS]
+                     if not b.startswith("tpu")]
     return backends
 
 
