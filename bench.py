@@ -24,9 +24,11 @@ N = 2048
 
 
 def _measure_slope(a, b, panel: int):
-    """(per-solve seconds, k_small, k_large) via the two-chain slope (see
-    gauss_tpu.bench.slope for the method and its noise hardening); the K
-    pair is the one actually measured after any jitter-floor escalation."""
+    """(per-solve seconds, k_small, k_large, is_slope) via the two-chain
+    slope (see gauss_tpu.bench.slope for the method and its noise
+    hardening); the K pair is the one actually measured after any
+    jitter-floor escalation, and is_slope=False marks the chain-mean
+    fallback (drives the FALLBACK method label below)."""
     from gauss_tpu.bench import slope
 
     make_chain, args = slope.gauss_chain(a, b, panel)

@@ -415,11 +415,13 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
     prep, run = _SUITE_FNS[suite]
     if suite == "gauss-dist":
         if not thread_sweep:
-            thread_sweep = DIST_SHARD_SWEEP
+            # An explicit -t is honored as a single-point sweep (as the
+            # other suites honor it); otherwise the default shard sweep.
+            thread_sweep = [nthreads] if nthreads else DIST_SHARD_SWEEP
         # Force the LARGEST shard count before the CPU backend initializes:
         # the forced-device-count flag is latched at first backend init, so
         # asking for 2 first would cap the whole sweep at 2.
-        _cpu_mesh_devices(max(max(thread_sweep), nthreads or 0))
+        _cpu_mesh_devices(max(thread_sweep))
     sweep = list(thread_sweep) if thread_sweep else [None]
     cells = []
     for key in keys:

@@ -35,28 +35,41 @@ from jax import lax
 DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
 CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16)
 
-# The Pallas panel kernel holds one transposed (panel, npad) block in VMEM;
-# keep it under the ~16 MB budget with headroom for its per-step vectors
-# (observed OOM: 19.12 M requested at panel=256, npad=17920).
-PANEL_VMEM_BUDGET = 14 * 1024 * 1024
+# The Pallas panel kernel holds one transposed (panel, npad) block in VMEM
+# plus per-row pivot bookkeeping (inv/chosen/done vectors). Calibrated from
+# the chip's scoped-vmem reports: 17.58 M requested at (panel=128,
+# h=24576) -> ~203 bytes/row beyond the 4*panel block bytes; 19.12 M at
+# (256, 17920). Budget = the 16 M scoped limit minus headroom.
+PANEL_VMEM_BUDGET = 15_500_000
+PANEL_VMEM_ROW_OVERHEAD = 256  # bytes per matrix row (bookkeeping vectors)
+
+
+def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
+    """Whether the Pallas panel kernel's VMEM working set fits the scoped
+    limit: npad * (panel * itemsize + row overhead)."""
+    npad = -(-n // panel) * panel
+    return npad * (panel * itemsize + PANEL_VMEM_ROW_OVERHEAD) \
+        <= PANEL_VMEM_BUDGET
 
 
 def auto_panel(n: int, itemsize: int = 4) -> int:
     """The widest panel in {256, 128, 64} whose kernel block fits VMEM.
 
     256 wins on v5e for n >= 1024 (fewer XLA glue steps beat the extra VPU
-    work); narrower panels extend the reachable n (128 to ~28k, 64 to ~57k).
+    work); narrower panels extend the reachable n (128 to ~20k, 64 to ~30k,
+    per the calibrated working-set model above). Beyond that no panel fits
+    the VMEM kernel; 64 is returned anyway and panel-impl resolution falls
+    back to the stock-JAX panel path, which has no VMEM ceiling (on one
+    v5e chip HBM binds first anyway, around n~33k f32 — see
+    fits_single_chip / solve_handoff for the size routing).
     Every factorization entry point resolves panel=None through this.
     """
     if n < 1024:
         return DEFAULT_PANEL  # crossover heuristic; VMEM is never binding
     for panel in (256, 128, 64):
-        npad = -(-n // panel) * panel
-        if panel * npad * itemsize <= PANEL_VMEM_BUDGET:
+        if panel_fits_vmem(n, panel, itemsize):
             return panel
-    raise ValueError(
-        f"n={n} exceeds the single-kernel panel budget even at panel=64; "
-        "shard the problem (dist engines) instead")
+    return 64
 
 
 def _resolve_panel(n: int, panel, itemsize: int = 4) -> int:
@@ -203,12 +216,19 @@ def _panel_factor_jax(p: jax.Array, kb):
     return lax.fori_loop(0, panel, step, (p, ipiv0, minpiv0))
 
 
-def _resolve_panel_impl(panel_impl):
+def _resolve_panel_impl(panel_impl, n: int | None = None,
+                        panel: int | None = None, itemsize: int = 4):
     if panel_impl == "auto":
-        # The Pallas VMEM-resident panel kernel uses TPU-only Mosaic features;
-        # it is the fast path on real TPUs and stock JAX everywhere else
-        # (CPU test mesh, GPU).
-        return "pallas" if jax.default_backend() == "tpu" else "jax"
+        # The Pallas VMEM-resident panel kernel uses TPU-only Mosaic
+        # features; it is the fast path on real TPUs — when its block fits
+        # VMEM — and stock JAX everywhere else (CPU test mesh, GPU) and
+        # beyond the ~57k VMEM ceiling (slower per panel but unlimited).
+        if jax.default_backend() != "tpu":
+            return "jax"
+        if (n is not None and panel is not None
+                and not panel_fits_vmem(n, panel, itemsize)):
+            return "jax"
+        return "pallas"
     if panel_impl not in ("jax", "pallas"):
         raise ValueError(f"unknown panel_impl {panel_impl!r}")
     return panel_impl
@@ -294,7 +314,6 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
-    panel_impl = _resolve_panel_impl(panel_impl)
     gemm_prec = resolve_precision(gemm_precision)
     if swap_impl not in ("gather", "loop"):
         raise ValueError(f"unknown swap_impl {swap_impl!r}; options: ('gather', 'loop')")
@@ -302,7 +321,9 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
-    panel = _resolve_panel(n, panel, jnp.dtype(a.dtype).itemsize)
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = _resolve_panel(n, panel, itemsize)
+    panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
@@ -375,13 +396,14 @@ def lu_factor_blocked_unrolled(a: jax.Array,
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
-    panel_impl = _resolve_panel_impl(panel_impl)
     gemm_prec = resolve_precision(gemm_precision)
     a = jnp.asarray(a)
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
-    panel = _resolve_panel(n, panel, jnp.dtype(a.dtype).itemsize)
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = _resolve_panel(n, panel, itemsize)
+    panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     dtype = m.dtype
@@ -528,7 +550,6 @@ def lu_factor_blocked_chunked(a: jax.Array,
     """
     from gauss_tpu.core.matmul import resolve_precision
 
-    panel_impl = _resolve_panel_impl(panel_impl)
     gemm_prec = resolve_precision(gemm_precision)
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -536,7 +557,9 @@ def lu_factor_blocked_chunked(a: jax.Array,
     n = a.shape[0]
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
-    panel = _resolve_panel(n, panel, jnp.dtype(a.dtype).itemsize)
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = _resolve_panel(n, panel, itemsize)
+    panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
@@ -590,6 +613,10 @@ def lu_factor_blocked_chunked(a: jax.Array,
 
 
 UNROLL_MAX_N = 4096  # above this, full unroll costs too much compile payload
+# Above this many trace-time groups even the chunked form's compile payload
+# overwhelms the tunneled remote compiler (observed: 96 groups at n=24576,
+# panel=64 never finished in 590 s; 35 groups at n=17758 compile fine).
+MAX_CHUNK_GROUPS = 40
 
 
 def resolve_factor(n: int, unroll):
@@ -597,12 +624,20 @@ def resolve_factor(n: int, unroll):
     unrolled on TPU up to UNROLL_MAX_N (true triangular work; measured
     6.1 -> 3.9 ms at n=2048 on v5e), group-chunked above it (triangular at
     group granularity, bounded compile payload; 121 -> 59 ms at n=8192),
-    and the flat fori_loop on CPU (compile time matters more than FLOPs
-    there). True/False force unrolled/fori; "chunked" forces the middle."""
+    the flat fori_loop once the chunked group count would exceed
+    MAX_CHUNK_GROUPS (one traced program, predictable compile — n=24576
+    factorizes in one ~6 min compile then re-solves from factors in
+    ~0.15 s), and the flat fori_loop on CPU (compile time matters more than
+    FLOPs there). True/False force unrolled/fori; "chunked" forces the
+    middle."""
     if unroll == "auto":
         if jax.default_backend() != "tpu":
             return lu_factor_blocked
         if n > UNROLL_MAX_N:
+            panel = auto_panel(n)
+            npad = -(-n // panel) * panel
+            if npad // (panel * CHUNK_DEFAULT) > MAX_CHUNK_GROUPS:
+                return lu_factor_blocked
             return lu_factor_blocked_chunked
         return lu_factor_blocked_unrolled
     if unroll == "chunked":
@@ -668,3 +703,64 @@ def solve_refined(a: np.ndarray, b: np.ndarray, panel: int | None = None,
         d = np.asarray(lu_solve(fac, jnp.asarray(r, dtype=dtype)), dtype=np.float64)
         x = x + d
     return x, fac
+
+
+# Conservative usable HBM per chip when the runtime cannot report it
+# (v5e ships 16 GiB; the runtime, compiled executables, and transients
+# take a slice).
+DEFAULT_CHIP_BYTES = 13 * 2**30
+
+
+def device_memory_budget() -> int:
+    """Usable bytes on the first visible device (runtime-reported when
+    available, conservative v5e-class constant otherwise)."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(0.85 * stats["bytes_limit"])
+    except Exception:
+        pass
+    return DEFAULT_CHIP_BYTES
+
+
+def fits_single_chip(n: int, itemsize: int = 4,
+                     budget: int | None = None) -> bool:
+    """Whether a blocked factorization's working set fits one device.
+
+    Peak residency ~3 matrix copies (operand, factor-in-progress with its
+    donated double-buffer, and slice/update transients); the diagonal-block
+    inverses are nb * panel^2, negligible beside them.
+    """
+    budget = device_memory_budget() if budget is None else budget
+    return 3 * n * n * itemsize <= budget
+
+
+def solve_handoff(a, b, budget: int | None = None,
+                  mesh=None, **refine_kwargs):
+    """Size-routed solve (VERDICT round 1 #8): the single-chip refined path
+    while the working set fits one device, the sharded blocked engine
+    (dist.gauss_dist_blocked) over the mesh beyond it. Returns x float64.
+
+    The single-chip ceiling this lifts: the f32 blocked path fits one v5e
+    chip to n ~ 33k (HBM-bound; the Pallas panel kernel's own VMEM ceiling
+    at ~57k no longer raises — panel-impl resolution falls back to the
+    stock-JAX panel beyond it). Past the budget the solve needs the sharded
+    engine's aggregate memory; with no multi-device mesh available that is
+    an explicit error, not an OOM.
+    """
+    n = np.shape(a)[0]
+    if fits_single_chip(n, budget=budget):
+        return solve_refined(a, b, **refine_kwargs)[0]
+    from gauss_tpu.dist.gauss_dist_blocked import gauss_solve_dist_blocked
+    from gauss_tpu.dist.mesh import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    if mesh.devices.size < 2:
+        eff = budget if budget is not None else device_memory_budget()
+        raise ValueError(
+            f"n={n} exceeds the single-chip budget (needs ~{3 * n * n * 4} "
+            f"bytes, budget {eff}) and only {mesh.devices.size} device is "
+            f"visible; provide a multi-device mesh (the sharded blocked "
+            f"engine splits the working set across chips)")
+    return np.asarray(gauss_solve_dist_blocked(a, b, mesh=mesh), np.float64)

@@ -219,7 +219,8 @@ def gauss_solve_rowelim_batched(a: jax.Array, b: jax.Array, *, k: int = 128,
     zero = jnp.zeros((), dtype)
     eye_k = jnp.eye(k, dtype=dtype)
     nb = npad // k
-    panel_impl_resolved = _resolve_panel_impl(panel_impl)
+    panel_impl_resolved = _resolve_panel_impl(
+        panel_impl, npad, k, jnp.dtype(dtype).itemsize)
 
     def group(g, carry):
         m, uinvs = carry

@@ -278,7 +278,7 @@ def test_chunked_rejects_bad_chunk():
 
 
 def test_auto_panel_vmem_budget():
-    from gauss_tpu.core.blocked import PANEL_VMEM_BUDGET, auto_panel
+    from gauss_tpu.core.blocked import auto_panel
 
     assert auto_panel(2048) == 256
     # panel=None resolves through auto_panel at every entry point
@@ -288,13 +288,17 @@ def test_auto_panel_vmem_budget():
     assert fac.linv.shape[1] == 128 or fac.m.shape[0] == 128
     assert auto_panel(512) == 128          # below the 1024 crossover
     assert auto_panel(17758) == 128        # 256 would blow the kernel VMEM
-    assert auto_panel(40000) == 64
-    with pytest.raises(ValueError, match="dist engines"):
-        auto_panel(60000)
-    for n in (100, 1024, 17758, 40000):
-        p = auto_panel(n)
-        npad = -(-n // p) * p
-        assert p * npad * 4 <= PANEL_VMEM_BUDGET
+    assert auto_panel(24576) == 64
+    # Beyond the VMEM ceiling auto_panel no longer raises (VERDICT r1 #8):
+    # 64 comes back as the fallthrough and the panel impl resolves to the
+    # stock-JAX path (panel_fits_vmem is the calibrated working-set model).
+    from gauss_tpu.core.blocked import panel_fits_vmem
+
+    for n in (40000, 60000):
+        assert auto_panel(n) == 64
+        assert not panel_fits_vmem(n, 64)
+    for n in (100, 1024, 17758, 24576):
+        assert panel_fits_vmem(n, auto_panel(n))
 
 
 def test_lu_solve_substitution_method(rng):
@@ -316,3 +320,71 @@ def test_lu_solve_substitution_method(rng):
     np.testing.assert_allclose(x_sub, ref, rtol=1e-9, atol=1e-9)
     with pytest.raises(ValueError):
         blocked.lu_solve(fac, jnp.asarray(b), method="bogus")
+
+
+def test_auto_panel_no_ceiling():
+    """auto_panel must not raise beyond the VMEM ceiling (VERDICT r1 #8):
+    it returns 64 and panel-impl resolution falls back to the stock-JAX
+    panel, which has no VMEM limit."""
+    from gauss_tpu.core import blocked
+
+    assert blocked.auto_panel(65536) == 64
+    assert not blocked.panel_fits_vmem(65536, 64)
+    assert blocked.panel_fits_vmem(2048, 256)
+
+
+def test_resolve_panel_impl_vmem_fallback(monkeypatch):
+    import jax
+
+    from gauss_tpu.core import blocked
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert blocked._resolve_panel_impl("auto", 2048, 256) == "pallas"
+    assert blocked._resolve_panel_impl("auto", 65536, 64) == "jax"
+    # Explicit requests are never overridden.
+    assert blocked._resolve_panel_impl("pallas", 65536, 64) == "pallas"
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert blocked._resolve_panel_impl("auto", 2048, 256) == "jax"
+
+
+def test_solve_handoff_routes_by_size(rng):
+    """Tiny budget forces the handoff to the sharded blocked engine on the
+    CPU mesh; a fitting budget keeps the single-chip refined path."""
+    from gauss_tpu.core import blocked
+
+    n = 96
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    x_true = rng.standard_normal(n)
+    b = a @ x_true
+
+    x = blocked.solve_handoff(a, b, budget=2**40)  # fits: refined path
+    np.testing.assert_allclose(x, x_true, rtol=1e-8, atol=1e-8)
+
+    x = blocked.solve_handoff(a, b, budget=1024)   # handoff: sharded engine
+    np.testing.assert_allclose(x, x_true, rtol=1e-4, atol=1e-4)
+
+
+def test_solve_handoff_single_device_error():
+    from gauss_tpu.core import blocked
+    from gauss_tpu.dist.mesh import make_mesh
+
+    a = np.eye(8)
+    b = np.zeros(8)
+    with pytest.raises(ValueError, match="single-chip budget"):
+        blocked.solve_handoff(a, b, budget=16, mesh=make_mesh(1))
+
+
+def test_resolve_factor_policy(monkeypatch):
+    """Size policy incl. the large-n compile-payload fallback (r2): chunked
+    group counts beyond MAX_CHUNK_GROUPS route to the flat fori program."""
+    import jax
+
+    from gauss_tpu.core import blocked
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert blocked.resolve_factor(2048, "auto") is blocked.lu_factor_blocked_unrolled
+    assert blocked.resolve_factor(8192, "auto") is blocked.lu_factor_blocked_chunked
+    assert blocked.resolve_factor(17758, "auto") is blocked.lu_factor_blocked_chunked
+    assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
