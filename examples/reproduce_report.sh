@@ -1,6 +1,9 @@
 #!/usr/bin/env bash
 # Reproduce reports/REPORT.md and graphs/ from scratch (run on the TPU host;
-# the full sweep takes ~20-30 min behind a tunneled dev chip).
+# the full sweep takes ~30-45 min behind a tunneled dev chip). External
+# suites read the REAL reference matrices in place when a checkout exists
+# (GAUSS_TPU_REFERENCE_ROOT, default /root/reference) and fall back to the
+# deterministic stand-ins otherwise; every cell records which one ran.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -9,17 +12,28 @@ python -m gauss_tpu.bench.grid --suite gauss-internal \
     --json /tmp/gi.json
 python -m gauss_tpu.bench.grid --suite gauss-internal --backends tpu \
     --span device --json /tmp/gid.json
+python -m gauss_tpu.bench.grid --suite gauss-internal \
+    --keys 512,1024,2048,4096 --backends tpu-rowelim --span device \
+    --json /tmp/gir.json
 python -m gauss_tpu.bench.grid --suite gauss-external --backends tpu,seq,omp \
+    --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3 \
     --json /tmp/ge.json
+python -m gauss_tpu.bench.grid --suite gauss-external --keys memplus \
+    --backends tpu --json /tmp/gem.json
 python -m gauss_tpu.bench.grid --suite gauss-external --backends tpu \
     --span device --json /tmp/ged.json
 python -m gauss_tpu.bench.grid --suite matmul \
     --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp --json /tmp/mm.json
 python -m gauss_tpu.bench.grid --suite matmul \
     --backends tpu,tpu-pallas,tpu-pallas-v1 --span device --json /tmp/mmd.json
+# The distributed shard sweep runs on a forced virtual CPU mesh and MUST be
+# its own process (the forced device count latches at backend init).
+JAX_PLATFORMS=cpu python -m gauss_tpu.bench.grid --suite gauss-dist \
+    --json /tmp/gdist.json
 
-python -m gauss_tpu.bench.report /tmp/gi.json /tmp/gid.json /tmp/ge.json \
-    /tmp/ged.json /tmp/mm.json /tmp/mmd.json \
+python -m gauss_tpu.bench.report /tmp/gi.json /tmp/gid.json /tmp/gir.json \
+    /tmp/ge.json /tmp/gem.json /tmp/ged.json /tmp/mm.json /tmp/mmd.json \
+    /tmp/gdist.json \
     --title "gauss-tpu benchmark report" --out reports/REPORT.md --profile 1024
 python -m gauss_tpu.bench.plots /tmp/gi.json /tmp/gid.json /tmp/mmd.json \
     --outdir graphs

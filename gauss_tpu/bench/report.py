@@ -34,6 +34,8 @@ SUITE_TITLES = {
     "gauss-internal": "Gaussian elimination — internal (synthetic) input",
     "gauss-external": "Gaussian elimination — external (.dat file) input",
     "matmul": "Dense matrix multiplication",
+    "gauss-dist": "Gaussian elimination — distributed engines "
+                  "(shard sweep, virtual CPU mesh — NOT ICI)",
 }
 
 # Verification semantics per suite (the reference's scattered checks,
@@ -44,6 +46,10 @@ SUITE_CHECKS = {
                       "(X__[i] = i+1, R = A.X__)",
     "matmul": "scaled elementwise epsilon comparison vs float64 host truth, "
               "eps = 1e-4",
+    "gauss-dist": "absolute residual ||Ax - b||_2 < 1e-4 (cells run on a "
+                  "forced virtual CPU mesh: scaling shape and correctness, "
+                  "NOT an ICI measurement; the reference comparator is the "
+                  "best 6-node Distributed-MPI cell per size)",
 }
 
 
