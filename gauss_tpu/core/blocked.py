@@ -735,11 +735,21 @@ def fits_single_chip(n: int, itemsize: int = 4,
     return 3 * n * n * itemsize <= budget
 
 
-def solve_handoff(a, b, budget: int | None = None,
-                  mesh=None, **refine_kwargs):
+def solve_handoff(a, b, budget: int | None = None, mesh=None,
+                  panel: int | None = None, iters: int = 2, tol: float = 0.0,
+                  **single_chip_kwargs):
     """Size-routed solve (VERDICT round 1 #8): the single-chip refined path
     while the working set fits one device, the sharded blocked engine
-    (dist.gauss_dist_blocked) over the mesh beyond it. Returns x float64.
+    (dist.gauss_dist_blocked) over the mesh beyond it. Returns x float64,
+    refined on BOTH routes (ADVICE round 2: the distributed route used to
+    return the raw f32 solution, a silent accuracy cliff at the routing
+    boundary — it now runs the same host-f64 iterative refinement through
+    the distributed factors, O(n^2) per step).
+
+    ``panel``/``iters``/``tol`` are honored on both routes;
+    ``single_chip_kwargs`` (panel_impl, unroll, dtype, a_dev/b_dev — see
+    :func:`solve_refined`) only apply below the budget, and passing any past
+    it raises rather than silently ignoring the request.
 
     The single-chip ceiling this lifts: the f32 blocked path fits one v5e
     chip to n ~ 33k (HBM-bound; the Pallas panel kernel's own VMEM ceiling
@@ -750,10 +760,16 @@ def solve_handoff(a, b, budget: int | None = None,
     """
     n = np.shape(a)[0]
     if fits_single_chip(n, budget=budget):
-        return solve_refined(a, b, **refine_kwargs)[0]
-    from gauss_tpu.dist.gauss_dist_blocked import gauss_solve_dist_blocked
+        return solve_refined(a, b, panel=panel, iters=iters, tol=tol,
+                             **single_chip_kwargs)[0]
+    from gauss_tpu.dist.gauss_dist_blocked import \
+        gauss_solve_dist_blocked_refined
     from gauss_tpu.dist.mesh import make_mesh
 
+    if single_chip_kwargs:
+        raise ValueError(
+            f"n={n} exceeds the single-chip budget and these options do not "
+            f"apply to the distributed route: {sorted(single_chip_kwargs)}")
     if mesh is None:
         mesh = make_mesh()
     if mesh.devices.size < 2:
@@ -763,4 +779,5 @@ def solve_handoff(a, b, budget: int | None = None,
             f"bytes, budget {eff}) and only {mesh.devices.size} device is "
             f"visible; provide a multi-device mesh (the sharded blocked "
             f"engine splits the working set across chips)")
-    return np.asarray(gauss_solve_dist_blocked(a, b, mesh=mesh), np.float64)
+    return gauss_solve_dist_blocked_refined(a, b, mesh=mesh, panel=panel,
+                                            iters=iters, tol=tol)

@@ -73,8 +73,21 @@ def to_ds(a, dtype=jnp.float32) -> DS:
     together they carry the f64 value to ~2^-48 relative, enough that the
     original external-input matrices (parsed in f64) lose nothing that a
     1e-4 verification bar could see.
+
+    Precondition: |a| must be comfortably inside float32 range
+    (|a| < ~3.4e38, and in practice < ~1.7e38 so :func:`_split`'s
+    round-half-up integer add cannot carry the exponent to inf). Outside it
+    hi overflows to inf and lo to -inf, NaN-poisoning every downstream
+    combination. Asserted here on the host operand — none of the reference
+    matrices comes near the bound, but this module is general-purpose and a
+    silent NaN residual would masquerade as a refinement failure.
     """
     a = np.asarray(a, np.float64)
+    if a.size and float(np.max(np.abs(a))) >= 1.7e38:
+        raise ValueError(
+            "to_ds operand exceeds the double-single representable range "
+            f"(max |a| = {float(np.max(np.abs(a))):.3e} >= 1.7e38); the f32 "
+            "hi part would overflow to inf and NaN-poison residuals")
     hi = a.astype(np.float32)
     lo = (a - hi.astype(np.float64)).astype(np.float32)
     return DS(jnp.asarray(hi, dtype), jnp.asarray(lo, dtype))
@@ -278,12 +291,16 @@ DS_REFINE_STEPS = 6
 
 
 def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
-                  iters: int = DS_REFINE_STEPS, unroll="auto") -> DS:
+                  iters: int = DS_REFINE_STEPS,
+                  unroll="auto") -> "tuple[DS, object]":
     """One jittable f32 factor + solve + double-single refinement pass.
 
     ``a`` is the f32 matrix (factor operand); ``at_ds``/``b_ds`` the
-    double-single transposed matrix and RHS (residual operands). The single
-    assembly point shared by :func:`solve_ds` and the bench timing chain
+    double-single transposed matrix and RHS (residual operands). Returns
+    ``(x_ds, factors)`` — the refined double-single solution and the
+    :class:`gauss_tpu.core.blocked.BlockedLU` it solved through, so callers
+    can reuse the factorization for further solves. The single assembly
+    point shared by :func:`solve_ds` and the bench timing chain
     (bench.slope.gauss_solve_once_ds) — what gets timed is exactly what
     gets verified.
     """

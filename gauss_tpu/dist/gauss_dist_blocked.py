@@ -115,7 +115,7 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
         zero = jnp.zeros((), dtype)
 
         def panel_step(carry, k):
-            A, min_piv = carry
+            A, min_piv, gperm = carry
             kb = k * panel
             own_k = (k % nshards) == d          # owner of diagonal block k
             lb = (k // nshards) * panel         # its local row offset there
@@ -130,6 +130,10 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
             pfac, ipiv, mp = _panel_factor_jax(strip, kb)
             min_piv = jnp.minimum(min_piv, mp)
             perm_g = _fold_transpositions(ipiv, kb, npad, panel)
+            # Composed P of PA = LU (replicated — every shard derives the
+            # same pivots), returned so factored solves can permute new
+            # right-hand sides.
+            gperm = gperm[perm_g]
             src = lax.dynamic_slice(perm_g, (kb,), (panel,))  # incoming rows
 
             # --- ONE routing psum: incoming pivot rows + displaced diagonal
@@ -178,44 +182,108 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
             below = g_loc >= kb + panel
             f_own = jnp.where(below[:, None], strip_mine, zero)
             A = A - jnp.dot(f_own, u12, precision=lax.Precision.HIGHEST)
-            return (A, min_piv), k
+            return (A, min_piv, gperm), k
 
         # min_piv init inherits a_loc's varying type (shard_map vma);
         # NaN-proof zero via the integer domain (int x * 0 is always 0).
-        vma0 = (a_loc[0, 0].astype(jnp.int32) * 0).astype(dtype)
-        (A, min_piv), _ = lax.scan(
-            panel_step, (a_loc, jnp.asarray(jnp.inf, dtype) + vma0),
+        vma0i = a_loc[0, 0].astype(jnp.int32) * 0
+        vma0 = vma0i.astype(dtype)
+        (A, min_piv, gperm), _ = lax.scan(
+            panel_step, (a_loc, jnp.asarray(jnp.inf, dtype) + vma0,
+                         jnp.arange(npad) + vma0i),
             jnp.arange(nblocks))
 
-        # --- blockwise back-substitution: one psum per block ---
-        def back_step(x, k):
-            kb = k * panel
-            own_k = (k % nshards) == d
-            lb = (k // nshards) * panel
-            rows = lax.dynamic_slice(A, (lb, 0), (panel, w))
-            # x is nonzero only for solved suffix columns (> kb+panel-1), so
-            # the full-width dot picks up exactly U_{k,>k} @ x_{>k}.
-            r = rows[:, npad] - rows[:, :npad] @ x
-            ukk = lax.dynamic_slice(rows, (0, kb), (panel, panel))
-            rows_p = jnp.arange(panel)
-            umask = rows_p[:, None] <= rows_p[None, :]
-            ukk = jnp.where(umask, ukk, zero)
-            xk = lax.linalg.triangular_solve(
-                ukk, r[:, None], left_side=True, lower=False)[:, 0]
-            xk = lax.psum(jnp.where(own_k, xk, zero), axis)
-            return lax.dynamic_update_slice(x, xk, (kb,)), k
-
-        x, _ = lax.scan(back_step, jnp.zeros((npad,), dtype),
-                        jnp.arange(nblocks - 1, -1, -1))
-        # min_piv is numerically identical on every shard (replicated panel
-        # factorization) but typed varying; one scalar pmin makes the
-        # replication provable for out_specs.
-        return x, lax.pmin(min_piv, axis)
+        # --- blockwise back-substitution: one psum per block. The RHS was
+        # eliminated in place as the augmented column (L already applied),
+        # so only the U substitution remains. ---
+        x = _block_substitution(A, lambda rows, kb: rows[:, npad],
+                                axis, d, npad, panel, nshards, lower=False)
+        # min_piv and gperm are numerically identical on every shard
+        # (replicated panel factorization) but typed varying; a pmin makes
+        # the replication provable for out_specs.
+        return (x, A, lax.pmin(gperm, axis), lax.pmin(min_piv, axis))
 
     mapped = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(axis, None),),
-        out_specs=(P(None), P()))
+        out_specs=(P(None), P(axis, None), P(None), P()))
+    return jax.jit(mapped)
+
+
+def _block_substitution(A_loc, rhs_block, axis, d, npad: int, panel: int,
+                        nshards: int, lower: bool):
+    """Blockwise triangular substitution over the distributed getrf factor:
+    per block, the full-width dot folds in the already-solved blocks (the
+    unsolved suffix/prefix multiplies zeros), the owner solves its
+    (panel, panel) diagonal system, and one psum broadcasts the block.
+    ``rhs_block(rows, kb)`` supplies the block's right-hand side — the spent
+    augmented column at factor time, a fresh vector at re-solve time — so
+    factor-time and resolve-time substitution cannot drift apart.
+    ``lower`` selects L (unit-diagonal, ascending) vs U (descending)."""
+    w = npad + 1
+    dtype = A_loc.dtype
+    zero = jnp.zeros((), dtype)
+    rows_p = jnp.arange(panel)
+    nblocks = npad // panel
+
+    def step(x, k):
+        kb = k * panel
+        own_k = (k % nshards) == d
+        lb = (k // nshards) * panel
+        rows = lax.dynamic_slice(A_loc, (lb, 0), (panel, w))
+        r_k = rhs_block(rows, kb) - rows[:, :npad] @ x
+        dkk = lax.dynamic_slice(rows, (0, kb), (panel, panel))
+        if lower:
+            # unit_diagonal=True ignores the stored diagonal (U's), so only
+            # the strictly-lower multipliers need keeping.
+            dkk = jnp.where(rows_p[:, None] > rows_p[None, :], dkk, zero)
+            xk = lax.linalg.triangular_solve(
+                dkk, r_k[:, None], left_side=True, lower=True,
+                unit_diagonal=True)[:, 0]
+        else:
+            dkk = jnp.where(rows_p[:, None] <= rows_p[None, :], dkk, zero)
+            xk = lax.linalg.triangular_solve(
+                dkk, r_k[:, None], left_side=True, lower=False)[:, 0]
+        xk = lax.psum(jnp.where(own_k, xk, zero), axis)
+        return lax.dynamic_update_slice(x, xk, (kb,)), k
+
+    order = (jnp.arange(nblocks) if lower
+             else jnp.arange(nblocks - 1, -1, -1))
+    x, _ = lax.scan(step, jnp.zeros((npad,), dtype), order)
+    return x
+
+
+@lru_cache(maxsize=32)
+def _build_resolver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
+                            dtype_name: str):
+    """Distributed solve from an already-factored system: given the factored
+    block-cyclic local rows (L multipliers below the diagonal, U on/above —
+    getrf layout, plus the spent RHS column which is ignored), the composed
+    row permutation, and a NEW right-hand side, run blockwise forward and
+    back substitution with one psum per block each way. O(n^2) work and
+    2 * n/panel collectives per solve — the cheap correction step that lets
+    iterative refinement run against ONE distributed factorization (ADVICE
+    round 2: the handoff's distributed route must refine too)."""
+    axis = mesh.axis_names[0]
+    nshards = mesh.devices.shape[0]
+
+    def shard_fn(a_loc, perm, r):
+        d = lax.axis_index(axis)
+        rp = r[perm]
+        # Forward: y = L^-1 (P r); y is nonzero only for solved prefix
+        # blocks, so the full-width dot picks up exactly the L_{k,<k} term.
+        y = _block_substitution(
+            a_loc, lambda rows, kb: lax.dynamic_slice(rp, (kb,), (panel,)),
+            axis, d, npad, panel, nshards, lower=True)
+        # Backward: x = U^-1 y.
+        return _block_substitution(
+            a_loc, lambda rows, kb: lax.dynamic_slice(y, (kb,), (panel,)),
+            axis, d, npad, panel, nshards, lower=False)
+
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(axis, None), P(None), P(None)),
+        out_specs=P(None))
     return jax.jit(mapped)
 
 
@@ -256,8 +324,75 @@ def prepare_dist_blocked(a, b, mesh: jax.sharding.Mesh,
 def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     a_c, n, npad, panel = staged
     solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
-    x, _ = solver(a_c)
+    x, *_ = solver(a_c)
     return x[:n]
+
+
+class DistBlockedLU:
+    """A factored distributed system: the sharded getrf-layout rows, the
+    composed row permutation, and the geometry needed to solve against it.
+    Produced by :func:`factor_solve_dist_blocked_staged`; consumed by
+    :func:`lu_solve_dist_blocked` — one distributed factorization, many
+    O(n^2) solves (the same getrf/getrs split the single-chip path has)."""
+
+    def __init__(self, a_fac, perm, min_piv, n, npad, panel, mesh):
+        self.a_fac, self.perm, self.min_piv = a_fac, perm, min_piv
+        self.n, self.npad, self.panel, self.mesh = n, npad, panel, mesh
+
+
+def factor_solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh):
+    """Factor + solve a staged system; returns (x, DistBlockedLU)."""
+    a_c, n, npad, panel = staged
+    solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
+    x, a_fac, perm, min_piv = solver(a_c)
+    return x[:n], DistBlockedLU(a_fac, perm, min_piv, n, npad, panel, mesh)
+
+
+def lu_solve_dist_blocked(fac: DistBlockedLU, r) -> jax.Array:
+    """Solve A d = r against an existing distributed factorization: blockwise
+    forward + back substitution, 2 psums per block, O(n^2) work."""
+    mesh = fac.mesh
+    axis = mesh.axis_names[0]
+    dtype = np.dtype(str(fac.a_fac.dtype))
+    rpad = np.zeros(fac.npad, dtype)
+    rpad[:fac.n] = np.asarray(r, dtype)
+    r_dev = jax.device_put(rpad, NamedSharding(mesh, P(None)))
+    resolver = _build_resolver_blocked(mesh, fac.npad, fac.panel,
+                                       str(fac.a_fac.dtype))
+    return resolver(fac.a_fac, fac.perm, r_dev)[:fac.n]
+
+
+def gauss_solve_dist_blocked_refined(a, b, mesh: jax.sharding.Mesh = None,
+                                     panel: int | None = None,
+                                     iters: int = 2,
+                                     tol: float = 0.0) -> np.ndarray:
+    """Distributed blocked solve + host-f64 iterative refinement; returns
+    x float64.
+
+    The distributed sibling of core.blocked.solve_refined (ADVICE round 2:
+    solve_handoff's past-the-budget route must not silently drop refinement):
+    one f32 distributed factorization, then per iteration an O(n^2) host-f64
+    residual and an O(n^2) distributed correction solve through the SAME
+    factors (:func:`lu_solve_dist_blocked`) — no refactorization.
+
+    ``tol``: same early-stop contract as solve_refined — stop once
+    ``||Ax - b||_2 <= tol * min(1, ||b||_2)``; 0.0 runs exactly ``iters``.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    staged = prepare_dist_blocked(a64.astype(np.float32),
+                                  b64.astype(np.float32), mesh, panel=panel)
+    x0, fac = factor_solve_dist_blocked_staged(staged, mesh)
+    x = np.asarray(x0, np.float64)
+    tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
+            break
+        x = x + np.asarray(lu_solve_dist_blocked(fac, r), np.float64)
+    return x
 
 
 def gauss_solve_dist_blocked(a, b, mesh: jax.sharding.Mesh = None,

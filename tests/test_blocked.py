@@ -360,8 +360,12 @@ def test_solve_handoff_routes_by_size(rng):
     x = blocked.solve_handoff(a, b, budget=2**40)  # fits: refined path
     np.testing.assert_allclose(x, x_true, rtol=1e-8, atol=1e-8)
 
-    x = blocked.solve_handoff(a, b, budget=1024)   # handoff: sharded engine
-    np.testing.assert_allclose(x, x_true, rtol=1e-4, atol=1e-4)
+    # Past the budget: the sharded engine, now REFINED (ADVICE round 2 —
+    # the raw f32 distributed solution would only reach ~1e-4 here; host-f64
+    # refinement through the distributed factors restores f64-grade accuracy,
+    # so the contract no longer degrades at the routing boundary).
+    x = blocked.solve_handoff(a, b, budget=1024)
+    np.testing.assert_allclose(x, x_true, rtol=1e-8, atol=1e-8)
 
 
 def test_solve_handoff_single_device_error():
