@@ -76,6 +76,7 @@ BACKEND_CLASS: Dict[str, str] = {
     "tpu-dist": "mpi",
     "tpu-dist2d": "mpi",
     "tpu-dist-blocked": "mpi",
+    "tpu-dist-blocked2d": "mpi",
     "tpu": "openmp",
     "tpu-unblocked": "seq",
     "tpu-rowelim": "openmp",

@@ -39,7 +39,8 @@ SUITES = ("gauss-internal", "gauss-external", "matmul")
 EXTRA_SUITES = ("gauss-dist",)
 RESIDUAL_BAR = 1e-4  # BASELINE.json acceptance bar
 
-DIST_BACKENDS = ("tpu-dist", "tpu-dist2d", "tpu-dist-blocked")
+DIST_BACKENDS = ("tpu-dist", "tpu-dist2d", "tpu-dist-blocked",
+                 "tpu-dist-blocked2d")
 DIST_SHARD_SWEEP = (2, 4, 8)   # reference sweep is mpirun -np {2,16,32,70}
 DIST_NOTE = "virtual CPU mesh (scaling shape + correctness; NOT ICI)"
 
@@ -331,6 +332,13 @@ def _run_gauss_dist(ctx, n: int, backend: str, shards: int,
         mesh = make_mesh(shards, devices=devs)
         staged = eng.prepare_dist_blocked(a32, b32, mesh)
         solve = lambda: eng.solve_dist_blocked_staged(staged, mesh)  # noqa: E731
+    elif backend == "tpu-dist-blocked2d":
+        from gauss_tpu.dist import gauss_dist_blocked2d as eng
+        from gauss_tpu.dist.mesh import make_mesh_2d_auto
+
+        mesh = make_mesh_2d_auto(shards, devices=devs)
+        staged = eng.prepare_dist_blocked2d(a32, b32, mesh)
+        solve = lambda: eng.solve_dist_blocked2d_staged(staged, mesh)  # noqa: E731
     else:
         raise ValueError(f"backend {backend!r} is not a distributed engine; "
                          f"options: {DIST_BACKENDS}")

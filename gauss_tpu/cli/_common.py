@@ -20,6 +20,10 @@ Backend taxonomy (maps the reference's 12-binary grid onto one flag):
     tpu-dist-blocked  panel-blocked distributed factorization (collectives
                   per panel, local MXU trailing GEMMs — the formulation
                   that scales; dist.gauss_dist_blocked); -t as tpu-dist
+    tpu-dist-blocked2d  2-D panel-blocked factorization (tournament
+                  pivoting, per-chip strip traffic O(n^2/R + n^2/C) — the
+                  pod-scale shape; dist.gauss_dist_blocked2d); -t as
+                  tpu-dist2d
     seq|omp|threads|forkjoin|tiled  native C++ host engines (reference CPU
                   baselines: sequential, OpenMP C4, persistent-pool C3,
                   fork-join-per-step C1, cache-tiled C2)
@@ -44,8 +48,9 @@ import numpy as np
 from gauss_tpu.utils.timing import timed_fetch
 
 GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-rowelim-step",
-                  "tpu-dist", "tpu-dist2d", "tpu-dist-blocked", "seq", "omp",
-                  "threads", "forkjoin", "tiled")
+                  "tpu-dist", "tpu-dist2d", "tpu-dist-blocked",
+                  "tpu-dist-blocked2d", "seq", "omp", "threads", "forkjoin",
+                  "tiled")
 MATMUL_BACKENDS = ("tpu", "tpu-pallas", "tpu-pallas-v1", "tpu-dist", "seq", "omp")
 
 
@@ -154,6 +159,17 @@ def _solve_tpu_dist_blocked(a64, b64, nthreads):
         lambda staged: gdb.solve_dist_blocked_staged(staged, mesh))
 
 
+def _solve_tpu_dist_blocked2d(a64, b64, nthreads):
+    from gauss_tpu.dist import gauss_dist_blocked2d as g2d
+    from gauss_tpu.dist.mesh import make_mesh_2d_auto
+
+    mesh = make_mesh_2d_auto(_dist_device_count(nthreads))
+    return _solve_dist_generic(
+        a64, b64,
+        lambda a, b: g2d.prepare_dist_blocked2d(a, b, mesh),
+        lambda staged: g2d.solve_dist_blocked2d_staged(staged, mesh))
+
+
 def _solve_tpu_rowelim(a64, b64, batched: bool = True):
     import jax.numpy as jnp
 
@@ -204,6 +220,8 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
         return _solve_tpu_dist2d(a64, b64, nthreads)
     if backend == "tpu-dist-blocked":
         return _solve_tpu_dist_blocked(a64, b64, nthreads)
+    if backend == "tpu-dist-blocked2d":
+        return _solve_tpu_dist_blocked2d(a64, b64, nthreads)
     if backend == "tpu-rowelim":
         return _solve_tpu_rowelim(a64, b64)
     if backend == "tpu-rowelim-step":

@@ -168,7 +168,7 @@ def _pad_to_panel(a: jax.Array, panel: int) -> jax.Array:
     return out.at[jnp.arange(n, npad), jnp.arange(n, npad)].set(jnp.asarray(1.0, a.dtype))
 
 
-def _panel_factor_jax(p: jax.Array, kb):
+def _panel_factor_jax(p: jax.Array, kb, zero_pivot_safe: bool = False):
     """Unblocked partial-pivot elimination of one (h, panel) column block whose
     diagonal lives at row offset ``kb`` within the block (stock-JAX analog of
     kernels.panel_pallas; single source of the pivot/NaN-as-singular policy).
@@ -177,6 +177,15 @@ def _panel_factor_jax(p: jax.Array, kb):
     reference's subtractElim hot loop (gauss_internal_input.c:155-162) —
     restricted to a VMEM-friendly panel width. Returns (factored_panel,
     ipiv, min_abs_pivot); ipiv indices are rows of ``p``.
+
+    ``zero_pivot_safe``: guard the multiplier division so a zero pivot
+    eliminates nothing (mult = 0) instead of NaN-poisoning every remaining
+    row. The factorization proper never wants this — a zero pivot means
+    singular, min_abs_pivot records 0 either way — but tournament-pivoting
+    CANDIDATE ELECTION (dist.gauss_dist_blocked2d) runs this factorizer on
+    routinely rank-deficient blocks (duplicate rows across shards), where
+    an unguarded NaN would corrupt the argmax and silently drop rows that
+    carry the remaining rank.
     """
     h, panel = p.shape
     rows = jnp.arange(h)
@@ -199,7 +208,12 @@ def _panel_factor_jax(p: jax.Array, kb):
         apiv = jnp.abs(piv)
         min_piv = jnp.minimum(min_piv, jnp.where(jnp.isnan(apiv), 0.0, apiv))
         # Multipliers below the diagonal, stored in place (getrf layout).
-        mult = jnp.where(rows > c, p[:, j] / piv, jnp.zeros((), dtype))
+        if zero_pivot_safe:
+            inv_piv = jnp.where(apiv > 0, 1.0 / piv, jnp.zeros((), dtype))
+            mult = jnp.where(rows > c, p[:, j] * inv_piv,
+                             jnp.zeros((), dtype))
+        else:
+            mult = jnp.where(rows > c, p[:, j] / piv, jnp.zeros((), dtype))
         p = p.at[:, j].set(jnp.where(rows > c, mult, p[:, j]))
         # Rank-1 update of the panel columns right of j.
         urow = jnp.where(pcols > j, p[c], jnp.zeros((), dtype))

@@ -13,5 +13,8 @@ and the SPMD program order replaces MPI_Barrier.
 from gauss_tpu.dist.mesh import make_mesh, make_mesh_2d  # noqa: F401
 from gauss_tpu.dist.gauss_dist import gauss_solve_dist, eliminate_dist  # noqa: F401
 from gauss_tpu.dist.gauss_dist2d import gauss_solve_dist2d  # noqa: F401
-from gauss_tpu.dist.gauss_dist_blocked import gauss_solve_dist_blocked  # noqa: F401
+from gauss_tpu.dist.gauss_dist_blocked import (  # noqa: F401
+    gauss_solve_dist_blocked, gauss_solve_dist_blocked_refined)
+from gauss_tpu.dist.gauss_dist_blocked2d import (  # noqa: F401
+    gauss_solve_dist_blocked2d, gauss_solve_dist_blocked2d_refined)
 from gauss_tpu.dist.matmul_dist import matmul_dist  # noqa: F401

@@ -362,6 +362,24 @@ def lu_solve_dist_blocked(fac: DistBlockedLU, r) -> jax.Array:
     return resolver(fac.a_fac, fac.perm, r_dev)[:fac.n]
 
 
+def host_refine(a64, b64, x0, lu_solve_fn, iters: int,
+                tol: float) -> np.ndarray:
+    """The shared host-f64 refinement loop for every distributed engine:
+    per iteration an O(n^2) f64 residual on host and an O(n^2) correction
+    through ``lu_solve_fn`` (a solve against EXISTING factors — no
+    refactorization). Same tol contract as core.blocked.solve_refined:
+    stop once ||Ax - b||_2 <= tol * min(1, ||b||_2); tol=0 runs exactly
+    ``iters``."""
+    x = np.asarray(x0, np.float64)
+    tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
+            break
+        x = x + np.asarray(lu_solve_fn(r), np.float64)
+    return x
+
+
 def gauss_solve_dist_blocked_refined(a, b, mesh: jax.sharding.Mesh = None,
                                      panel: int | None = None,
                                      iters: int = 2,
@@ -385,14 +403,8 @@ def gauss_solve_dist_blocked_refined(a, b, mesh: jax.sharding.Mesh = None,
     staged = prepare_dist_blocked(a64.astype(np.float32),
                                   b64.astype(np.float32), mesh, panel=panel)
     x0, fac = factor_solve_dist_blocked_staged(staged, mesh)
-    x = np.asarray(x0, np.float64)
-    tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
-    for _ in range(iters):
-        r = b64 - a64 @ x
-        if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
-            break
-        x = x + np.asarray(lu_solve_dist_blocked(fac, r), np.float64)
-    return x
+    return host_refine(a64, b64, x0,
+                       lambda r: lu_solve_dist_blocked(fac, r), iters, tol)
 
 
 def gauss_solve_dist_blocked(a, b, mesh: jax.sharding.Mesh = None,

@@ -226,6 +226,30 @@ def test_rowelim_batched_internal_pattern():
     assert checks.internal_pattern_ok(x, atol=1e-4)
 
 
+def test_auto_rowelim_k_policy():
+    """k resolution: 256 while the in-kernel panel block fits VMEM (the
+    measured round-3 winner at every bench size), narrowing beyond."""
+    from gauss_tpu.kernels.rowelim_pallas import auto_rowelim_k
+
+    assert auto_rowelim_k(512) == 256
+    assert auto_rowelim_k(2048) == 256
+    assert auto_rowelim_k(8192) == 256
+    assert auto_rowelim_k(16384) == 128   # 256-block no longer fits VMEM
+    assert auto_rowelim_k(24576) == 64
+
+
+def test_rowelim_batched_auto_k(rng):
+    """k=None (the default) must resolve and solve correctly."""
+    from gauss_tpu.kernels.rowelim_pallas import gauss_solve_rowelim_batched
+
+    n = 100
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = np.asarray(gauss_solve_rowelim_batched(a, b), np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
 def test_rowelim_batched_zero_diagonal(rng):
     from gauss_tpu.kernels.rowelim_pallas import gauss_solve_rowelim_batched
 
