@@ -1,0 +1,118 @@
+"""MXU precision sweep: HIGHEST (6-pass f32 emulation) vs HIGH (bf16x3)
+trailing GEMMs at large n (VERDICT round 2 next #3).
+
+The blocked factorization's O(n^3) lands in trailing GEMMs whose MXU
+precision is selectable (core.blocked gemm_precision). Round 2 measured
+"high" saving only ~4% at n=2048 — where the panel factorization, not the
+GEMM, dominates — and never measured n >= 8192, where bf16x3's ~2x MXU
+throughput should actually show. This sweep times BOTH precisions through
+the same double-single-refined pipeline (refinement absorbs bf16x3's
+accuracy loss; the cell verifies the refined solution against the 1e-4
+residual bar), so the comparison is end-to-end honest: if bf16x3's GEMM
+win survives its extra refinement cost, the number shows it.
+
+Usage::
+
+    python -m gauss_tpu.bench.precision --sizes 2048,4096,8192 \
+        --json reports/cells_precision.json
+
+Cells carry the same schema as bench.grid (suite "gauss-precision",
+backend "tpu[<precision>]", device span) so bench.report folds them in.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import asdict
+
+import numpy as np
+
+from gauss_tpu.bench.grid import RESIDUAL_BAR, Cell, format_table
+
+PRECISIONS = ("highest", "high")
+DEFAULT_SIZES = (2048, 4096, 8192)
+DS_ITERS = 3  # refinement steps inside the timed chain (both precisions)
+
+
+def measure_cell(n: int, precision: str, refine_steps: int = DS_ITERS) -> Cell:
+    """One slope-timed, ds-refined, verified cell at (n, gemm_precision)."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.bench import slope
+    from gauss_tpu.core import dsfloat
+    from gauss_tpu.core.blocked import auto_panel
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.verify import checks
+
+    a64 = synthetic.internal_matrix(n)
+    b64 = synthetic.internal_rhs(n)
+    a = jnp.asarray(a64, jnp.float32)
+    at_ds = dsfloat.to_ds(a64.T)
+    b_ds = dsfloat.to_ds(b64)
+    panel = auto_panel(n)
+
+    x = dsfloat.ds_to_f64(slope.gauss_solve_once_ds(
+        a, at_ds, b_ds, panel, refine_steps, gemm_precision=precision))
+    res = checks.residual_norm(a64, x, b64)
+
+    make_chain, args = slope.ds_solver_chain(a, at_ds, b_ds, panel,
+                                             refine_steps,
+                                             gemm_precision=precision)
+    # Per-solve seconds at n >= 8192 are far above the jitter floor, so a
+    # K=1/2 chain pair keeps signal while holding compile time down (the
+    # chunked program is large; escalating from 4/16 would never trigger).
+    ks, kl = (1, 2) if n >= 8192 else (4, 16)
+    seconds, ks, kl, is_slope = slope.measure_slope_info(
+        make_chain, args, k_small=ks, k_large=kl)
+    note = (f"gemm_precision={precision}, ds-refine x{refine_steps}, "
+            f"K=({ks},{kl}){'' if is_slope else ', NOT A SLOPE'}; "
+            f"{2 * n ** 3 / 3 / seconds / 1e12:.2f} TF/s useful")
+    return Cell("gauss-precision", str(n), f"tpu[{precision}]", seconds,
+                res < RESIDUAL_BAR, res, None, span="device", note=note)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench-precision",
+        description="HIGHEST vs HIGH (bf16x3) GEMM sweep, ds-refined.")
+    p.add_argument("--sizes", default=",".join(map(str, DEFAULT_SIZES)))
+    p.add_argument("--precisions", default=",".join(PRECISIONS))
+    p.add_argument("--json", dest="json_path", default=None)
+    args = p.parse_args(argv)
+
+    sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+    precisions = [s.strip() for s in args.precisions.split(",") if s.strip()]
+    cells = []
+    for n in sizes:
+        for prec in precisions:
+            print(f"bench-precision: n={n} {prec} ...", file=sys.stderr,
+                  flush=True)
+            try:
+                cell = measure_cell(n, prec)
+            except Exception as e:
+                from gauss_tpu.bench.grid import _failure_note
+
+                cell = Cell("gauss-precision", str(n), f"tpu[{prec}]", 0.0,
+                            False, float("nan"), None, span="device",
+                            note=_failure_note("failed", e))
+            print(f"bench-precision: n={n} {prec} -> {cell.seconds:.6f}s "
+                  f"verified={cell.verified} ({cell.note})", file=sys.stderr,
+                  flush=True)
+            cells.append(cell)
+
+    print(format_table(cells))
+    if args.json_path:
+        payload = [dict(asdict(c), speedup=c.speedup,
+                        error=c.error if np.isfinite(c.error) else None)
+                   for c in cells]
+        with open(args.json_path, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {len(payload)} cells to {args.json_path}",
+              file=sys.stderr)
+    return 0 if all(c.verified for c in cells) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

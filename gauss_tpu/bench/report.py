@@ -36,6 +36,8 @@ SUITE_TITLES = {
     "matmul": "Dense matrix multiplication",
     "gauss-dist": "Gaussian elimination — distributed engines "
                   "(shard sweep, virtual CPU mesh — NOT ICI)",
+    "gauss-precision": "Gaussian elimination — MXU GEMM precision sweep "
+                       "(HIGHEST f32-emulation vs HIGH bf16x3, ds-refined)",
 }
 
 # Verification semantics per suite (the reference's scattered checks,
@@ -50,7 +52,65 @@ SUITE_CHECKS = {
                   "forced virtual CPU mesh: scaling shape and correctness, "
                   "NOT an ICI measurement; the reference comparator is the "
                   "best 6-node Distributed-MPI cell per size)",
+    "gauss-precision": "absolute residual ||Ax - b||_2 < 1e-4 of the "
+                       "double-single-refined solution (refinement inside "
+                       "the timed chain for BOTH precisions)",
 }
+
+# The one-host interpretation that must ride WITH the dist numbers
+# (VERDICT round 2 weak #5): without it, the sweep's inverse scaling reads
+# as the engines failing to scale.
+DIST_CAVEAT = (
+    "**Reading this table:** all shards of the virtual mesh share ONE "
+    "host's cores and memory bus, and XLA emulates collectives as local "
+    "copies, so wall-clock GROWS with shard count by construction — more "
+    "shards just means more copies through the same silicon. These cells "
+    "validate correctness, collective structure, and relative engine cost "
+    "at identical shard counts; they are NOT an ICI scaling measurement. "
+    "The per-chip traffic/latency model for real meshes, with the "
+    "jaxpr-counted collective budgets, is docs/SCALING.md.")
+
+
+def _parse_sweep_key(key: str):
+    """'1024 @4sh' -> (1024, 4); plain keys -> (key, None)."""
+    base, _, tail = str(key).partition(" @")
+    if tail.endswith("sh") and tail[:-2].isdigit() and base.isdigit():
+        return int(base), int(tail[:-2])
+    return key, None
+
+
+def _dist_efficiency_table(cells: Sequence[dict]) -> Optional[List[str]]:
+    """Per (size, engine): seconds at each shard count + parallel efficiency
+    vs that engine's own smallest-shard cell (eff = t_s0 * s0 / (t_s * s)).
+    On one host efficiency is expected to fall well below 100% — the table
+    makes the shape explicit instead of leaving readers to infer it."""
+    sweeps: Dict[tuple, Dict[int, dict]] = defaultdict(dict)
+    for c in cells:
+        n, shards = _parse_sweep_key(c["key"])
+        if shards is None or not c["verified"]:
+            continue
+        sweeps[(n, c["backend"])][shards] = c
+    if not sweeps:
+        return None
+    all_shards = sorted({s for v in sweeps.values() for s in v})
+    head = ("| size | engine | " +
+            " | ".join(f"{s} shards" for s in all_shards) + " |")
+    lines = [head, "|---|---|" + "---|" * len(all_shards)]
+    for (n, backend), by_shards in sweeps.items():
+        s0 = min(by_shards)
+        t0 = by_shards[s0]["seconds"]
+        row = []
+        for s in all_shards:
+            c = by_shards.get(s)
+            if c is None:
+                row.append("—")
+            elif s == s0:
+                row.append(f"{_fmt_s(c['seconds'])} (base)")
+            else:
+                eff = t0 * s0 / (c["seconds"] * s) * 100.0
+                row.append(f"{_fmt_s(c['seconds'])} ({eff:.0f}% eff)")
+        lines.append(f"| {n} | {backend} | " + " | ".join(row) + " |")
+    return lines
 
 
 def _fmt_s(seconds: float) -> str:
@@ -270,6 +330,18 @@ def compose_report(cells: Sequence[dict], title: str, hardware: str,
         lines += [f"## {SUITE_TITLES.get(suite, suite)}", "",
                   "### Performance (seconds)", ""]
         lines += _time_table(suite_cells)
+        if suite == "gauss-dist":
+            eff = _dist_efficiency_table(suite_cells)
+            if eff:
+                lines += ["", "### Shard-sweep efficiency (one-host mesh)",
+                          "", DIST_CAVEAT, ""]
+                lines += eff
+        if suite == "gauss-precision":
+            notes = [f"- {c['key']}/{c['backend']}: {c['note']}"
+                     for c in suite_cells if c.get("note")]
+            if notes:
+                lines += ["", "Measurement configuration per cell:", ""]
+                lines += notes
         speedup = _speedup_table(suite_cells)
         if speedup:
             lines += ["", "### Speedup over the sequential engine", ""]
@@ -284,7 +356,9 @@ def compose_report(cells: Sequence[dict], title: str, hardware: str,
         failed = [c for c in suite_cells if not c["verified"]]
         if failed:
             lines += ["", "Failed cells: " + ", ".join(
-                f"{c['key']}/{c['backend']}" for c in failed) + "."]
+                f"{c['key']}/{c['backend']}"
+                + (f" — {c['note']}" if c.get("note") else "")
+                for c in failed) + "."]
         inferences = _inferences(suite, suite_cells)
         if inferences:
             lines += ["", "### Inferences", ""]

@@ -89,7 +89,7 @@ def measure_slope(make_chain: Callable[[int], Callable], args: Sequence = (),
 
 
 def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
-                     unroll="auto"):
+                     unroll="auto", gemm_precision: str = "highest"):
     """One iteration of exactly the configuration :func:`gauss_chain` times:
     blocked f32 factor + solve (+ optional on-device f32 refinement steps).
     Exposed so callers can VERIFY the very computation the slope measures —
@@ -104,7 +104,7 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
     from gauss_tpu.core import blocked
 
     factor = blocked.resolve_factor(a.shape[0], unroll)
-    fac = factor(a, panel=panel)
+    fac = factor(a, panel=panel, gemm_precision=gemm_precision)
     x = blocked.lu_solve(fac, b)
     for _ in range(refine_steps):
         r = b - jnp.dot(a, x, precision=lax.Precision.HIGHEST)
@@ -113,7 +113,7 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
 
 
 def gauss_solve_once_ds(a, at_ds, b_ds, panel: int, refine_steps: int,
-                        unroll="auto"):
+                        unroll="auto", gemm_precision: str = "highest"):
     """One f32 factor + solve + double-single on-device refinement — the
     external-suite device-span configuration (VERDICT round 1 #3: the f32
     refinement floor failed memplus; double-single residuals clear the 1e-4
@@ -122,12 +122,14 @@ def gauss_solve_once_ds(a, at_ds, b_ds, panel: int, refine_steps: int,
     from gauss_tpu.core import dsfloat
 
     x, _ = dsfloat.solve_once_ds(a, at_ds, b_ds, panel, iters=refine_steps,
-                                 unroll=unroll)
+                                 unroll=unroll,
+                                 gemm_precision=gemm_precision)
     return x
 
 
 def ds_solver_chain(a, at_ds, b_ds, panel: int, refine_steps: int,
-                    unroll="auto") -> Tuple[Callable[[int], Callable], tuple]:
+                    unroll="auto", gemm_precision: str = "highest"
+                    ) -> Tuple[Callable[[int], Callable], tuple]:
     """Chain factory for the ds-refined solve. The factor operand is
     perturbed per iteration (defeats CSE); the residual operands stay fixed,
     so every iteration converges to the same (verified) solution — the
@@ -146,7 +148,7 @@ def ds_solver_chain(a, at_ds, b_ds, panel: int, refine_steps: int,
                 a_i = a_ + xc[0] * jnp.asarray(PERTURB, a_.dtype)
                 x = gauss_solve_once_ds(a_i, DS(at_hi, at_lo),
                                         DS(b_hi, b_lo), panel, refine_steps,
-                                        unroll)
+                                        unroll, gemm_precision)
                 return x.hi + x.lo
 
             x = lax.fori_loop(0, k, body, x0)
@@ -184,14 +186,16 @@ def solver_chain(a, b, solve_once: Callable
     return make_chain, (a, b, b)
 
 
-def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto"
+def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto",
+                gemm_precision: str = "highest"
                 ) -> Tuple[Callable[[int], Callable], tuple]:
     """Chain factory for the blocked gauss solve (+ refine_steps on-device
     f32 refinement iterations — each one matvec + triangular solves, O(n^2)
     against the O(n^3) factor). Returns (make_chain, args)."""
 
     def solve_once(a_, b_):
-        return gauss_solve_once(a_, b_, panel, refine_steps, unroll)
+        return gauss_solve_once(a_, b_, panel, refine_steps, unroll,
+                                gemm_precision)
 
     return solver_chain(a, b, solve_once)
 

@@ -662,14 +662,17 @@ def resolve_factor(n: int, unroll):
     return lu_factor_blocked_unrolled if unroll else lu_factor_blocked
 
 
-@partial(jax.jit, static_argnames=("panel", "panel_impl", "unroll"))
+@partial(jax.jit, static_argnames=("panel", "panel_impl", "unroll",
+                                   "gemm_precision"))
 def gauss_solve_blocked(a: jax.Array, b: jax.Array,
                         panel: int | None = None,
                         panel_impl: str = "auto",
-                        unroll: bool | str = "auto") -> jax.Array:
+                        unroll: bool | str = "auto",
+                        gemm_precision: str = "highest") -> jax.Array:
     """Factor + solve in one jitted program (the fast single-chip solver)."""
     factor = resolve_factor(a.shape[0], unroll)
-    return lu_solve(factor(a, panel=panel, panel_impl=panel_impl), b)
+    return lu_solve(factor(a, panel=panel, panel_impl=panel_impl,
+                           gemm_precision=gemm_precision), b)
 
 
 def solve_refined(a: np.ndarray, b: np.ndarray, panel: int | None = None,

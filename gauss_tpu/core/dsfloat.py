@@ -291,8 +291,8 @@ DS_REFINE_STEPS = 6
 
 
 def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
-                  iters: int = DS_REFINE_STEPS,
-                  unroll="auto") -> "tuple[DS, object]":
+                  iters: int = DS_REFINE_STEPS, unroll="auto",
+                  gemm_precision: str = "highest") -> "tuple[DS, object]":
     """One jittable f32 factor + solve + double-single refinement pass.
 
     ``a`` is the f32 matrix (factor operand); ``at_ds``/``b_ds`` the
@@ -307,7 +307,7 @@ def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
     from gauss_tpu.core import blocked
 
     factor = blocked.resolve_factor(a.shape[0], unroll)
-    fac = factor(a, panel=panel)
+    fac = factor(a, panel=panel, gemm_precision=gemm_precision)
     x0 = blocked.lu_solve(fac, b_ds.hi)
     return refine_ds(fac, at_ds, b_ds, x0, iters=iters), fac
 

@@ -394,11 +394,18 @@ def lu_solve_dist_blocked2d(fac: DistBlocked2DLU, r) -> jax.Array:
     return solver(fac.a_fac, fac.perm, fac.linvs, fac.uinvs, r_dev)[:fac.n]
 
 
-def solve_dist_blocked2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
+def factor_solve_dist_blocked2d_staged(staged, mesh: jax.sharding.Mesh):
+    """Factor + solve a staged system; returns (x, DistBlocked2DLU) — the
+    single plumbing point for both the staged solve and the refined entry
+    (mirrors the 1-D engine's factor_solve_dist_blocked_staged)."""
     a_c, b_c, n, npad, panel = staged
     fac = factor_dist_blocked2d(staged, mesh)
     solver = _build_solver_2d(mesh, npad, panel, str(a_c.dtype))
-    return solver(fac.a_fac, fac.perm, fac.linvs, fac.uinvs, b_c)[:n]
+    return solver(fac.a_fac, fac.perm, fac.linvs, fac.uinvs, b_c)[:n], fac
+
+
+def solve_dist_blocked2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
+    return factor_solve_dist_blocked2d_staged(staged, mesh)[0]
 
 
 def gauss_solve_dist_blocked2d(a, b, mesh: jax.sharding.Mesh = None,
@@ -426,9 +433,6 @@ def gauss_solve_dist_blocked2d_refined(a, b, mesh: jax.sharding.Mesh = None,
     b64 = np.asarray(b, np.float64)
     staged = prepare_dist_blocked2d(a64.astype(np.float32),
                                     b64.astype(np.float32), mesh, panel=panel)
-    fac = factor_dist_blocked2d(staged, mesh)
-    solver = _build_solver_2d(mesh, fac.npad, fac.panel, str(fac.a_fac.dtype))
-    x0 = solver(fac.a_fac, fac.perm, fac.linvs, fac.uinvs,
-                staged[1])[:fac.n]
+    x0, fac = factor_solve_dist_blocked2d_staged(staged, mesh)
     return host_refine(a64, b64, x0,
                        lambda r: lu_solve_dist_blocked2d(fac, r), iters, tol)

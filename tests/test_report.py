@@ -162,3 +162,54 @@ def test_scaling_exponent_tolerates_duplicate_sizes():
              for k, s in (("1024", 0.001), ("2048", 0.004), ("2048", 0.0041))]
     p = report._scaling_exponent(cells, "b")
     assert p == pytest.approx(2.0, abs=0.01)
+
+
+def test_dist_efficiency_table_and_caveat():
+    """The gauss-dist section must carry the one-host caveat and a per-engine
+    efficiency column computed against the engine's own smallest-shard cell
+    (VERDICT round 2 weak #5)."""
+    cells = [
+        {"suite": "gauss-dist", "key": "1024 @2sh", "backend": "tpu-dist-blocked",
+         "seconds": 0.2, "verified": True, "error": 0.0, "reference_s": None,
+         "note": "virtual CPU mesh"},
+        {"suite": "gauss-dist", "key": "1024 @4sh", "backend": "tpu-dist-blocked",
+         "seconds": 0.4, "verified": True, "error": 0.0, "reference_s": None,
+         "note": "virtual CPU mesh"},
+        {"suite": "gauss-dist", "key": "1024 @8sh", "backend": "tpu-dist-blocked",
+         "seconds": 0.8, "verified": True, "error": 0.0, "reference_s": None,
+         "note": "virtual CPU mesh"},
+    ]
+    text = report.compose_report(cells, "t", "hw")
+    assert "Shard-sweep efficiency" in text
+    assert "NOT an ICI scaling measurement" in text
+    # eff at 4 shards: 0.2*2/(0.4*4) = 25%; at 8: 0.2*2/(0.8*8) = 6%.
+    assert "(25% eff)" in text and "(6% eff)" in text
+    assert "0.200000 (base)" in text
+
+
+def test_precision_suite_renders_notes():
+    cells = [
+        {"suite": "gauss-precision", "key": "8192", "backend": "tpu[highest]",
+         "seconds": 0.058, "verified": True, "error": 1e-7,
+         "reference_s": None, "span": "device",
+         "note": "gemm_precision=highest, ds-refine x3, K=(1,2); 6.3 TF/s useful"},
+        {"suite": "gauss-precision", "key": "8192", "backend": "tpu[high]",
+         "seconds": 0.030, "verified": True, "error": 2e-7,
+         "reference_s": None, "span": "device",
+         "note": "gemm_precision=high, ds-refine x3, K=(1,2); 12.2 TF/s useful"},
+    ]
+    text = report.compose_report(cells, "t", "hw")
+    assert "GEMM precision sweep" in text
+    assert "6.3 TF/s useful" in text and "12.2 TF/s useful" in text
+
+
+def test_failed_cells_show_cause():
+    """A FAILED cell's note (the captured exception) must surface in the
+    report, not just the JSON (VERDICT round 2 weak #2)."""
+    cells = [
+        {"suite": "gauss-external", "key": "memplus", "backend": "tpu",
+         "seconds": 0.0, "verified": False, "error": float("nan"),
+         "reference_s": None, "span": "device",
+         "note": "failed: XlaRuntimeError: compile timed out"}]
+    text = report.compose_report(cells, "t", "hw")
+    assert "memplus/tpu [device-span] — failed: XlaRuntimeError" in text
