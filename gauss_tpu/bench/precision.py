@@ -37,35 +37,18 @@ DS_ITERS = 3  # refinement steps inside the timed chain (both precisions)
 
 
 def measure_cell(n: int, precision: str, refine_steps: int = DS_ITERS) -> Cell:
-    """One slope-timed, ds-refined, verified cell at (n, gemm_precision)."""
-    import jax.numpy as jnp
-
-    from gauss_tpu.bench import slope
-    from gauss_tpu.core import dsfloat
-    from gauss_tpu.core.blocked import auto_panel
+    """One slope-timed, ds-refined, verified cell at (n, gemm_precision) —
+    the measurement recipe (K policy included) is grid's
+    _gauss_device_cell_ds, not a copy of it."""
+    from gauss_tpu.bench.grid import _gauss_device_cell_ds
     from gauss_tpu.io import synthetic
     from gauss_tpu.verify import checks
 
     a64 = synthetic.internal_matrix(n)
     b64 = synthetic.internal_rhs(n)
-    a = jnp.asarray(a64, jnp.float32)
-    at_ds = dsfloat.to_ds(a64.T)
-    b_ds = dsfloat.to_ds(b64)
-    panel = auto_panel(n)
-
-    x = dsfloat.ds_to_f64(slope.gauss_solve_once_ds(
-        a, at_ds, b_ds, panel, refine_steps, gemm_precision=precision))
+    seconds, x, (ks, kl, is_slope) = _gauss_device_cell_ds(
+        a64, b64, refine_steps=refine_steps, gemm_precision=precision)
     res = checks.residual_norm(a64, x, b64)
-
-    make_chain, args = slope.ds_solver_chain(a, at_ds, b_ds, panel,
-                                             refine_steps,
-                                             gemm_precision=precision)
-    # Per-solve seconds at n >= 8192 are far above the jitter floor, so a
-    # K=1/2 chain pair keeps signal while holding compile time down (the
-    # chunked program is large; escalating from 4/16 would never trigger).
-    ks, kl = (1, 2) if n >= 8192 else (4, 16)
-    seconds, ks, kl, is_slope = slope.measure_slope_info(
-        make_chain, args, k_small=ks, k_large=kl)
     note = (f"gemm_precision={precision}, ds-refine x{refine_steps}, "
             f"K=({ks},{kl}){'' if is_slope else ', NOT A SLOPE'}; "
             f"{2 * n ** 3 / 3 / seconds / 1e12:.2f} TF/s useful")

