@@ -10,30 +10,35 @@ cd "$(dirname "$0")/.."
 python -m gauss_tpu.bench.grid --suite gauss-internal \
     --backends tpu,tpu-unblocked,seq,omp,threads,forkjoin,tiled \
     --json /tmp/gi.json
-python -m gauss_tpu.bench.grid --suite gauss-internal --backends tpu \
-    --span device --json /tmp/gid.json
 python -m gauss_tpu.bench.grid --suite gauss-internal \
-    --keys 512,1024,2048,4096 --backends tpu-rowelim --span device \
-    --json /tmp/gir.json
+    --backends tpu,tpu-rowelim,tpu-rowelim-step \
+    --span device --json /tmp/gid.json
+python -m gauss_tpu.bench.grid --suite gauss-internal --keys 4096,8192 \
+    --backends tpu,tpu-rowelim --span device --json /tmp/gil.json
 python -m gauss_tpu.bench.grid --suite gauss-external --backends tpu,seq,omp \
     --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3 \
     --json /tmp/ge.json
 python -m gauss_tpu.bench.grid --suite gauss-external --keys memplus \
     --backends tpu --json /tmp/gem.json
+python -m gauss_tpu.bench.grid --suite gauss-external --keys memplus \
+    --backends tpu --span device --json /tmp/gemd.json
 python -m gauss_tpu.bench.grid --suite gauss-external --backends tpu \
     --span device --json /tmp/ged.json
 python -m gauss_tpu.bench.grid --suite matmul \
     --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp --json /tmp/mm.json
 python -m gauss_tpu.bench.grid --suite matmul \
     --backends tpu,tpu-pallas,tpu-pallas-v1 --span device --json /tmp/mmd.json
+# The MXU precision sweep (HIGHEST vs bf16x3 through the ds-refined chain).
+python -m gauss_tpu.bench.precision --sizes 2048,4096,8192 \
+    --json /tmp/gprec.json
 # The distributed shard sweep runs on a forced virtual CPU mesh and MUST be
 # its own process (the forced device count latches at backend init).
 JAX_PLATFORMS=cpu python -m gauss_tpu.bench.grid --suite gauss-dist \
     --json /tmp/gdist.json
 
-python -m gauss_tpu.bench.report /tmp/gi.json /tmp/gid.json /tmp/gir.json \
-    /tmp/ge.json /tmp/gem.json /tmp/ged.json /tmp/mm.json /tmp/mmd.json \
-    /tmp/gdist.json \
+python -m gauss_tpu.bench.report /tmp/gi.json /tmp/gid.json /tmp/gil.json \
+    /tmp/ge.json /tmp/gem.json /tmp/gemd.json /tmp/ged.json /tmp/mm.json \
+    /tmp/mmd.json /tmp/gprec.json /tmp/gdist.json \
     --title "gauss-tpu benchmark report" --out reports/REPORT.md --profile 1024
 python -m gauss_tpu.bench.plots /tmp/gi.json /tmp/gid.json /tmp/mmd.json \
     --outdir graphs
