@@ -36,8 +36,26 @@ def main(n: int = 256, shards: int = 8) -> None:
     a = internal_matrix(n, dtype=np.float32)
     b = internal_rhs(n, dtype=np.float32)
     x = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh), np.float64)
-    print(f"n={n} over {shards} shards: pattern ok = "
+    print(f"n={n} over {shards} shards (per-step engine): pattern ok = "
           f"{checks.internal_pattern_ok(x, atol=1e-3)}")
+
+    # The scaling engines: 1-D panel-blocked (collectives per panel), and —
+    # when the shard count factors into a grid — the 2-D tournament-pivoted
+    # engine (per-chip traffic O(n^2/R + n^2/C), the pod-scale shape).
+    from gauss_tpu.dist import gauss_dist_blocked, gauss_dist_blocked2d
+    from gauss_tpu.dist.mesh import make_mesh_2d_auto, squarest_factors
+
+    xb = np.asarray(gauss_dist_blocked.gauss_solve_dist_blocked(
+        a, b, mesh=mesh), np.float64)
+    print(f"n={n} over {shards} shards (panel-blocked): pattern ok = "
+          f"{checks.internal_pattern_ok(xb, atol=1e-3)}")
+    if squarest_factors(shards)[1] > 1:  # shard count factors into a grid
+        mesh2 = make_mesh_2d_auto(shards, devices=devs[:shards])
+        x2 = gauss_dist_blocked2d.gauss_solve_dist_blocked2d_refined(
+            a, b, mesh=mesh2)
+        print(f"n={n} over {mesh2.devices.shape} grid (2-D tournament, "
+              f"refined): pattern ok = "
+              f"{checks.internal_pattern_ok(x2, atol=1e-3)}")
 
 
 if __name__ == "__main__":

@@ -47,6 +47,22 @@ b = synthetic.internal_rhs(n, dtype=np.float32)
 mesh = make_mesh(8)
 x = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh), np.float64)
 assert checks.internal_pattern_ok(x, atol=1e-3), x[:4]
+
+# The round-3 scaling engines over the SAME cross-process pool: the 1-D
+# panel-blocked factorization and the 2-D tournament-pivoted one — real
+# cross-process collectives through their per-panel psum/all_gather
+# protocol, not just the single-process simulation.
+from gauss_tpu.dist import gauss_dist_blocked, gauss_dist_blocked2d
+from gauss_tpu.dist.mesh import make_mesh_2d
+
+xb = np.asarray(gauss_dist_blocked.gauss_solve_dist_blocked(
+    a, b, mesh=mesh, panel=4), np.float64)
+assert checks.internal_pattern_ok(xb, atol=1e-3), xb[:4]
+
+mesh2 = make_mesh_2d(4, 2)
+x2 = np.asarray(gauss_dist_blocked2d.gauss_solve_dist_blocked2d(
+    a, b, mesh=mesh2, panel=4), np.float64)
+assert checks.internal_pattern_ok(x2, atol=1e-3), x2[:4]
 print("RESULT_OK process {pid}", flush=True)
 """
 
