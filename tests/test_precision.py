@@ -1,0 +1,60 @@
+"""Tests for the MXU GEMM precision sweep bench (gauss_tpu.bench.precision).
+
+The sweep's TPU measurements live in reports/cells_precision.json; these
+tests pin the machinery — cell schema, verification gating, CLI plumbing,
+and the failure path — on the CPU test platform.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gauss_tpu.bench import precision
+from gauss_tpu.bench.grid import format_table
+
+
+def test_measure_cell_schema_and_verification():
+    c = precision.measure_cell(64, "highest", refine_steps=2)
+    assert c.suite == "gauss-precision"
+    assert c.backend == "tpu[highest]"
+    assert c.span == "device"
+    assert c.verified and c.error < 1e-4
+    assert "gemm_precision=highest" in c.note
+    assert "TF/s useful" in c.note
+    # format_table must render the suite (round-3 regression: KeyError).
+    assert "gauss-precision" in format_table([c])
+
+
+def test_both_precisions_verify_small():
+    for prec in precision.PRECISIONS:
+        c = precision.measure_cell(48, prec, refine_steps=3)
+        assert c.verified, (prec, c.error)
+
+
+def test_main_writes_json(tmp_path):
+    out = tmp_path / "cells.json"
+    rc = precision.main(["--sizes", "48", "--precisions", "highest",
+                         "--json", str(out)])
+    assert rc == 0
+    cells = json.loads(out.read_text())
+    assert len(cells) == 1
+    assert cells[0]["backend"] == "tpu[highest]"
+    assert cells[0]["verified"] is True
+
+
+def test_main_failure_path_records_cause(tmp_path, monkeypatch):
+    """A crashing measurement must produce a FAILED cell with the exception
+    in its note and a nonzero exit — never a lost sweep."""
+    def boom(n, prec, refine_steps=3):
+        raise RuntimeError("synthetic kaboom")
+
+    monkeypatch.setattr(precision, "measure_cell", boom)
+    out = tmp_path / "cells.json"
+    rc = precision.main(["--sizes", "48", "--precisions", "high",
+                         "--json", str(out)])
+    assert rc == 1
+    cells = json.loads(out.read_text())
+    assert cells[0]["verified"] is False
+    assert "RuntimeError: synthetic kaboom" in cells[0]["note"]
+    assert cells[0]["error"] is None  # NaN serialized as null
