@@ -453,10 +453,19 @@ def test_resolve_factor_policy(monkeypatch):
     assert blocked.resolve_factor(2048, "auto") is blocked.lu_factor_blocked_unrolled
     assert blocked.resolve_factor(8192, "auto") is blocked.lu_factor_blocked_chunked
     assert blocked.resolve_factor(12288, "auto") is blocked.lu_factor_blocked_chunked
-    # n=17758 is 35 chunked groups — measured NOT to compile within 49 min
-    # on the tunneled chip (the round-2 memplus device crash); it must
-    # route to the flat fori program (round 3).
-    assert blocked.resolve_factor(17758, "auto") is blocked.lu_factor_blocked
-    assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
+    # Compile payload scales with GROUP count: 35 chunk-4 groups at n=17758
+    # did not compile in 49 min on the tunneled chip (the round-2 memplus
+    # crash); the chunk ESCALATES so the group count stays under the cap.
+    f = blocked.resolve_factor(16384, "auto")
+    assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
+    assert f.keywords["chunk"] == 8
+    f = blocked.resolve_factor(17758, "auto")
+    assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
+    assert f.keywords["chunk"] == 8
+    f = blocked.resolve_factor(24576, "auto")  # panel 64 -> 384 blocks
+    assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
+    assert f.keywords["chunk"] == 16
+    # Past chunk-16's reach: the flat program.
+    assert blocked.resolve_factor(34048, "auto") is blocked.lu_factor_blocked
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
