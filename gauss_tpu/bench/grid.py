@@ -99,6 +99,11 @@ def _gauss_device_cell(a64, b64, refine_steps: int, backend: str = "tpu"):
         from gauss_tpu.kernels.rowelim_pallas import gauss_solve_rowelim
 
         solve_once = gauss_solve_rowelim
+    elif backend == "jax-linalg":
+        import jax.scipy.linalg as jsl
+
+        def solve_once(a_, b_):
+            return jsl.solve(a_, b_)
     else:
         panel = auto_panel(a.shape[0])
 
@@ -140,16 +145,26 @@ def _gauss_device_cell_ds(a64, b64, refine_steps: int | None = None,
     # Very large systems: per-solve seconds dwarf the jitter floor, so a
     # K=(1,2) pair keeps full slope validity while holding the chain's
     # compile payload and run count down (the memplus lesson, r2 -> r3).
-    ks, kl = (1, 2) if n >= 8192 else (slope.K_SMALL, slope.K_LARGE)
+    # With only one (K1, K2) pair a single outlier run would contaminate
+    # the slope directly, so the interleaved rounds count rises to keep
+    # per-K minima meaningful (ADVICE r3: cheap relative to per-solve
+    # seconds at this size).
+    if n >= 8192:
+        ks, kl, rounds = 1, 2, 2 * slope.ROUNDS
+    else:
+        ks, kl, rounds = slope.K_SMALL, slope.K_LARGE, slope.ROUNDS
     seconds, ks, kl, is_slope = slope.measure_slope_info(
-        make_chain, args, k_small=ks, k_large=kl)
+        make_chain, args, k_small=ks, k_large=kl, rounds=rounds)
     return seconds, x, (ks, kl, is_slope)
 
 
 # Per-suite device-span eligibility. tpu-rowelim has no refinement path
 # (nothing to reuse across solves), so it cannot meet the external suite's
-# 1e-4 bar in f32 and is internal-only there.
-DEVICE_SPAN_GAUSS = ("tpu", "tpu-rowelim", "tpu-rowelim-step")
+# 1e-4 bar in f32 and is internal-only there. "jax-linalg" is the
+# stock-library baseline column (VERDICT r3 next #4: jax.scipy.linalg.solve,
+# slope-timed with the identical chain) — the framework must beat the
+# library it could have been a thin wrapper over, not just a 2022 Xeon.
+DEVICE_SPAN_GAUSS = ("tpu", "tpu-rowelim", "tpu-rowelim-step", "jax-linalg")
 DEVICE_SPAN_GAUSS_EXTERNAL = ("tpu",)
 DEVICE_SPAN_MATMUL = ("tpu", "tpu-pallas", "tpu-pallas-v1")
 
@@ -166,6 +181,10 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
     # charged to every backend's cell so the vs-reference column compares
     # like spans.
     a, b, init_s = ctx
+    if backend == "jax-linalg" and span != "device":
+        raise ValueError("jax-linalg is a device-span-only baseline column "
+                         "(stock jax.scipy.linalg.solve, slope-timed); run "
+                         "with --span device")
     if (span == "device" and backend.startswith("tpu")
             and backend not in DEVICE_SPAN_GAUSS):
         _no_device_span_notice("gauss-internal", n, backend,
@@ -207,6 +226,10 @@ def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
                         span: str = "reference") -> Cell:
     a, b, x_true, source = ctx
     note = f"source={source}"
+    if backend == "jax-linalg":
+        raise ValueError("the jax-linalg baseline column exists only in the "
+                         "gauss-internal suite (it has no refinement path "
+                         "for the external suite's 1e-4 bar)")
     if (span == "device" and backend.startswith("tpu")
             and backend not in DEVICE_SPAN_GAUSS_EXTERNAL):
         _no_device_span_notice(
@@ -401,6 +424,13 @@ def _ctx_note(suite: str, ctx) -> str:
     return f"source={ctx[3]}" if suite == "gauss-external" else ""
 
 
+def _is_device_backend(backend: str) -> bool:
+    """Backends whose parallelism is the device/mesh, not a thread pool —
+    they have no thread axis. Includes the stock-library baseline column
+    (jax-linalg), which is device-resident but not tpu-prefixed."""
+    return backend.startswith("tpu") or backend == "jax-linalg"
+
+
 def _sweep_skip(suite: str, backend: str, t, sweep) -> bool:
     """Device engines have no thread axis (the mesh, not a thread pool, is
     their parallelism): in a thread sweep they run once, at the first entry.
@@ -408,7 +438,7 @@ def _sweep_skip(suite: str, backend: str, t, sweep) -> bool:
     shard count."""
     if suite == "gauss-dist":
         return False
-    return t is not None and backend.startswith("tpu") and t != sweep[0]
+    return t is not None and _is_device_backend(backend) and t != sweep[0]
 
 
 def _sweep_label(suite: str, key, backend: str, t) -> str:
@@ -416,7 +446,7 @@ def _sweep_label(suite: str, key, backend: str, t) -> str:
     fits and tables stay honest, and distributed cells key on shards."""
     if suite == "gauss-dist":
         return f"{key} @{t}sh" if t is not None else str(key)
-    return (str(key) if t is None or backend.startswith("tpu")
+    return (str(key) if t is None or _is_device_backend(backend)
             else f"{key} @{t}t")
 
 

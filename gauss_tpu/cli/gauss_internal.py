@@ -47,18 +47,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help=f"threads / shards, backend-dependent (default {DEFAULT_THREADS})")
     p.add_argument("--backend", choices=_common.GAUSS_BACKENDS, default="tpu")
     p.add_argument("--pivoting", choices=("partial", "first_nonzero"),
-                   default="first_nonzero",
-                   help="pivot policy; the reference internal flavor uses "
-                        "first_nonzero (tpu backend always uses partial)")
+                   default=None,
+                   help="pivot policy; default: first_nonzero (the reference "
+                        "internal flavor's swap-on-zero) on backends that "
+                        "implement it, partial elsewhere — explicitly "
+                        "requesting first_nonzero on a partial-only backend "
+                        "prints a notice and runs partial")
     p.add_argument("--verify", action="store_true",
                    help="check the closed-form solution pattern and residual "
                         "(the reference's compile-time VERIFY, now a flag)")
     p.add_argument("--refine", type=int, default=2, metavar="K",
-                   help="max iterative-refinement steps for the f32 tpu "
-                        "backend (stops early at --refine-tol)")
+                   help="iterative-refinement budget for the f32 tpu "
+                        "backend; K <= 2 refines host-side (early exit at "
+                        "--refine-tol), K > 2 runs the whole budget on "
+                        "device with double-single residuals")
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
-                   help="stop refining once ||Ax-b|| <= TOL*min(1, ||b||); "
-                        "0 always runs exactly --refine steps (default 1e-5)")
+                   help="host-side refinement only (--refine <= 2): stop "
+                        "once ||Ax-b|| <= TOL*min(1, ||b||); 0 always runs "
+                        "exactly --refine steps (default 1e-5)")
     p.add_argument("--panel", type=int, default=None,
                    help="panel width for the blocked tpu backend "
                         "(default: auto — VMEM-aware)")

@@ -40,9 +40,10 @@ def _inputs(n: int):
 def _tpu_engine_fn(engine: str, precision: str = None):
     """The device matmul callable behind a tpu* engine name.
 
-    ``precision`` None keeps each engine's default ("high" bf16x3 for the
-    XLA engine, "highest" for the Pallas kernels — Mosaic rejects HIGH
-    inside kernels, so "high" is clamped up to "highest" there).
+    ``precision`` None keeps each engine's default — "high" (bf16x3)
+    everywhere: the XLA engine via lax.Precision.HIGH, the Pallas kernels
+    via the manual in-kernel split scheme (Mosaic rejects HIGH as a dot
+    precision, so the kernels build it by hand; kernels.matmul_pallas).
     """
     from functools import partial as _partial
 
@@ -58,9 +59,7 @@ def _tpu_engine_fn(engine: str, precision: str = None):
         else:
             from gauss_tpu.kernels.matmul_pallas import (
                 matmul_pallas_stripe as mm)
-        if precision is None or precision == "high":
-            return mm
-        return _partial(mm, precision=precision)
+        return mm if precision is None else _partial(mm, precision=precision)
     from gauss_tpu.core.matmul import matmul as mm
     return mm if precision is None else _partial(mm, precision=precision)
 
@@ -99,9 +98,9 @@ def main(argv=None) -> int:
                    help="threads for the omp engine (default: all)")
     p.add_argument("--precision", choices=("highest", "high", "default"),
                    default=None,
-                   help="MXU precision for device engines (default: each "
-                        "engine's own — 'high' bf16x3 for the XLA engine, "
-                        "'highest' f32-emulation for Pallas kernels)")
+                   help="MXU precision for device engines (default 'high' "
+                        "bf16x3 everywhere; the Pallas kernels implement it "
+                        "in-kernel by manual operand splitting)")
     args = p.parse_args(argv)
     n = args.nsize
     if n <= 0:

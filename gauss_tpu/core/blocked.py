@@ -34,6 +34,9 @@ from jax import lax
 
 DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
 CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16)
+GROUP_UPDATE_STRIP = 2048  # rows per deferred-trailing-GEMM strip: bounds
+# the chunked form's group-end transients to O(strip * n) so the route
+# reaches the HBM ceiling (the unstripped form OOMed at n=32768)
 
 # The Pallas panel kernel holds one transposed (panel, npad) block in VMEM
 # plus per-row pivot bookkeeping (inv/chosen/done vectors). Calibrated from
@@ -245,6 +248,17 @@ def _resolve_panel_impl(panel_impl, n: int | None = None,
         return "pallas"
     if panel_impl not in ("jax", "pallas"):
         raise ValueError(f"unknown panel_impl {panel_impl!r}")
+    if (panel_impl == "pallas" and jax.default_backend() == "tpu"
+            and n is not None and panel is not None
+            and not panel_fits_vmem(n, panel, itemsize)):
+        # An EXPLICIT pallas request past the ceiling must fail with a
+        # sizing error, not a Mosaic scoped-VMEM error (ADVICE r3) — on a
+        # real TPU only; everywhere else the kernel runs in interpret mode,
+        # which has no VMEM limit.
+        raise ValueError(
+            f"panel_impl='pallas' requested but the (h={n}, panel={panel}) "
+            f"panel block exceeds the VMEM budget; use panel_impl='auto' "
+            f"(stock-JAX panel there) or a narrower panel")
     return panel_impl
 
 
@@ -272,14 +286,22 @@ def _fold_transpositions(ipiv, kb, h: int, panel: int):
     return lax.fori_loop(0, panel, fold, jnp.arange(h) + ipiv[0] * 0)
 
 
-def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype):
+def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype,
+                        w: int | None = None):
     """Install the factored panel at column kb of the (row-permuted) ``sub``,
     compute the diagonal-block inverses, apply U12 = L11^-1 A12, and the
     masked trailing GEMM. Returns (sub, linv_k, uinv_k). Shared by the
     fori_loop and chunked factorizations — they must stay in numerical
-    lockstep."""
+    lockstep.
+
+    ``sub`` may be rectangular (h, w): the chunked factorization passes only
+    the group's own column block (w = chunk*panel), deferring the update of
+    the columns right of the group to one big GEMM per group (see
+    lu_factor_blocked_chunked) — the per-panel update then touches O(h*w)
+    instead of O(h^2)."""
+    w = h if w is None else w
     rows = jnp.arange(h)
-    cols = jnp.arange(h)
+    cols = jnp.arange(w)
     sub = lax.dynamic_update_slice(sub, p, (0, kb))
 
     # Diagonal-block inverses (TRTRI+GEMM): U12 and lu_solve become GEMMs
@@ -289,7 +311,7 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype):
 
     # Block row of U: U12 = L11^-1 A12, masked so finished columns
     # (multipliers left of the panel, the panel itself) stay untouched.
-    block_row = lax.dynamic_slice(sub, (kb, 0), (panel, h))
+    block_row = lax.dynamic_slice(sub, (kb, 0), (panel, w))
     solved = jnp.dot(linv_k, block_row, precision=gemm_prec)
     right = cols >= kb + panel
     block_row = jnp.where(right[None, :], solved, block_row)
@@ -608,6 +630,20 @@ def lu_factor_blocked_chunked(a: jax.Array,
     The group's left L-multiplier columns are realigned ONCE per group after
     its local permutations compose — per-panel realignment measured slower
     (gathers are per-op latency-bound), per-group is chunk x fewer ops.
+
+    Round 4 restructure (VERDICT r3 next #1, the lookahead form): panels
+    inside a group factor and update ONLY the group's own (gh, W=chunk*panel)
+    column block — each next panel is factored from columns the narrow
+    update already brought current, before any of the right-of-group
+    trailing matrix is touched. The columns right of the group then receive
+    ONE composed-permutation gather, one blockwise L^-1 solve (lax.scan over
+    the group's chunk block rows), and one big unmasked (gh-W, W) x (W, rt)
+    MXU GEMM per group. The per-panel full-width masked GEMM + full
+    submatrix gather of the round-3 form did ~chunk x more HBM traffic for
+    the same FLOPs; measured at n=16384 chunk-8 this restructure took the
+    factorization 0.59 s -> ~0.2 s class (see reports). This completes the
+    reference Version-2's cache-blocking idea
+    (Pthreads/Version-2/gauss_internal_input.c:162-173) at MXU scale.
     """
     from gauss_tpu.core.matmul import resolve_precision
 
@@ -620,7 +656,6 @@ def lu_factor_blocked_chunked(a: jax.Array,
         raise ValueError(f"expected square matrix, got {a.shape}")
     itemsize = jnp.dtype(a.dtype).itemsize
     panel = _resolve_panel(n, panel, itemsize)
-    panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
@@ -633,40 +668,102 @@ def lu_factor_blocked_chunked(a: jax.Array,
         gs = g0 * panel              # group start row/col (static)
         gh = npad - gs               # static trailing size
         gpanels = min(chunk, nb - g0)
-        sub = m[gs:, gs:]            # (gh, gh) trailing submatrix
+        w = gpanels * panel          # group block width (static)
+        rt = gh - w                  # right-of-group trailing width (static)
+        grp = m[gs:, gs:gs + w]      # (gh, w) group column block
+        # Panel-impl resolution is PER GROUP on the group height: the Pallas
+        # kernel's VMEM block is (panel, gh), so even when the FIRST groups
+        # of a very large n exceed the budget (n=32768 at panel 64 does),
+        # every group past the ceiling runs the fast kernel — only the
+        # early ones fall back to the stock-JAX panel. This is what extends
+        # the chunked route to the single-chip HBM ceiling (VERDICT r3
+        # next #2); explicit "jax"/"pallas" requests stay global.
+        impl_g = _resolve_panel_impl(panel_impl, gh, panel, itemsize)
 
-        def body(j, carry, gh=gh):
-            sub, gperm, min_piv, linvs, uinvs = carry
+        def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
+            grp, gperm, min_piv, linvs, uinvs = carry
             kb = j * panel           # panel offset WITHIN the group
-            p, ipiv, perm_local, mp = _factor_panel(sub, kb, gh, panel,
+            p, ipiv, perm_local, mp = _factor_panel(grp, kb, gh, panel,
                                                     panel_impl)
             if perm_local is None:
                 perm_local = _fold_transpositions(ipiv, kb, gh, panel)
             min_piv = jnp.minimum(min_piv, mp)
-            sub = sub[perm_local]
+            grp = grp[perm_local]
             gperm = gperm[perm_local]
 
-            sub, linv_k, uinv_k = _install_and_update(sub, kb, gh, panel, p,
-                                                      gemm_prec, dtype)
+            grp, linv_k, uinv_k = _install_and_update(grp, kb, gh, panel, p,
+                                                      gemm_prec, dtype, w=w)
             linvs = lax.dynamic_update_slice(linvs, linv_k[None], (j, 0, 0))
             uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (j, 0, 0))
-            return sub, gperm, min_piv, linvs, uinvs
+            return grp, gperm, min_piv, linvs, uinvs
 
         gperm0 = jnp.arange(gh)
         linvs0 = jnp.zeros((gpanels, panel, panel), dtype)
         uinvs0 = jnp.zeros((gpanels, panel, panel), dtype)
-        sub, gperm, min_piv, linvs, uinvs = lax.fori_loop(
-            0, gpanels, body, (sub, gperm0, min_piv, linvs0, uinvs0))
+        grp, gperm, min_piv, linvs, uinvs = lax.fori_loop(
+            0, gpanels, body, (grp, gperm0, min_piv, linvs0, uinvs0))
 
         # One fix-up per group: realign the left L-multiplier columns
         # (written by earlier groups) with this group's composed permutation.
         if gs:
             left = m[gs:, :gs][gperm]
             m = m.at[gs:, :gs].set(left)
-        m = m.at[gs:, gs:].set(sub)
+        m = m.at[gs:, gs:gs + w].set(grp)
         perm = perm.at[gs:].set(perm[gs:][gperm])
         linvs_all.append(linvs)
         uinvs_all.append(uinvs)
+
+        if rt:
+            # Deferred right-of-group update: gather the group's block rows
+            # of the right columns with the composed permutation, compute
+            # U12 = L_group^-1 A12 as a blockwise scan over the group's
+            # chunk block rows (same zero-meets-U argument as
+            # _blockwise_substitution_scan), then the whole group's
+            # trailing contribution as one logical (gh-w, w) x (w, rt) MXU
+            # GEMM — executed in bounded ROW STRIPS so peak HBM residency
+            # stays ~2 matrix copies + O(strip) transients: the full-size
+            # gather + GEMM temporaries of the unstripped form OOMed the
+            # chip at n=32768 (4.3 GB matrix, ~16 GB peak), while the strip
+            # form keeps the whole 24.5k-34k band on this route.
+            top = m[gs + gperm[:w]][:, gs + w:]     # (w, rt) block rows
+
+            def usolve(x, i, grp=grp):
+                rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
+                r = lax.dynamic_slice(top, (i * panel, 0), (panel, rt))
+                r = r - jnp.dot(rows, x, precision=gemm_prec)
+                xi = jnp.dot(linvs[i], r, precision=gemm_prec)
+                return lax.dynamic_update_slice(x, xi, (i * panel, 0)), i
+
+            u12, _ = lax.scan(usolve, jnp.zeros((w, rt), dtype),
+                              jnp.arange(gpanels))
+
+            def a22_strip(rows_idx, l21_strip):
+                old = m[gs + rows_idx][:, gs + w:]   # gathered old rows
+                return old - jnp.dot(l21_strip, u12, precision=gemm_prec)
+
+            sw = min(GROUP_UPDATE_STRIP, gh - w)
+            nfull = (gh - w) // sw
+            fresh = jnp.zeros((gh - w, rt), dtype)
+
+            def strip_body(s, fresh):
+                r0 = w + s * sw
+                idx = lax.dynamic_slice(gperm, (r0,), (sw,))
+                l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
+                return lax.dynamic_update_slice(
+                    fresh, a22_strip(idx, l21), (s * sw, 0))
+
+            fresh = lax.fori_loop(0, nfull, strip_body, fresh)
+            tail = (gh - w) - nfull * sw
+            if tail:
+                fresh = lax.dynamic_update_slice(
+                    fresh,
+                    a22_strip(gperm[w + nfull * sw:], grp[w + nfull * sw:]),
+                    (nfull * sw, 0))
+            # Writes come LAST: gperm[w:] can name original rows < w, so
+            # every strip must read the right region's OLD data — the u12
+            # block-row write would clobber exactly those rows.
+            m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
+            m = lax.dynamic_update_slice(m, fresh, (gs + w, gs + w))
 
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=jnp.concatenate(linvs_all),
@@ -687,7 +784,12 @@ UNROLL_MAX_N = 4096  # above this, full unroll costs too much compile payload
 MAX_CHUNK_GROUPS = 24
 
 
-MAX_CHUNK = 16  # escalation ceiling; beyond chunk=16 groups get too big
+MAX_CHUNK = 32  # escalation ceiling: chunk-32 at panel 64 reaches
+# 24 * 32 * 64 = 49k — past the single-chip HBM ceiling (~34k), so the
+# flat fori fallback is never the route below it (VERDICT r3 next #2).
+# Group count, not group size, is what the tunneled compiler cannot
+# absorb (see MAX_CHUNK_GROUPS); wider groups also make the one deferred
+# trailing GEMM per group deeper (W = 2048 at panel 64).
 
 
 def resolve_factor(n: int, unroll):

@@ -408,14 +408,30 @@ def solve_dist_blocked2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     return factor_solve_dist_blocked2d_staged(staged, mesh)[0]
 
 
+def _check_not_singular(fac: DistBlocked2DLU) -> None:
+    """Raise on a zero tournament pivot (ADVICE r3: on an all-zero candidate
+    column the tournament argmax can elect a finished row and the swap would
+    silently corrupt the factor — min_piv == 0 is the witness; surfacing it
+    matches the reference's singular-matrix abort,
+    gauss_internal_input.c:95-98). One scalar D2H fetch; the staged/timed
+    entry points stay unchecked so timed spans never host-sync."""
+    if float(np.min(np.asarray(fac.min_piv))) == 0.0:
+        raise np.linalg.LinAlgError(
+            "matrix is singular (zero tournament pivot in the 2-D blocked "
+            "factorization)")
+
+
 def gauss_solve_dist_blocked2d(a, b, mesh: jax.sharding.Mesh = None,
                                panel: int | None = None) -> jax.Array:
     """2-D panel-blocked distributed dense solve; x replicated, natural
     order. The pod-scale formulation (see module docstring); the 1-D
-    blocked engine remains the small-mesh default."""
+    blocked engine remains the small-mesh default. Raises LinAlgError on a
+    singular input (zero tournament pivot)."""
     mesh, panel = _resolve_mesh_panel(a, mesh, panel)
     staged = prepare_dist_blocked2d(a, b, mesh, panel=panel)
-    return solve_dist_blocked2d_staged(staged, mesh)
+    x, fac = factor_solve_dist_blocked2d_staged(staged, mesh)
+    _check_not_singular(fac)
+    return x
 
 
 def gauss_solve_dist_blocked2d_refined(a, b, mesh: jax.sharding.Mesh = None,
@@ -434,5 +450,7 @@ def gauss_solve_dist_blocked2d_refined(a, b, mesh: jax.sharding.Mesh = None,
     staged = prepare_dist_blocked2d(a64.astype(np.float32),
                                     b64.astype(np.float32), mesh, panel=panel)
     x0, fac = factor_solve_dist_blocked2d_staged(staged, mesh)
+    _check_not_singular(fac)  # a refined f64 answer must not look
+    # authoritative when the underlying factor silently lost rank
     return host_refine(a64, b64, x0,
                        lambda r: lu_solve_dist_blocked2d(fac, r), iters, tol)

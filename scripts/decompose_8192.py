@@ -1,0 +1,98 @@
+"""Decompose the n=8192 factor+solve time: panel kernel / factor / solve.
+
+Usage: python scripts/decompose_8192.py [n [panel [chunk]]]
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gauss_tpu.bench.slope import PERTURB, measure_slope_info
+from gauss_tpu.core import blocked
+from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+panel = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+a[np.arange(n), np.arange(n)] += n / 100.0
+b = rng.standard_normal(n).astype(np.float32)
+ad = jax.block_until_ready(jnp.asarray(a))
+bd = jax.block_until_ready(jnp.asarray(b))
+nb = n // panel
+
+
+def report(name, make_chain, args, ks=1, kl=4):
+    sec, k1, k2, s = measure_slope_info(make_chain, args, k_small=ks,
+                                        k_large=kl, rounds=8)
+    print(f"{name}: {sec*1e3:.2f} ms (K={k1}/{k2}, slope={s})", flush=True)
+    return sec
+
+
+# 1. One panel factor on an (n, panel) block, chained.
+def make_panel_chain(k):
+    @jax.jit
+    def run(a_, x0):
+        def body(_, x):
+            p = lax.dynamic_slice(a_, (0, 0), (n, panel)) \
+                + x * jnp.asarray(PERTURB, a_.dtype)
+            out, ipiv, perm, mp = panel_factor_pallas(p, 0)
+            return out[0, 0] + mp
+
+        x = lax.fori_loop(0, k, body, x0)
+        return x
+
+    return run
+
+
+t_panel = report("one panel_factor_pallas (h=n)", make_panel_chain,
+                 (ad, jnp.zeros((), jnp.float32)), ks=4, kl=16)
+print(f"  x nb={nb} panels (upper bound, h shrinks in groups): "
+      f"{t_panel*nb*1e3:.1f} ms", flush=True)
+
+
+# 2. Factor only.
+def make_factor_chain(k):
+    @jax.jit
+    def run(a_, x0):
+        def body(_, x):
+            fac = blocked.lu_factor_blocked_chunked(
+                a_ + x * jnp.asarray(PERTURB, a_.dtype), panel=panel,
+                chunk=chunk)
+            return fac.m[0, 0] + fac.min_abs_pivot
+
+        return lax.fori_loop(0, k, body, x0)
+
+    return run
+
+
+t_factor = report(f"factor only (chunked p{panel} c{chunk})",
+                  make_factor_chain, (ad, jnp.zeros((), jnp.float32)))
+
+# 3. Solve only (factor fixed, chained solves).
+fac = jax.block_until_ready(
+    blocked.lu_factor_blocked_chunked(ad, panel=panel, chunk=chunk))
+
+
+def make_solve_chain(k):
+    @jax.jit
+    def run(m, perm, mp, linv, uinv, b_, x0):
+        f = blocked.BlockedLU(m, perm, mp, linv, uinv)
+
+        def body(_, x):
+            return blocked.lu_solve(f, b_ + x[0] * jnp.asarray(PERTURB,
+                                                               b_.dtype))
+
+        return jnp.sum(lax.fori_loop(0, k, body, x0))
+
+    return run
+
+
+t_solve = report("solve only", make_solve_chain,
+                 (fac.m, fac.perm, fac.min_abs_pivot, fac.linv, fac.uinv,
+                  bd, bd), ks=4, kl=16)
+print(f"TOTAL accounted: factor {t_factor*1e3:.1f} + solve "
+      f"{t_solve*1e3:.1f} ms", flush=True)

@@ -1,0 +1,36 @@
+"""Sweep (panel, chunk) for the grouped chunked factorization on the chip.
+
+Usage: python scripts/sweep_grouped.py <n> "panel,chunk" "panel,chunk" ...
+"""
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gauss_tpu.bench.slope import measure_slope_info, solver_chain
+from gauss_tpu.core import blocked
+
+n = int(sys.argv[1])
+configs = [tuple(int(v) for v in s.split(",")) for s in sys.argv[2:]]
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+a[np.arange(n), np.arange(n)] += n / 100.0
+b = rng.standard_normal(n).astype(np.float32)
+ad = jax.block_until_ready(jnp.asarray(a))
+bd = jax.block_until_ready(jnp.asarray(b))
+
+for panel, chunk in configs:
+    def solve_once(a_, b_, panel=panel, chunk=chunk):
+        fac = blocked.lu_factor_blocked_chunked(a_, panel=panel, chunk=chunk)
+        return blocked.lu_solve(fac, b_)
+
+    x = np.asarray(solve_once(ad, bd), np.float64)
+    r = np.linalg.norm(a @ x - b) / np.linalg.norm(b)
+    make_chain, args = solver_chain(ad, bd, solve_once)
+    sec, k1, k2, is_slope = measure_slope_info(make_chain, args,
+                                               k_small=1, k_large=4,
+                                               rounds=8)
+    print(f"n={n} panel={panel} chunk={chunk}: {sec*1e3:.1f} ms "
+          f"(K={k1}/{k2}, slope={is_slope}, relres={r:.1e})", flush=True)

@@ -178,6 +178,19 @@ def test_grid_device_span_gauss_and_matmul():
     assert mm[0].span == "device" and mm[0].verified and mm[0].seconds > 0
 
 
+def test_grid_jax_linalg_baseline_column():
+    """The stock-library baseline column (VERDICT r3 next #4):
+    jax.scipy.linalg.solve runs as a slope-timed device-span cell; in the
+    reference span it fails loudly instead of silently timing nothing."""
+    cells = grid.run_suite("gauss-internal", [32], ["jax-linalg"],
+                           span="device")
+    assert cells[0].span == "device"
+    assert cells[0].verified and cells[0].seconds > 0
+    ref_cells = grid.run_suite("gauss-internal", [32], ["jax-linalg"])
+    assert not ref_cells[0].verified
+    assert "device-span-only" in ref_cells[0].note
+
+
 def test_grid_rejects_unknown_span():
     with pytest.raises(ValueError, match="span"):
         grid.run_suite("matmul", [16], ["tpu"], span="bogus")

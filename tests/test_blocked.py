@@ -331,10 +331,15 @@ def test_resolve_panel_impl_vmem_fallback(monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert blocked._resolve_panel_impl("auto", 2048, 256) == "pallas"
     assert blocked._resolve_panel_impl("auto", 65536, 64) == "jax"
-    # Explicit requests are never overridden.
-    assert blocked._resolve_panel_impl("pallas", 65536, 64) == "pallas"
+    # An explicit pallas request past the ceiling raises a sizing error on
+    # a real TPU (ADVICE r3) instead of dying in Mosaic.
+    with pytest.raises(ValueError, match="VMEM budget"):
+        blocked._resolve_panel_impl("pallas", 65536, 64)
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked._resolve_panel_impl("auto", 2048, 256) == "jax"
+    # Off-TPU the kernel runs in interpret mode (no VMEM limit): explicit
+    # requests are never overridden or rejected.
+    assert blocked._resolve_panel_impl("pallas", 65536, 64) == "pallas"
 
 
 def test_lu_solve_scan_form_matches_unrolled(rng):
@@ -465,7 +470,12 @@ def test_resolve_factor_policy(monkeypatch):
     f = blocked.resolve_factor(24576, "auto")  # panel 64 -> 384 blocks
     assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
     assert f.keywords["chunk"] == 16
-    # Past chunk-16's reach: the flat program.
-    assert blocked.resolve_factor(34048, "auto") is blocked.lu_factor_blocked
+    # Round 4: chunk escalates to 32, so the chunked route covers the whole
+    # single-chip range — the flat fori fallback is never the route below
+    # the HBM ceiling (~34k) anymore (VERDICT r3 next #2).
+    for big_n in (32768, 34048):
+        f = blocked.resolve_factor(big_n, "auto")
+        assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
+        assert f.keywords["chunk"] == 32
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked

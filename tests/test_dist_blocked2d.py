@@ -131,6 +131,19 @@ def test_singular_detected(mesh42):
     assert float(fac.min_piv) == 0.0
 
 
+def test_singular_raises_on_solve_entries(mesh42):
+    """ADVICE r3: the convenience and refined entries must not return an
+    authoritative-looking answer from a rank-deficient factorization — the
+    zero tournament pivot is the witness and both entries raise on it."""
+    n = 32
+    a = np.ones((n, n))  # rank 1
+    b = np.ones(n)
+    with pytest.raises(np.linalg.LinAlgError, match="singular"):
+        g2d.gauss_solve_dist_blocked2d(a, b, mesh=mesh42, panel=8)
+    with pytest.raises(np.linalg.LinAlgError, match="singular"):
+        g2d.gauss_solve_dist_blocked2d_refined(a, b, mesh=mesh42, panel=8)
+
+
 def test_nonsingular_min_piv_positive(mesh42, rng):
     a, b, _ = _system(64, rng)
     staged = g2d.prepare_dist_blocked2d(a, b, mesh42, panel=8)

@@ -133,8 +133,13 @@ def test_stripe_matches_tiled_variant(rng):
 
     a = rng.standard_normal((96, 96)).astype(np.float32)
     b = rng.standard_normal((96, 96)).astype(np.float32)
-    c1 = np.asarray(matmul_pallas(a, b, bm=32, bn=128, bk=128))
-    c2 = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128))
+    # Pinned to "highest": this checks the two tilings compute the same
+    # product; under the default bf16x3 the tilings' different accumulation
+    # orders would only agree to ~1e-3.
+    c1 = np.asarray(matmul_pallas(a, b, bm=32, bn=128, bk=128,
+                                  precision="highest"))
+    c2 = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128,
+                                         precision="highest"))
     np.testing.assert_allclose(c1, c2, rtol=1e-5, atol=1e-5)
 
 
@@ -183,9 +188,42 @@ def test_stripe_shrunk_blocks_correct(rng):
 
     a = rng.standard_normal((96, 80)).astype(np.float32)
     b = rng.standard_normal((80, 160)).astype(np.float32)
-    c = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128))
+    c = np.asarray(matmul_pallas_stripe(a, b, bm=32, bk=128,
+                                        precision="highest"))
     np.testing.assert_allclose(
         c, a.astype(np.float64) @ b.astype(np.float64), rtol=1e-5, atol=1e-4)
+
+
+def test_matmul_pallas_bf16x3_meets_comparator(rng):
+    """The manual in-kernel bf16x3 path (the "high" default, VERDICT r3
+    next #3) must pass the reference's eps=1e-4 comparator (scaled, as the
+    CLI applies it) on both kernels, and must clearly beat a single bf16
+    pass; "highest" stays available and tighter."""
+    from gauss_tpu.kernels.matmul_pallas import (matmul_pallas,
+                                                 matmul_pallas_stripe)
+
+    m, k, n = 128, 512, 256
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    scale = np.abs(ref).max()
+    c_high = np.asarray(matmul_pallas(a, b, precision="high"))
+    assert checks.elementwise_match(c_high, ref,
+                                    epsilon=checks.EPSILON * scale)
+    c_stripe = np.asarray(matmul_pallas_stripe(a, b, precision="high"))
+    assert checks.elementwise_match(c_stripe, ref,
+                                    epsilon=checks.EPSILON * scale)
+    # A lone bf16 pass loses the low mantissa bits the x3 scheme recovers.
+    import jax.numpy as jnp
+
+    a16 = jnp.asarray(a).astype(jnp.bfloat16).astype(jnp.float32)
+    b16 = jnp.asarray(b).astype(jnp.bfloat16).astype(jnp.float32)
+    c_bf16 = np.asarray(jnp.dot(a16, b16), np.float64)
+    err_high = np.abs(c_high - ref).max()
+    err_bf16 = np.abs(c_bf16 - ref).max()
+    assert err_high < err_bf16 / 10
+    c_highest = np.asarray(matmul_pallas(a, b, precision="highest"))
+    assert np.abs(c_highest - ref).max() <= err_high
 
 
 @pytest.mark.parametrize("n,k", [(32, 8), (100, 16), (200, 32)])
@@ -200,6 +238,46 @@ def test_gauss_solve_rowelim_batched(rng, n, k):
                    np.float64)
     ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
     np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_rowelim_batched_scan_substitution(rng):
+    """Above ROWELIM_UNROLL_MAX_NB blocks the back-substitution runs as one
+    lax.scan (VERDICT r3 weak #4 — the unrolled chain's trace payload kept
+    the engine out of the 16384 cell); it must agree with the unrolled form
+    at an nb just past the threshold."""
+    from gauss_tpu.kernels import rowelim_pallas as rp
+
+    k = 8
+    n = k * (rp.ROWELIM_UNROLL_MAX_NB + 3)  # nb > threshold -> scan form
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = np.asarray(rp.gauss_solve_rowelim_batched(a, b, k=k, bm=8, bn=64),
+                   np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_rowelim_explicit_pallas_past_vmem_ceiling_raises(monkeypatch):
+    """An explicit panel_impl='pallas' past the VMEM ceiling must fail with
+    a clear sizing error, not a Mosaic VMEM error (ADVICE r3); 'auto'
+    resolves to the stock-JAX panel there instead. The check lives in
+    _resolve_panel_impl, shared with every core.blocked entry, and applies
+    only on a real TPU (interpret mode has no VMEM limit)."""
+    import jax
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.kernels import rowelim_pallas as rp
+
+    # Shrink the budget so a tiny system is "past the ceiling" — the real
+    # ceiling needs n ~ 60k, unaffordable in a unit test — and fake a TPU
+    # backend (the raise is trace-time, before any Mosaic lowering).
+    monkeypatch.setattr(blocked, "PANEL_VMEM_BUDGET", 1024)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    a = np.eye(64, dtype=np.float32)
+    b = np.zeros(64, dtype=np.float32)
+    with pytest.raises(ValueError, match="VMEM budget"):
+        rp.gauss_solve_rowelim_batched(a, b, k=16, bm=16, bn=64,
+                                       panel_impl="pallas")
 
 
 def test_rowelim_batched_matches_per_step(rng):
