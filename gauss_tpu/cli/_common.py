@@ -115,13 +115,18 @@ def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel, refine_tol):
         # to test — the fixed budget subsumes it, see DS_REFINE_STEPS).
         from gauss_tpu.core import dsfloat
 
+        import jax
+
         a64c = np.asarray(a64, np.float64)
         b64c = np.asarray(b64, np.float64)
         eye = np.eye(n)
-        dsfloat.solve_once_ds(_stage(eye)[0], dsfloat.to_ds(eye.T),
-                              dsfloat.to_ds(np.zeros(n)), panel,
-                              iters=refine_iters)  # jit warmup at shape
-        import jax
+        # jit warmup at shape — BLOCKED on: the TPU executes enqueued
+        # programs in order, so an un-fetched warmup would still be running
+        # when the timed span below opens and would be billed to it.
+        jax.block_until_ready(
+            dsfloat.solve_once_ds(_stage(eye)[0], dsfloat.to_ds(eye.T),
+                                  dsfloat.to_ds(np.zeros(n)), panel,
+                                  iters=refine_iters))
 
         a_dev = _stage(a64c)[0]
         at_ds = jax.block_until_ready(dsfloat.to_ds(a64c.T))

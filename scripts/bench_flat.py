@@ -1,0 +1,37 @@
+"""One-shot timing of the FLAT fori factorization route (comparison for
+the chunked route at sizes near the HBM ceiling).
+
+Usage: python scripts/bench_flat.py [n] [reps]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gauss_tpu.bench.slope import gauss_solve_once
+from gauss_tpu.core.blocked import auto_panel
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+reps = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+a[np.arange(n), np.arange(n)] += n / 100.0
+b = rng.standard_normal(n).astype(np.float32)
+ad = jax.block_until_ready(jnp.asarray(a))
+bd = jax.block_until_ready(jnp.asarray(b))
+panel = auto_panel(n)
+print(f"n={n}: flat route (unroll=False), panel={panel}", flush=True)
+t0 = time.perf_counter()
+x = np.asarray(gauss_solve_once(ad, bd, panel, unroll=False), np.float64)
+print(f"compile+first: {time.perf_counter()-t0:.1f} s", flush=True)
+r = np.linalg.norm(a @ x - b) / np.linalg.norm(b)
+print(f"relres={r:.1e}", flush=True)
+ts = []
+for _ in range(reps):
+    t0 = time.perf_counter()
+    np.asarray(gauss_solve_once(ad, bd, panel, unroll=False))
+    ts.append(time.perf_counter() - t0)
+print(f"n={n} flat: {min(ts):.3f} s one-shot min of {reps} "
+      f"(all={[f'{t:.2f}' for t in ts]})", flush=True)

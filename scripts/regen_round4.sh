@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Round-4 report regeneration, staged so partial results survive
+# interruption. Run on the TPU host; takes a few hours behind the tunnel.
+# Stages write /tmp/r4_*.json; the final report step combines them with
+# the round-3 cells that are still current (precision sweep, dist sweep).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+stage() {  # stage <name> <args...>: skip if the json already exists
+    local out="/tmp/r4_$1.json"; shift
+    if [ -s "$out" ]; then echo "== skip $out (exists)"; return 0; fi
+    echo "== running $out"
+    python -m gauss_tpu.bench.grid "$@" --json "$out" || echo "== FAILED $out"
+}
+
+stage gi   --suite gauss-internal \
+           --backends tpu,tpu-unblocked,seq,omp,threads,forkjoin,tiled
+stage gid  --suite gauss-internal \
+           --backends tpu,tpu-rowelim,tpu-rowelim-step,jax-linalg --span device
+stage gil  --suite gauss-internal --keys 4096,8192 \
+           --backends tpu,tpu-rowelim,jax-linalg --span device
+stage gi16 --suite gauss-internal --keys 16384 \
+           --backends tpu,tpu-rowelim,jax-linalg --span device
+stage ge   --suite gauss-external --backends tpu,seq,omp \
+           --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3
+stage gem  --suite gauss-external --keys memplus --backends tpu
+stage gemd --suite gauss-external --keys memplus --backends tpu --span device
+stage ged  --suite gauss-external --backends tpu --span device
+stage mm   --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp
+stage mmd  --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,tpu-dist \
+           --span device
+stage mm16 --suite matmul --keys 16384 --backends tpu,tpu-pallas --span device
+
+echo "== all stages done; artifacts in /tmp/r4_*.json"

@@ -191,6 +191,20 @@ def test_grid_jax_linalg_baseline_column():
     assert "device-span-only" in ref_cells[0].note
 
 
+def test_grid_matmul_sampled_verification(monkeypatch):
+    """n >= MATMUL_SAMPLE_N: exact f64 truth on a seeded row sample, device
+    span only, the sample labeled in the note; the reference span refuses
+    loudly instead of silently timing a multi-GB fetch."""
+    monkeypatch.setattr(grid, "MATMUL_SAMPLE_N", 64)
+    monkeypatch.setattr(grid, "MATMUL_SAMPLE_ROWS", 8)
+    cells = grid.run_suite("matmul", [96], ["tpu"], span="device")
+    assert cells[0].span == "device"
+    assert cells[0].verified and cells[0].seconds > 0
+    assert "8-row sample" in cells[0].note
+    ref = grid.run_suite("matmul", [96], ["tpu"])
+    assert not ref[0].verified and "device span" in ref[0].note
+
+
 def test_grid_rejects_unknown_span():
     with pytest.raises(ValueError, match="span"):
         grid.run_suite("matmul", [16], ["tpu"], span="bogus")
