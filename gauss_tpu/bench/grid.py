@@ -206,7 +206,14 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
                     res_dev < RESIDUAL_BAR, res_dev,
                     baselines.reference_seconds("gauss-internal", n, backend),
                     span="device")
-    x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads)
+    # refine_iters=2: the internal synthetic system solves exactly in one
+    # f32 factor+solve (measured residual 0.0 at every reference size), so
+    # the tol exits refinement immediately — the default budget of 8 would
+    # route through the fixed-iteration ds chain and pay 8 pointless
+    # on-device iterations per solve (measured 2x on this column). The
+    # external suite keeps the big budget; its matrices need it.
+    x, elapsed = _common.solve_with_backend(a, b, backend, nthreads=nthreads,
+                                            refine_iters=2)
     res = checks.residual_norm(a, x, b)  # absolute, the BASELINE.json bar
     return Cell("gauss-internal", str(n), backend, init_s + elapsed,
                 res < RESIDUAL_BAR, res,
@@ -684,11 +691,20 @@ def main(argv=None) -> int:
         # request falls through to the per-suite validity filter and its
         # "no requested backend applies" notice.
         backends = list(DIST_BACKENDS)
-    known = set(_common.GAUSS_BACKENDS) | set(_common.MATMUL_BACKENDS)
+    # "jax-linalg" is bench-only (the stock-library baseline column), not a
+    # CLI solve backend — known here, not in _common.GAUSS_BACKENDS.
+    known = (set(_common.GAUSS_BACKENDS) | set(_common.MATMUL_BACKENDS)
+             | {"jax-linalg"})
     unknown = [b for b in backends if b not in known]
     if unknown:
         p.error(f"unknown backend(s) {unknown}; gauss: "
-                f"{_common.GAUSS_BACKENDS}; matmul: {_common.MATMUL_BACKENDS}")
+                f"{_common.GAUSS_BACKENDS} + jax-linalg (device span only); "
+                f"matmul: {_common.MATMUL_BACKENDS}")
+    if "jax-linalg" in backends and args.span != "device":
+        # Statically-detectable misuse gets a parse-time error, not a sweep
+        # of per-cell run-time failures.
+        p.error("jax-linalg is a device-span-only baseline column; add "
+                "--span device")
     sweep = None
     if args.thread_sweep:
         raw = [x.strip() for x in args.thread_sweep.split(",") if x.strip()]
@@ -714,6 +730,9 @@ def main(argv=None) -> int:
             valid = _common.MATMUL_BACKENDS
         elif suite == "gauss-dist":
             valid = DIST_BACKENDS
+        elif suite == "gauss-internal":
+            # + the bench-only stock-library baseline column (device span).
+            valid = _common.GAUSS_BACKENDS + ("jax-linalg",)
         else:
             valid = _common.GAUSS_BACKENDS
         suite_backends = [b for b in backends if b in valid]

@@ -35,12 +35,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=_common.GAUSS_BACKENDS, default="tpu")
     p.add_argument("--refine", type=int, default=2, metavar="K",
                    help="iterative-refinement budget for the tpu backend; "
-                        "K <= 2 refines host-side (early exit at "
-                        "--refine-tol), K > 2 runs the whole budget on "
-                        "device with double-single residuals")
+                        "K <= 2 (or n < 512) refines host-side with early "
+                        "exit at --refine-tol, K > 2 at n >= 512 runs the "
+                        "whole budget on device with double-single "
+                        "residuals")
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
-                   help="host-side refinement only (--refine <= 2): stop "
-                        "once ||Ax-b|| <= TOL*min(1, ||b||); 0 always runs "
+                   help="host-side refinement only: stop once "
+                        "||Ax-b|| <= TOL*min(1, ||b||); 0 always runs "
                         "exactly --refine steps")
     p.add_argument("--panel", type=int, default=None,
                    help="panel width for the blocked tpu backend "

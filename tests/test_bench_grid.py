@@ -191,6 +191,21 @@ def test_grid_jax_linalg_baseline_column():
     assert "device-span-only" in ref_cells[0].note
 
 
+def test_grid_cli_accepts_jax_linalg(tmp_path):
+    """The bench-only baseline backend must pass the CLI's backend
+    validation (it is not in _common.GAUSS_BACKENDS — round-4 regression:
+    the device-span regen stages all died on p.error)."""
+    out = tmp_path / "c.json"
+    rc = grid.main(["--suite", "gauss-internal", "--keys", "32",
+                    "--backends", "jax-linalg", "--span", "device",
+                    "--json", str(out)])
+    assert rc == 0
+    import json
+
+    cells = json.loads(out.read_text())
+    assert cells[0]["backend"] == "jax-linalg" and cells[0]["verified"]
+
+
 def test_grid_matmul_sampled_verification(monkeypatch):
     """n >= MATMUL_SAMPLE_N: exact f64 truth on a seeded row sample, device
     span only, the sample labeled in the note; the reference span refuses

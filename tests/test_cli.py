@@ -86,17 +86,20 @@ def test_gauss_external(tmp_path, capsys):
     assert m and float(m.group(1)) < 1e-3
 
 
-def test_tpu_backend_ds_route_for_large_refine_budget():
-    """refine_iters > 2 routes the tpu backend through the on-device
-    double-single chain (VERDICT r3 weak #5: host-driven refinement paid a
-    tunnel round trip per iteration); same answer, same contract."""
+def test_tpu_backend_ds_route_for_large_refine_budget(monkeypatch):
+    """refine_iters > 2 (at or above DS_ROUTE_MIN_N) routes the tpu backend
+    through the on-device double-single chain (VERDICT r3 weak #5:
+    host-driven refinement paid a tunnel round trip per iteration); same
+    answer, same contract. The size gate is patched down so the ds route
+    actually runs at test size."""
+    from gauss_tpu.cli import _common
+
+    monkeypatch.setattr(_common, "DS_ROUTE_MIN_N", 8)
     rng = np.random.default_rng(7)
     n = 48
     a = rng.standard_normal((n, n)) + n * np.eye(n)
     x_true = rng.standard_normal(n)
     b = a @ x_true
-    from gauss_tpu.cli import _common
-
     x_ds, t_ds = _common.solve_with_backend(a, b, "tpu", refine_iters=4)
     x_host, t_host = _common.solve_with_backend(a, b, "tpu", refine_iters=2)
     assert t_ds > 0 and t_host > 0
