@@ -58,10 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the reference's compile-time VERIFY, now a flag)")
     p.add_argument("--refine", type=int, default=2, metavar="K",
                    help="iterative-refinement budget for the f32 tpu "
-                        "backend; K <= 2 (or n < 512) refines host-side "
-                        "with early exit at --refine-tol, K > 2 at n >= 512 "
-                        "runs the whole budget on device with double-single "
-                        "residuals")
+                        "backend; K <= 2 (or n < "
+                        f"{_common.DS_ROUTE_MIN_N}) refines host-side with "
+                        "early exit at --refine-tol, larger budgets run "
+                        "fully on device with double-single residuals")
     p.add_argument("--refine-tol", type=float, default=1e-5, metavar="TOL",
                    help="host-side refinement only: stop once "
                         "||Ax-b|| <= TOL*min(1, ||b||); 0 always runs "

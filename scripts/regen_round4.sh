@@ -23,12 +23,20 @@ stage gi16 --suite gauss-internal --keys 16384 \
            --backends tpu,tpu-rowelim,jax-linalg --span device
 stage ge   --suite gauss-external --backends tpu,seq,omp \
            --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3
-stage gem  --suite gauss-external --keys memplus --backends tpu
-stage gemd --suite gauss-external --keys memplus --backends tpu --span device
 stage ged  --suite gauss-external --backends tpu --span device
 stage mm   --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp
 stage mmd  --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,tpu-dist \
            --span device
 stage mm16 --suite matmul --keys 16384 --backends tpu,tpu-pallas --span device
+# The round-3 tpu-pallas cells at 4096/8192 ran 6-pass HIGHEST; the kernels
+# now default to in-kernel bf16x3 — regenerate so the tables measure what
+# the engine ships.
+stage mm48 --suite matmul --keys 4096,8192 --backends tpu,tpu-pallas \
+           --span device
+
+# memplus last: its ds-chain compile at n=17758 is the longest pole and has
+# hung behind a dropped tunnel once; isolated so the rest of the grid lands.
+stage gem  --suite gauss-external --keys memplus --backends tpu
+stage gemd --suite gauss-external --keys memplus --backends tpu --span device
 
 echo "== all stages done; artifacts in /tmp/r4_*.json"
