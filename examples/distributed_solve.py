@@ -13,6 +13,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
 
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()  # JAX_PLATFORMS=cpu must win over a sitecustomize pin
+
 
 def main(n: int = 256, shards: int = 8) -> None:
     from gauss_tpu.utils.env import force_host_device_count

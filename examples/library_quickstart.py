@@ -9,6 +9,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
 
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()  # JAX_PLATFORMS=cpu must win over a sitecustomize pin
+
 import numpy as np
 
 from gauss_tpu.core.blocked import solve_refined

@@ -24,3 +24,19 @@ def force_host_device_count(n: int) -> bool:
         return current >= n
     os.environ["XLA_FLAGS"] = (flags + f" --{FORCE_FLAG}={n}").strip()
     return True
+
+
+def honor_jax_platforms() -> bool:
+    """Make JAX_PLATFORMS effective even where a sitecustomize re-pins a
+    device platform AFTER env processing (this image's tunneled-TPU setup
+    does): the jax.config update takes precedence over the pin. No-op (and
+    no jax import) when the variable is unset. Call before any
+    jax.devices() use; returns True if a platform was applied. Single
+    source for tests/conftest.py-style pinning in scripts and examples."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    return True

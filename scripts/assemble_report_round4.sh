@@ -32,7 +32,7 @@ for k in "${!dest[@]}"; do
         cp "/tmp/r4_$k.json" "reports/${dest[$k]}"
     else
         echo "MISSING /tmp/r4_$k.json (keeping old reports/${dest[$k]} if present)"
-        missing=1
+        missing=$((missing+1))
     fi
 done
 # Old per-size matmul files are superseded by cells_matmul_4096_8192.json.

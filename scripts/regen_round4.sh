@@ -13,10 +13,17 @@ stage() {  # stage <name> <args...>: skip if the json already exists
     python -m gauss_tpu.bench.grid "$@" --json "$out" || echo "== FAILED $out"
 }
 
-stage gi   --suite gauss-internal \
-           --backends tpu,tpu-unblocked,seq,omp,threads,forkjoin,tiled
 stage gid  --suite gauss-internal \
            --backends tpu,tpu-rowelim,tpu-rowelim-step,jax-linalg --span device
+stage mmd  --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,tpu-dist \
+           --span device
+# The round-3 tpu-pallas cells at 4096/8192 ran 6-pass HIGHEST; the kernels
+# now default to in-kernel bf16x3 — regenerate so the tables measure what
+# the engine ships.
+stage mm48 --suite matmul --keys 4096,8192 --backends tpu,tpu-pallas \
+           --span device
+stage gi   --suite gauss-internal \
+           --backends tpu,tpu-unblocked,seq,omp,threads,forkjoin,tiled
 stage gil  --suite gauss-internal --keys 4096,8192 \
            --backends tpu,tpu-rowelim,jax-linalg --span device
 stage gi16 --suite gauss-internal --keys 16384 \
@@ -25,15 +32,7 @@ stage ge   --suite gauss-external --backends tpu,seq,omp \
            --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3
 stage ged  --suite gauss-external --backends tpu --span device
 stage mm   --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp
-stage mmd  --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,tpu-dist \
-           --span device
 stage mm16 --suite matmul --keys 16384 --backends tpu,tpu-pallas --span device
-# The round-3 tpu-pallas cells at 4096/8192 ran 6-pass HIGHEST; the kernels
-# now default to in-kernel bf16x3 — regenerate so the tables measure what
-# the engine ships.
-stage mm48 --suite matmul --keys 4096,8192 --backends tpu,tpu-pallas \
-           --span device
-
 # memplus last: its ds-chain compile at n=17758 is the longest pole and has
 # hung behind a dropped tunnel once; isolated so the rest of the grid lands.
 stage gem  --suite gauss-external --keys memplus --backends tpu

@@ -1,0 +1,40 @@
+"""Sweep Pallas matmul tile shapes (bf16x3 in-kernel) vs the XLA engine.
+
+Usage: python scripts/sweep_matmul.py <n> "bm,bn,bk" ... (no configs = defaults)
+"""
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gauss_tpu.bench.slope import matmul_chain, measure_slope_info
+from gauss_tpu.core.matmul import matmul
+from gauss_tpu.kernels.matmul_pallas import matmul_pallas
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+configs = [tuple(int(v) for v in s.split(",")) for s in sys.argv[2:]] or [
+    (256, 256, 512), (256, 512, 512), (512, 256, 512), (256, 256, 1024),
+    (512, 512, 512), (128, 512, 512)]
+rng = np.random.default_rng(0)
+a = jax.block_until_ready(jnp.asarray(
+    rng.standard_normal((n, n)).astype(np.float32)))
+b = jax.block_until_ready(jnp.asarray(
+    rng.standard_normal((n, n)).astype(np.float32)))
+
+
+def bench(name, mm):
+    mk, args = matmul_chain(a, b, mm)
+    sec, k1, k2, s = measure_slope_info(mk, args)
+    gf = 2 * n**3 / sec / 1e9
+    print(f"{name}: {sec*1e3:.3f} ms ({gf/1000:.1f} TF/s, K={k1}/{k2}, "
+          f"slope={s})", flush=True)
+    return sec
+
+
+t_xla = bench("xla high (bf16x3)", matmul)
+for bm, bn, bk in configs:
+    t = bench(f"pallas bf16x3 bm={bm} bn={bn} bk={bk}",
+              partial(matmul_pallas, bm=bm, bn=bn, bk=bk))
+    print(f"   -> {t/t_xla:.2f}x of XLA", flush=True)

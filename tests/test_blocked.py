@@ -246,6 +246,38 @@ def test_chunked_matches_unrolled(rng, chunk, panel_impl):
     np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
 
 
+def test_chunked_strip_form_multi_strip_and_tail(rng, monkeypatch):
+    """The deferred right-of-group update runs in GROUP_UPDATE_STRIP-row
+    strips (HBM-transient bound; the unstripped form OOMed at n=32768).
+    At production sizes on CPU that path is a single strip, so shrink the
+    strip to force several full strips plus a ragged tail — the strip
+    arithmetic must be invisible in the result."""
+    from gauss_tpu.core import blocked
+
+    n = 200  # pads to 7 panels of 32; chunk 2 -> groups of 64 columns
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    import jax
+
+    fac_ref = blocked.lu_factor_blocked_chunked(a, panel=32, chunk=2)
+    fac_ref = jax.tree.map(np.asarray, fac_ref)  # hold values, not buffers
+    monkeypatch.setattr(blocked, "GROUP_UPDATE_STRIP", 48)  # strips + tail
+    # The strip width is a trace-time constant, not a jit static arg: a
+    # cached executable for this signature would silently ignore the patch
+    # and make the test vacuous.
+    jax.clear_caches()
+    fac_strip = blocked.lu_factor_blocked_chunked(a, panel=32, chunk=2,
+                                                  panel_impl="jax")
+    # Same math, different loop carving: factors agree to f32 noise (the
+    # jax/pallas-interpret panel impls are numerically identical, and the
+    # strip boundaries change no accumulation order inside any dot).
+    np.testing.assert_allclose(np.asarray(fac_strip.m),
+                               np.asarray(fac_ref.m), rtol=2e-4, atol=2e-4)
+    x = np.asarray(lu_solve(fac_strip, b), np.float64)
+    ref = np.linalg.solve(a.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+
+
 def test_resolve_factor_forced_modes():
     """Explicit unroll requests are never second-guessed; bad ones raise.
     (Was shadowed by a same-named test below until round 3.)"""

@@ -37,3 +37,18 @@ def test_force_host_device_count_flag_logic(monkeypatch):
     assert env.force_host_device_count(8) is True
     monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
     assert env.force_host_device_count(8) is False
+
+
+def test_honor_jax_platforms(monkeypatch):
+    """The shared sitecustomize workaround (examples + conftest): applies
+    JAX_PLATFORMS through jax.config (which beats a later platform pin),
+    no-ops when unset."""
+    import jax
+
+    from gauss_tpu.utils import env
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert env.honor_jax_platforms() is False
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert env.honor_jax_platforms() is True
+    assert jax.config.jax_platforms == "cpu"
