@@ -17,6 +17,8 @@ declare -A dest=(
     [gid]=cells_gauss_internal_device.json
     [gil]=cells_gauss_internal_large.json
     [gi16]=cells_gauss_internal_16384.json
+    [gi32]=cells_gauss_internal_32768.json
+    [mm24]=cells_matmul_24576.json
     [ge]=cells_gauss_external.json
     [gem]=cells_gauss_external_memplus.json
     [gemd]=cells_gauss_external_memplus_dev.json
@@ -45,6 +47,8 @@ files=(reports/cells_gauss_internal.json reports/cells_gauss_internal_device.jso
        reports/cells_gauss_external_memplus_dev.json reports/cells_gauss_external_device.json
        reports/cells_matmul.json reports/cells_matmul_device.json)
 [ -s reports/cells_matmul_16384.json ] && files+=(reports/cells_matmul_16384.json)
+[ -s reports/cells_gauss_internal_32768.json ] && files+=(reports/cells_gauss_internal_32768.json)
+[ -s reports/cells_matmul_24576.json ] && files+=(reports/cells_matmul_24576.json)
 if [ -s reports/cells_matmul_4096_8192.json ]; then
     files+=(reports/cells_matmul_4096_8192.json)
 else

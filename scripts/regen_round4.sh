@@ -28,11 +28,17 @@ stage gil  --suite gauss-internal --keys 4096,8192 \
            --backends tpu,tpu-rowelim,jax-linalg --span device
 stage gi16 --suite gauss-internal --keys 16384 \
            --backends tpu,tpu-rowelim,jax-linalg --span device
+# The 24.5k-34k band: the chunk-escalated deferred-update route must beat
+# the flat fori fallback all the way to the HBM ceiling (VERDICT r3
+# next #2) — these are the REPORT cells that back the README/DESIGN claim.
+stage gi32 --suite gauss-internal --keys 24576,32768 \
+           --backends tpu --span device
 stage ge   --suite gauss-external --backends tpu,seq,omp \
            --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3
 stage ged  --suite gauss-external --backends tpu --span device
 stage mm   --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp
 stage mm16 --suite matmul --keys 16384 --backends tpu,tpu-pallas --span device
+stage mm24 --suite matmul --keys 24576 --backends tpu --span device
 # memplus last: its ds-chain compile at n=17758 is the longest pole and has
 # hung behind a dropped tunnel once; isolated so the rest of the grid lands.
 stage gem  --suite gauss-external --keys memplus --backends tpu

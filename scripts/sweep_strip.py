@@ -1,0 +1,61 @@
+"""Sweep GROUP_UPDATE_STRIP for the deferred-update chunked factorization.
+
+The strip loop bounds group-end transients to O(strip * n) so n=32768 fits
+HBM (core/blocked.py GROUP_UPDATE_STRIP); but at moderate n the stripping
+serializes the one deferred trailing GEMM into several gather+GEMM rounds
+that a single unstripped pass may beat. This sweeps the strip size on the
+chip to find the routing rule.
+
+Monkeypatches the module constant; jax.clear_caches() between configs is
+REQUIRED because the constant is read at trace time and is not part of the
+jit cache key.
+
+Usage: python scripts/sweep_strip.py <n> <strip> [<strip> ...]
+       (strip 0 means unstripped: strip = full trailing height)
+"""
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from gauss_tpu.bench.slope import measure_slope_info, solver_chain
+from gauss_tpu.core import blocked
+
+n = int(sys.argv[1])
+strips = [int(v) for v in sys.argv[2:]]
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)).astype(np.float32)
+a[np.arange(n), np.arange(n)] += n / 100.0
+b = rng.standard_normal(n).astype(np.float32)
+ad = jax.block_until_ready(jnp.asarray(a))
+bd = jax.block_until_ready(jnp.asarray(b))
+
+for strip in strips:
+    jax.clear_caches()
+    blocked.GROUP_UPDATE_STRIP = strip if strip else 1 << 30
+
+    factor = blocked.resolve_factor(n, "auto")
+    # Guard against a silent no-op: GROUP_UPDATE_STRIP is read only by the
+    # chunked factorization; auto resolves elsewhere for n <= UNROLL_MAX_N,
+    # non-TPU backends, and past MAX_CHUNK's reach.
+    resolved = factor.func if isinstance(factor, partial) else factor
+    if resolved is not blocked.lu_factor_blocked_chunked:
+        sys.exit(f"n={n} resolves to {resolved.__name__}, which ignores "
+                 "GROUP_UPDATE_STRIP; pick n that routes chunked on this "
+                 "backend")
+
+    def solve_once(a_, b_):
+        return blocked.lu_solve(factor(a_), b_)
+
+    x = np.asarray(solve_once(ad, bd), np.float64)
+    r = np.linalg.norm(a @ x - b) / np.linalg.norm(b)
+    make_chain, args = solver_chain(ad, bd, solve_once)
+    sec, k1, k2, is_slope = measure_slope_info(make_chain, args,
+                                               k_small=1, k_large=4,
+                                               rounds=8)
+    print(f"n={n} strip={strip or 'full'}: {sec*1e3:.1f} ms "
+          f"(K={k1}/{k2}, slope={is_slope}, relres={r:.1e})", flush=True)
