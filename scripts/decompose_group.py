@@ -1,0 +1,232 @@
+"""Time each component of the chunked factorization's per-group epilogue.
+
+The n=8192 factor runs ~43 ms against ~14 ms of GEMM-bound work and ~12 ms
+of panel chain (scripts/decompose_8192.py), leaving ~16 ms in the group
+epilogue: permutation gathers, the U12 block substitution scan, and the
+strip-looped trailing GEMM. This times each component standalone at the
+REAL per-group shapes (summed over groups) so the glue budget has names,
+and times drop-in alternatives next to the shipped forms:
+
+- u12-scan vs u12 via a composed group L-inverse (one GEMM);
+- strip-looped trailing update vs one unstripped gather + GEMM.
+
+Usage: python scripts/decompose_group.py [n [panel [chunk]]]
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, ".")
+
+from gauss_tpu.bench.slope import measure_slope_info
+from gauss_tpu.core.blocked import GROUP_UPDATE_STRIP
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+panel = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+prec = lax.Precision.HIGHEST
+nb = n // panel
+w = chunk * panel
+rng = np.random.default_rng(0)
+m_host = rng.standard_normal((n, n)).astype(np.float32)
+md = jax.block_until_ready(jnp.asarray(m_host))
+# A realistic group permutation: local row swaps within the trailing block.
+perm_host = np.arange(n)
+rng.shuffle(perm_host[: n // 2])
+permd = jax.block_until_ready(jnp.asarray(perm_host))
+
+groups = [(g0 * panel, n - g0 * panel) for g0 in range(0, nb, chunk)]
+
+
+def timed(name, make_chain, args, ks=4, kl=16):
+    sec, k1, k2, s = measure_slope_info(make_chain, args, k_small=ks,
+                                        k_large=kl, rounds=6)
+    print(f"{name}: {sec*1e3:.2f} ms (K={k1}/{k2}, slope={s})", flush=True)
+    return sec
+
+
+def chain(body):
+    """Wrap a per-iteration body(m, perm, x) -> scalar into a K-chain."""
+
+    def make_chain(k):
+        @jax.jit
+        def run(m_, perm_, x0):
+            def step(_, x):
+                return body(m_, perm_, x)
+
+            return lax.fori_loop(0, k, step, x0)
+
+        return run
+
+    return make_chain
+
+
+zero = jnp.zeros((), jnp.float32)
+
+
+def _jitter(acc):
+    """A carry-dependent int32 zero XLA cannot fold away: an int `x * 0`
+    simplifies to a constant and the gathers become loop-invariant
+    (hoistable out of the K-chain); a float scale then cast stays dynamic."""
+    return (acc * jnp.float32(1e-30)).astype(jnp.int32)
+
+# 1. top gather: (w, rt) permuted block-row read, summed over groups.
+
+
+def top_gather(m_, perm_, x):
+    acc = x
+    for gs, gh in groups:
+        rt = gh - w
+        if rt <= 0:
+            continue
+        gp = lax.dynamic_slice(perm_, (gs,), (w,)) - gs + _jitter(x)
+        top = m_[gs + gp][:, gs + w:]
+        acc = acc + top[0, 0]
+    return acc
+
+
+t_top = timed("top gathers (all groups)", chain(top_gather), (md, permd, zero))
+
+# 2. u12 scan (shipped form) vs one composed-Linv GEMM.
+linvs = jax.block_until_ready(
+    jnp.asarray(rng.standard_normal((chunk, panel, panel)), jnp.float32))
+
+
+def u12_scan(m_, perm_, x):
+    acc = x
+    for gs, gh in groups:
+        rt = gh - w
+        if rt <= 0:
+            continue
+        grp = lax.dynamic_slice(m_, (gs, gs), (gh, w))
+        top = lax.dynamic_slice(m_, (gs, gs + w), (w, rt)) + acc
+
+        def usolve(xc, i, grp=grp, top=top, rt=rt):
+            rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
+            r = lax.dynamic_slice(top, (i * panel, 0), (panel, rt))
+            r = r - jnp.dot(rows, xc, precision=prec)
+            xi = jnp.dot(linvs[i], r, precision=prec)
+            return lax.dynamic_update_slice(xc, xi, (i * panel, 0)), i
+
+        u12, _ = lax.scan(usolve, jnp.zeros((w, rt), jnp.float32),
+                          jnp.arange(chunk))
+        acc = acc + u12[0, 0]
+    return acc
+
+
+t_scan = timed("u12 scan (all groups)", chain(u12_scan), (md, permd, zero))
+
+
+def u12_inverse(m_, perm_, x):
+    acc = x
+    for gs, gh in groups:
+        rt = gh - w
+        if rt <= 0:
+            continue
+        grp = lax.dynamic_slice(m_, (gs, gs), (gh, w))
+        top = lax.dynamic_slice(m_, (gs, gs + w), (w, rt)) + acc
+        # Compose Linv_group (w x w) blockwise from panel inverses:
+        # row block i: Linv[i, j] = -linvs[i] @ L[i, j] @ Linv[j, :] built
+        # progressively; cost O(chunk^2) panel-size GEMMs per group.
+        rowsL = [[None] * chunk for _ in range(chunk)]
+        for i in range(chunk):
+            for j in range(i):
+                s = jnp.zeros((panel, panel), jnp.float32)
+                for k in range(j, i):
+                    lik = lax.dynamic_slice(grp, (i * panel, k * panel),
+                                            (panel, panel))
+                    s = s + jnp.dot(lik, rowsL[k][j], precision=prec)
+                rowsL[i][j] = -jnp.dot(linvs[i], s, precision=prec)
+            rowsL[i][i] = linvs[i]
+        linv_g = jnp.concatenate(
+            [jnp.concatenate(
+                [rowsL[i][j] if j <= i else jnp.zeros((panel, panel),
+                                                      jnp.float32)
+                 for j in range(chunk)], axis=1)
+             for i in range(chunk)], axis=0)
+        u12 = jnp.dot(linv_g, top, precision=prec)
+        acc = acc + u12[0, 0]
+    return acc
+
+
+t_inv = timed("u12 composed-Linv GEMM (all groups)", chain(u12_inverse),
+              (md, permd, zero))
+
+# 3. trailing update: strip loop (shipped) vs unstripped single pass.
+
+
+def trailing(strip):
+    def body(m_, perm_, x):
+        acc = x
+        for gs, gh in groups:
+            rt = gh - w
+            if rt <= 0:
+                continue
+            grp = lax.dynamic_slice(m_, (gs, gs), (gh, w))
+            u12 = lax.dynamic_slice(m_, (gs, gs + w), (w, rt)) + acc
+            sw = min(strip, gh - w)
+            nfull = (gh - w) // sw
+            fresh = jnp.zeros((gh - w, rt), jnp.float32)
+
+            # acc-dependence keeps the gathers loop-variant across the
+            # K-chain (otherwise XLA's LICM could hoist them and the chain
+            # would time only the dots).
+            jitter = _jitter(acc)
+
+            def strip_body(s, fresh, gs=gs, gh=gh, rt=rt, sw=sw, grp=grp,
+                           u12=u12, jitter=jitter):
+                r0 = w + s * sw
+                idx = lax.dynamic_slice(perm_, (gs + r0,), (sw,)) - gs + jitter
+                old = m_[gs + idx][:, gs + w:]
+                l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
+                return lax.dynamic_update_slice(
+                    fresh, old - jnp.dot(l21, u12, precision=prec),
+                    (s * sw, 0))
+
+            fresh = lax.fori_loop(0, nfull, strip_body, fresh)
+            tail = (gh - w) - nfull * sw
+            if tail:
+                idx = perm_[gs + w + nfull * sw:gs + gh] - gs + jitter
+                old = m_[gs + idx][:, gs + w:]
+                l21 = grp[w + nfull * sw:]
+                fresh = lax.dynamic_update_slice(
+                    fresh, old - jnp.dot(l21, u12, precision=prec),
+                    (nfull * sw, 0))
+            acc = acc + fresh[0, 0]
+        return acc
+
+    return body
+
+
+t_strip = timed(f"trailing strip={GROUP_UPDATE_STRIP} (all groups)",
+                chain(trailing(GROUP_UPDATE_STRIP)), (md, permd, zero),
+                ks=1, kl=4)
+t_full = timed("trailing unstripped (all groups)",
+               chain(trailing(1 << 30)), (md, permd, zero), ks=1, kl=4)
+
+# 4. left realign gather: m[gs:, :gs][gperm] summed over groups.
+
+
+def left_realign(m_, perm_, x):
+    acc = x
+    for gs, gh in groups:
+        if not gs:
+            continue
+        gp = lax.dynamic_slice(perm_, (gs,), (gh,)) - gs + _jitter(acc)
+        left = m_[gs:][gp][:, :gs]
+        acc = acc + left[0, 0]
+    return acc
+
+
+t_left = timed("left realign gathers (all groups)", chain(left_realign),
+               (md, permd, zero))
+
+print(f"\nepilogue accounted: top {t_top*1e3:.1f} + u12-scan "
+      f"{t_scan*1e3:.1f} + trailing-strip {t_strip*1e3:.1f} + left "
+      f"{t_left*1e3:.1f} = "
+      f"{(t_top + t_scan + t_strip + t_left)*1e3:.1f} ms", flush=True)
+print(f"alternatives: u12-inv {t_inv*1e3:.1f} ms, trailing-unstripped "
+      f"{t_full*1e3:.1f} ms", flush=True)

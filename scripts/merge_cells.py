@@ -1,0 +1,43 @@
+"""Splice re-measured bench cells into an existing cells JSON.
+
+The tunneled chip occasionally lands a jitter-contaminated cell despite the
+slope method's hardening (e.g. a small-n cell 20x its neighbors). The fix
+is to re-measure just that cell with the same grid CLI and replace it:
+
+    python -m gauss_tpu.bench.grid --suite gauss-internal --keys 256 \
+        --backends tpu --span device --json /tmp/fix.json
+    python scripts/merge_cells.py /tmp/r4_gid.json /tmp/fix.json
+
+Cells are keyed by (suite, key, backend, span); the patch file wins. The
+target is rewritten in place (a .bak copy is left beside it).
+"""
+import json
+import os
+import shutil
+import sys
+
+if len(sys.argv) < 3:
+    sys.exit(f"usage: {sys.argv[0]} <target.json> <patch.json> [...]")
+
+target = sys.argv[1]
+cells = json.load(open(target))
+index = {(c["suite"], c["key"], c["backend"], c.get("span")): i
+         for i, c in enumerate(cells)}
+replaced = added = 0
+for patch in sys.argv[2:]:
+    for c in json.load(open(patch)):
+        k = (c["suite"], c["key"], c["backend"], c.get("span"))
+        if k in index:
+            cells[index[k]] = c
+            replaced += 1
+        else:
+            index[k] = len(cells)
+            cells.append(c)
+            added += 1
+
+if not os.path.exists(target + ".bak"):  # keep the pristine pre-merge copy
+    shutil.copy(target, target + ".bak")
+with open(target, "w") as f:
+    json.dump(cells, f, indent=1)
+print(f"{target}: {replaced} replaced, {added} added "
+      f"({len(cells)} total; backup at {target}.bak)")

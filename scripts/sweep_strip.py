@@ -49,7 +49,9 @@ for strip in strips:
                  "backend")
 
     def solve_once(a_, b_):
-        return blocked.lu_solve(factor(a_), b_)
+        # panel=None resolves through auto_panel(n), matching every
+        # production call site (the function default is NOT the auto panel).
+        return blocked.lu_solve(factor(a_, panel=None), b_)
 
     x = np.asarray(solve_once(ad, bd), np.float64)
     r = np.linalg.norm(a @ x - b) / np.linalg.norm(b)
