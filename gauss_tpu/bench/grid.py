@@ -305,6 +305,18 @@ def _matmul_device_seconds(a64, b64, backend: str) -> float:
     import jax.numpy as jnp
 
     from gauss_tpu.bench import slope
+
+    if backend == "tpu-dist":
+        # The one-shot engine stages host operands per call (device_put),
+        # which cannot appear inside the traced K-chain; the staged form
+        # shards once and chains the pure sharded dot.
+        from gauss_tpu.dist.matmul_dist import matmul_dist_staged
+
+        a_dev, b_dev, c0, mm = matmul_dist_staged(
+            np.asarray(a64, np.float32), np.asarray(b64, np.float32))
+        make_chain, args = slope.matmul_chain(a_dev, b_dev, mm, c0=c0)
+        return slope.measure_slope(make_chain, args)
+
     from gauss_tpu.cli.matmul import _tpu_engine_fn
 
     a = jnp.asarray(a64, jnp.float32)
@@ -651,6 +663,9 @@ def format_table(cells: List[Cell]) -> str:
 
 
 def main(argv=None) -> int:
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()  # an explicit JAX_PLATFORMS beats the image's pin
     p = argparse.ArgumentParser(
         prog="bench-grid",
         description="Reproduce the reference reports' benchmark grids.")

@@ -200,8 +200,15 @@ def gauss_chain(a, b, panel: int, refine_steps: int = 0, unroll="auto",
     return solver_chain(a, b, solve_once)
 
 
-def matmul_chain(a, b, mm: Callable) -> Tuple[Callable[[int], Callable], tuple]:
-    """Chain factory for a device matmul engine ``mm(a, b) -> c``."""
+def matmul_chain(a, b, mm: Callable,
+                 c0=None) -> Tuple[Callable[[int], Callable], tuple]:
+    """Chain factory for a device matmul engine ``mm(a, b) -> c``.
+
+    ``mm`` must be pure traced computation (no host staging — the body runs
+    under one jit); distributed engines pass their staged form
+    (dist/matmul_dist.matmul_dist_staged) along with a ``c0`` carry whose
+    sharding matches the engine output, so the loop carry is
+    sharding-stable on a multi-device mesh."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -217,4 +224,6 @@ def matmul_chain(a, b, mm: Callable) -> Tuple[Callable[[int], Callable], tuple]:
 
         return run
 
-    return make_chain, (a, b, jnp.zeros((a.shape[0], b.shape[1]), a.dtype))
+    if c0 is None:
+        c0 = jnp.zeros((a.shape[0], b.shape[1]), a.dtype)
+    return make_chain, (a, b, c0)

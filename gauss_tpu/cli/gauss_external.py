@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()  # an explicit JAX_PLATFORMS beats the image's pin
     from gauss_tpu.dist import multihost
 
     if multihost.maybe_initialize_from_args(args):

@@ -87,6 +87,9 @@ def _run_native(a, b, engine, nthreads):
 
 
 def main(argv=None) -> int:
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()  # an explicit JAX_PLATFORMS beats the image's pin
     p = argparse.ArgumentParser(
         prog="matmul",
         description="Dense matmul benchmark (TPU-native port of cuda_matmul).")

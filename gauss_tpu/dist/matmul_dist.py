@@ -23,24 +23,22 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from gauss_tpu.dist.mesh import make_mesh
 
 
-def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
-                precision: str = "high", replicate_out: bool = True):
-    """C = A @ B with operands sharded over the mesh."""
-    if mesh is None:
-        mesh = make_mesh()
-    from gauss_tpu.core.matmul import resolve_precision
+def _prepare_operands(a, b, mesh, replicate_out: bool):
+    """Shared host-side prep: dtype/pad/sharding resolution for both the
+    one-shot and the staged entry points. Returns
+    (a_np, b_np, in_shardings, out_spec, m, n, vec_rhs)."""
     from gauss_tpu.dist.gauss_dist import _input_dtype
 
-    # Host-side prep + explicit device_put below: the default backend is
-    # never touched (see gauss_tpu.dist.gauss_dist._prepare for why).
-    # Unlike gauss, matmul keeps the input dtype (integer products stay exact).
+    # Host-side prep + explicit device_put in the callers: the default
+    # backend is never touched (see gauss_tpu.dist.gauss_dist._prepare).
+    # Unlike gauss, matmul keeps the input dtype (integer products stay
+    # exact).
     dtype = _input_dtype(a)
     a = np.asarray(a, dtype)
     b = np.asarray(b, dtype)
     vec_rhs = b.ndim == 1  # matrix-vector: lift to (k, 1), squeeze at the end
     if vec_rhs:
         b = b[:, None]
-    prec = resolve_precision(precision)
     m, n = a.shape[0], b.shape[1]
 
     def _pad(x, mult0, mult1):
@@ -67,6 +65,19 @@ def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
         in_shardings = (NamedSharding(mesh, P(r, None)),
                         NamedSharding(mesh, P(None, c)))
         out_spec = P() if replicate_out else P(r, c)
+    return a, b, in_shardings, out_spec, m, n, vec_rhs
+
+
+def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
+                precision: str = "high", replicate_out: bool = True):
+    """C = A @ B with operands sharded over the mesh."""
+    if mesh is None:
+        mesh = make_mesh()
+    from gauss_tpu.core.matmul import resolve_precision
+
+    prec = resolve_precision(precision)
+    a, b, in_shardings, out_spec, m, n, vec_rhs = _prepare_operands(
+        a, b, mesh, replicate_out)
 
     @jax.jit
     def run(a, b):
@@ -81,3 +92,40 @@ def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
     if vec_rhs:
         out = out[:, 0]
     return out
+
+
+def matmul_dist_staged(a, b, mesh: jax.sharding.Mesh = None, *,
+                       precision: str = "high"):
+    """Stage operands for a device-resident sharded-matmul chain.
+
+    ``matmul_dist`` stages host arrays per call (np.asarray + device_put),
+    which cannot appear inside a traced K-chain — the bench's device-span
+    timing wraps the engine in one jitted ``lax.fori_loop``
+    (bench/slope.matmul_chain). This entry point does the staging ONCE and
+    returns ``(a_dev, b_dev, c0_dev, mm)`` where ``mm(a_, b_) -> c`` is pure
+    traced computation (the sharded dot + replicated output constraint), and
+    ``c0_dev`` is a replicated zero of the product shape for the chain
+    carry. Matrix operands only (the chain perturbs ``a_dev`` elementwise).
+    """
+    if np.ndim(b) == 1:
+        raise ValueError("matmul_dist_staged stages matrix operands only")
+    if mesh is None:
+        mesh = make_mesh()
+    from gauss_tpu.core.matmul import resolve_precision
+
+    prec = resolve_precision(precision)
+    a, b, in_shardings, _out_spec, m, n, _vec = _prepare_operands(
+        a, b, mesh, replicate_out=True)  # out replicated (P()) by construction
+
+    def mm(a_, b_):
+        c = jnp.dot(a_, b_, precision=prec)
+        return lax.with_sharding_constraint(c, NamedSharding(mesh, P()))
+
+    a_dev = jax.device_put(a, in_shardings[0])
+    b_dev = jax.device_put(b, in_shardings[1])
+    # Zero carry created device-side with its sharding (a host np.zeros +
+    # device_put would ship the whole buffer through the tunnel; the
+    # explicit sharding keeps the default backend untouched).
+    c0 = jnp.zeros((a.shape[0], b.shape[1]), a.dtype,
+                   device=NamedSharding(mesh, P()))
+    return a_dev, b_dev, c0, mm

@@ -33,9 +33,17 @@ w = chunk * panel
 rng = np.random.default_rng(0)
 m_host = rng.standard_normal((n, n)).astype(np.float32)
 md = jax.block_until_ready(jnp.asarray(m_host))
-# A realistic group permutation: local row swaps within the trailing block.
+# A realistic group permutation: the factorization's gperm for the group at
+# gs is a permutation of the LOCAL trailing range, so every per-group slice
+# below must yield in-range local indices (a global shuffle would go
+# negative after the -gs shift and silently clamp in the gather). Shuffling
+# each group-width segment locally keeps all slices valid and every timed
+# group genuinely permuted.
 perm_host = np.arange(n)
-rng.shuffle(perm_host[: n // 2])
+for s0 in range(0, n, chunk * panel):
+    seg = perm_host[s0:s0 + chunk * panel]
+    rng.shuffle(seg)
+    perm_host[s0:s0 + chunk * panel] = seg
 permd = jax.block_until_ready(jnp.asarray(perm_host))
 
 groups = [(g0 * panel, n - g0 * panel) for g0 in range(0, nb, chunk)]

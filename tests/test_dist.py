@@ -88,6 +88,35 @@ def test_matmul_dist_2d(rng):
     np.testing.assert_allclose(c, a @ b, rtol=1e-10)
 
 
+def test_matmul_dist_staged_chains_under_jit(rng):
+    """The staged form must be traceable inside one jitted fori_loop — the
+    device-span K-chain the bench grid times (the one-shot engine's per-call
+    device_put is what broke the first dist-matmul device cells)."""
+    import jax
+
+    from gauss_tpu.bench.slope import matmul_chain
+    from gauss_tpu.dist.matmul_dist import matmul_dist_staged
+
+    a = rng.standard_normal((96, 64)).astype(np.float32)
+    b = rng.standard_normal((64, 32)).astype(np.float32)
+    a_dev, b_dev, c0, mm = matmul_dist_staged(a, b, mesh=make_mesh(8))
+    # Pure traced product matches the host truth (pad rows beyond 96 are 0).
+    c = np.asarray(jax.jit(mm)(a_dev, b_dev))[:96]
+    np.testing.assert_allclose(c, a @ b, rtol=1e-4, atol=1e-4)
+    # And the chain form compiles + runs: K=3 perturbed products.
+    make_chain, args = matmul_chain(a_dev, b_dev, mm, c0=c0)
+    out = jax.block_until_ready(make_chain(3)(*args))
+    assert np.isfinite(float(out))
+
+
+def test_matmul_dist_staged_rejects_vector_rhs(rng):
+    from gauss_tpu.dist.matmul_dist import matmul_dist_staged
+
+    with pytest.raises(ValueError, match="matrix operands"):
+        matmul_dist_staged(rng.standard_normal((8, 8)),
+                           rng.standard_normal(8), mesh=make_mesh(8))
+
+
 def test_cyclic_perm_roundtrip():
     perm = gauss_dist._cyclic_perm(16, 4)
     # shard d's block holds global rows l*4 + d
