@@ -39,19 +39,32 @@ GROUP_UPDATE_STRIP = 2048  # rows per deferred-trailing-GEMM strip: bounds
 # reaches the HBM ceiling (the unstripped form OOMed at n=32768)
 
 # The Pallas panel kernel holds one transposed (panel, npad) block in VMEM
-# plus per-row pivot bookkeeping (inv/chosen/done vectors). Calibrated from
-# the chip's scoped-vmem reports: 17.58 M requested at (panel=128,
-# h=24576) -> ~203 bytes/row beyond the 4*panel block bytes; 19.12 M at
-# (256, 17920). Budget = the 16 M scoped limit minus headroom.
+# plus pipeline copies and per-row pivot bookkeeping. The per-row cost
+# beyond the raw panel*itemsize block bytes is panel-dependent — narrower
+# panels pay proportionally more copy/bookkeeping per row. Calibrated from
+# the chip's scoped-vmem reports (requested bytes / rows - block bytes,
+# decimal M):
+#   (256, 17920): 19.12 M -> ~43 B/row      (64, 24576): 25.50 M -> ~782 B/row
+#   (128, 24576): 17.58 M -> ~203 B/row
+# Table values round the measurements up for margin. The old flat 256 B/row
+# under-modeled panel 64 by 3x and let the chunked route emit a 25.5 M
+# kernel for any group of height 15k-30k at panel 64 — the round-4 gi32
+# compile failure. Budget = 16 M scoped limit - headroom.
 PANEL_VMEM_BUDGET = 15_500_000
-PANEL_VMEM_ROW_OVERHEAD = 256  # bytes per matrix row (bookkeeping vectors)
+PANEL_VMEM_ROW_OVERHEAD = {64: 800, 128: 210, 256: 48}
+
+
+def _panel_row_overhead(panel: int) -> int:
+    # Unknown panels: conservative 1/panel extrapolation through the
+    # measured points (halving panel roughly doubles per-row overhead).
+    return PANEL_VMEM_ROW_OVERHEAD.get(panel, max(48, 55_000 // panel))
 
 
 def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
     """Whether the Pallas panel kernel's VMEM working set fits the scoped
-    limit: npad * (panel * itemsize + row overhead)."""
+    limit: npad * (panel * itemsize + per-panel row overhead)."""
     npad = -(-n // panel) * panel
-    return npad * (panel * itemsize + PANEL_VMEM_ROW_OVERHEAD) \
+    return npad * (panel * itemsize + _panel_row_overhead(panel)) \
         <= PANEL_VMEM_BUDGET
 
 
@@ -59,12 +72,14 @@ def auto_panel(n: int, itemsize: int = 4) -> int:
     """The widest panel in {256, 128, 64} whose kernel block fits VMEM.
 
     256 wins on v5e for n >= 1024 (fewer XLA glue steps beat the extra VPU
-    work); narrower panels extend the reachable n (128 to ~20k, 64 to ~30k,
-    per the calibrated working-set model above). Beyond that no panel fits
-    the VMEM kernel; 64 is returned anyway and panel-impl resolution falls
-    back to the stock-JAX panel path, which has no VMEM ceiling (on one
-    v5e chip HBM binds first anyway, around n~33k f32 — see
-    fits_single_chip / solve_handoff for the size routing).
+    work); 128 extends the reachable n to ~21.5k. Panel 64's per-row
+    overhead is so large (see the calibration above) that its ceiling
+    (~14.5k) sits BELOW 128's — narrower never extends reach past 128, so
+    beyond ~21.5k no panel fits the VMEM kernel; 64 is returned anyway and
+    panel-impl resolution (per GROUP in the chunked route) falls back to
+    the stock-JAX panel path, which has no VMEM ceiling (on one v5e chip
+    HBM binds first anyway, around n~33k f32 — see fits_single_chip /
+    solve_handoff for the size routing).
     Every factorization entry point resolves panel=None through this.
     """
     if n < 1024:

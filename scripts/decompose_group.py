@@ -41,9 +41,7 @@ md = jax.block_until_ready(jnp.asarray(m_host))
 # group genuinely permuted.
 perm_host = np.arange(n)
 for s0 in range(0, n, chunk * panel):
-    seg = perm_host[s0:s0 + chunk * panel]
-    rng.shuffle(seg)
-    perm_host[s0:s0 + chunk * panel] = seg
+    rng.shuffle(perm_host[s0:s0 + chunk * panel])  # in-place via the view
 permd = jax.block_until_ready(jnp.asarray(perm_host))
 
 groups = [(g0 * panel, n - g0 * panel) for g0 in range(0, nb, chunk)]
