@@ -480,8 +480,26 @@ def _cell_span(suite: str, backend: str, span: str) -> str:
 def _failure_note(stage: str, e: Exception, limit: int = 500) -> str:
     """One-line provenance for a FAILED cell: exception type + (truncated)
     message. Cells are the only artifact a later reader has; 'seconds 0.0,
-    verified false, error null' with no cause is undiagnosable."""
+    verified false, error null' with no cause is undiagnosable. Terminal
+    escape codes and trailing device-daemon log lines (timestamped) are
+    stripped — they bloat the note with noise that renders as garbage in
+    the REPORT tables."""
+    import re
+
     msg = " ".join(str(e).split())
+    msg = re.sub(r"\x1b\[[0-9;]*[A-Za-z]", "", msg)  # any CSI, not just SGR
+    # Remote-compile failures bury the actionable cause ("Ran out of
+    # memory...") inside timestamped daemon log lines; keep the head plus
+    # the salient error fragment and drop the transport noise between. If
+    # no fragment looks salient, keep the tail — dropping it could discard
+    # the cause (truncation below bounds the size either way).
+    parts = re.split(r"\s\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\S*\s", msg)
+    if len(parts) > 1:
+        salient = [p for p in parts[1:]
+                   if re.search(r"error|Error|out of memory|OOM", p)]
+        frag = max(salient, key=len) if salient else " ".join(parts[1:])
+        frag = re.sub(r"^\s*\[?\w*ERROR\]?\s*", "", frag)
+        msg = f"{parts[0]} | {frag}"
     if len(msg) > limit:
         msg = msg[:limit] + "..."
     return f"{stage}: {type(e).__name__}: {msg}"

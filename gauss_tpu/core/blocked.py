@@ -37,6 +37,11 @@ CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16
 GROUP_UPDATE_STRIP = 2048  # rows per deferred-trailing-GEMM strip: bounds
 # the chunked form's group-end transients to O(strip * n) so the route
 # reaches the HBM ceiling (the unstripped form OOMed at n=32768)
+GROUP_UPDATE_UNSTRIPPED_MAX_N = 20480  # up to here the group-end update
+# runs as ONE gather + GEMM instead of strips: transients peak ~3 copies
+# of the first group's (n-w)^2 trailing block (~16 n^2 bytes with the
+# matrix, 6.7 GB at this bound vs 16 GB HBM; the strip loop's extra
+# serialized gathers measured +2.3 ms at n=8192, sweep_strip r4)
 
 # The Pallas panel kernel holds one transposed (panel, npad) block in VMEM
 # plus pipeline copies and per-row pivot bookkeeping. The per-row cost
@@ -756,7 +761,8 @@ def lu_factor_blocked_chunked(a: jax.Array,
                 old = m[gs + rows_idx][:, gs + w:]   # gathered old rows
                 return old - jnp.dot(l21_strip, u12, precision=gemm_prec)
 
-            sw = min(GROUP_UPDATE_STRIP, gh - w)
+            sw = ((gh - w) if npad <= GROUP_UPDATE_UNSTRIPPED_MAX_N
+                  else min(GROUP_UPDATE_STRIP, gh - w))
             nfull = (gh - w) // sw
             fresh = jnp.zeros((gh - w, rt), dtype)
 

@@ -1,0 +1,46 @@
+"""Sweep (bm, bn, bk) for the tiled Pallas matmul on the chip.
+
+At the default (256, 256, 512) the kernel's operand streaming traffic
+(~ mp*np*K*4*(1/bm + 1/bn) bytes) is ~17 GB at n=8192 — HBM-bound where
+the XLA engine balances compute and traffic; doubling the output tile
+halves the traffic. VMEM at (512, 512, 1024): 2*(512*1024)*2 blocks * 4 B
+double-buffered + 1 MB f32 accumulator + output copies ~= 12 MB, inside
+the 16 MB budget.
+
+Usage: python scripts/sweep_mm_tiles.py <n> "bm,bn,bk" ["bm,bn,bk" ...]
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from gauss_tpu.bench.slope import matmul_chain, measure_slope_info
+from gauss_tpu.kernels.matmul_pallas import matmul_pallas
+
+n = int(sys.argv[1])
+configs = [tuple(int(v) for v in s.split(",")) for s in sys.argv[2:]]
+rng = np.random.default_rng(0)
+a = jax.block_until_ready(
+    jnp.asarray(rng.standard_normal((n, n)), jnp.float32))
+b = jax.block_until_ready(
+    jnp.asarray(rng.standard_normal((n, n)), jnp.float32))
+truth_rows = np.asarray(a[:8], np.float64) @ np.asarray(b, np.float64)
+
+for bm, bn, bk in configs:
+    def mm(a_, b_, bm=bm, bn=bn, bk=bk):
+        return matmul_pallas(a_, b_, bm=bm, bn=bn, bk=bk)
+
+    try:
+        c8 = np.asarray(mm(a, b)[:8], np.float64)
+    except Exception as e:
+        print(f"n={n} ({bm},{bn},{bk}): FAILED {str(e)[:120]}", flush=True)
+        continue
+    err = np.abs(c8 - truth_rows).max() / np.abs(truth_rows).max()
+    make_chain, args = matmul_chain(a, b, mm)
+    sec, k1, k2, s = measure_slope_info(make_chain, args, k_small=2,
+                                        k_large=8, rounds=6)
+    print(f"n={n} ({bm},{bn},{bk}): {sec*1e3:.2f} ms "
+          f"(K={k1}/{k2}, slope={s}, relerr={err:.1e})", flush=True)

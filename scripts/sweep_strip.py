@@ -37,6 +37,11 @@ bd = jax.block_until_ready(jnp.asarray(b))
 for strip in strips:
     jax.clear_caches()
     blocked.GROUP_UPDATE_STRIP = strip if strip else 1 << 30
+    # Force the explicit strip to be honored: below the unstripped gate the
+    # factorization would ignore GROUP_UPDATE_STRIP and every config would
+    # time the same single-pass program. strip 0 sweeps the unstripped form
+    # explicitly, so the gate value is irrelevant there.
+    blocked.GROUP_UPDATE_UNSTRIPPED_MAX_N = 1 << 30 if not strip else 0
 
     factor = blocked.resolve_factor(n, "auto")
     # Guard against a silent no-op: GROUP_UPDATE_STRIP is read only by the
