@@ -95,14 +95,22 @@ def _pad2(x, bm, bn):
 
 
 @partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret", "precision"))
-def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 256, bn: int = 256,
-                  bk: int = 512, interpret: bool | None = None,
+def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 512, bn: int = 512,
+                  bk: int = 1024, interpret: bool | None = None,
                   precision: str = "high") -> jax.Array:
     """C = A @ B with an explicit (m, n, k) tile grid. Any shapes; inputs are
     zero-padded to tile multiples (zeros contribute nothing to the products).
     Accumulation is float32 for sub-f64 dtypes, float64 for f64 inputs.
     Default precision "high" = the manual in-kernel bf16x3 scheme (see
-    _mm_kernel), matching the XLA engine's default (core.matmul)."""
+    _mm_kernel), matching the XLA engine's default (core.matmul).
+
+    Default tiles (512, 512, 1024): operand streaming traffic scales as
+    mp*np*K*(1/bm + 1/bn) bytes, so the 512-wide output tile halves the HBM
+    traffic of the former 256x256 default — measured on v5e (sweep_mm_tiles
+    r4): n=8192 27.5 -> 18.25 ms (1.04x the XLA engine, from 1.57x), n=4096
+    3.53 -> 2.54 ms, n=2048 0.43 -> 0.36 ms. ~11 MB VMEM with Mosaic's
+    double buffering; 1024-wide tiles exceed the 16 MB budget and fail to
+    compile."""
     interpret = _auto_interpret(interpret)
     a = jnp.asarray(a)
     b = jnp.asarray(b, a.dtype)
