@@ -35,6 +35,28 @@ def _measure_slope(a, b, panel: int):
     return slope.measure_slope_info(make_chain, args)
 
 
+def best_prior_headline() -> float | None:
+    """Best (smallest) headline seconds across the committed BENCH_r*.json
+    driver records, or None when none parse. The 49% r3->r4 swing went
+    unnoticed because bench.py knew nothing of prior rounds (VERDICT r4
+    next #8); the emitted "regression_vs_best" field makes any future swing
+    loud in the one artifact the driver records."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                value = (json.load(f).get("parsed") or {}).get("value")
+        except (OSError, ValueError):
+            continue
+        if isinstance(value, (int, float)) and value > 0:
+            best = value if best is None else min(best, value)
+    return best
+
+
 def main() -> None:
     import jax.numpy as jnp
 
@@ -50,6 +72,7 @@ def main() -> None:
     panel = 256
 
     per_solve, k_small, k_large, is_slope = _measure_slope(a, b, panel)
+    best_prior = best_prior_headline()
 
     # Correctness gate on EXACTLY the timed configuration (one f32 blocked
     # factor+solve, no refinement — it solves the internal system exactly;
@@ -99,6 +122,12 @@ def main() -> None:
                            f"slope protocol"
                            + ("" if refined_is_slope else " (FALLBACK mean)")),
         "refined_vs_baseline": round(BASELINE_GAUSS_2048_S / refined_s, 2),
+        # > 1 means this round is SLOWER than the best committed round —
+        # a value near 1.5 is a real regression, not jitter (the slope
+        # protocol's round-to-round spread is ~±10%, see docs/REPORT).
+        "regression_vs_best": (round(per_solve / best_prior, 3)
+                               if best_prior else None),
+        "best_prior_s": best_prior,
     }))
 
 

@@ -497,7 +497,11 @@ def _failure_note(stage: str, e: Exception, limit: int = 500) -> str:
     if len(parts) > 1:
         salient = [p for p in parts[1:]
                    if re.search(r"error|Error|out of memory|OOM", p)]
-        frag = max(salient, key=len) if salient else " ".join(parts[1:])
+        # ALL salient fragments, not just the longest: a second,
+        # complementary cause in a different post-timestamp part (or one
+        # phrased without these markers) must survive into the note
+        # (ADVICE r4 #3); the truncation below bounds the size.
+        frag = " | ".join(salient) if salient else " ".join(parts[1:])
         frag = re.sub(r"^\s*\[?\w*ERROR\]?\s*", "", frag)
         msg = f"{parts[0]} | {frag}"
     if len(msg) > limit:

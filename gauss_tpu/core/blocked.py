@@ -37,11 +37,14 @@ CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16
 GROUP_UPDATE_STRIP = 2048  # rows per deferred-trailing-GEMM strip: bounds
 # the chunked form's group-end transients to O(strip * n) so the route
 # reaches the HBM ceiling (the unstripped form OOMed at n=32768)
-GROUP_UPDATE_UNSTRIPPED_MAX_N = 20480  # up to here the group-end update
-# runs as ONE gather + GEMM instead of strips: transients peak ~3 copies
-# of the first group's (n-w)^2 trailing block (~16 n^2 bytes with the
-# matrix, 6.7 GB at this bound vs 16 GB HBM; the strip loop's extra
-# serialized gathers measured +2.3 ms at n=8192, sweep_strip r4)
+GROUP_UPDATE_UNSTRIPPED_MAX_BYTES = 16 * 20480 * 20480  # ~6.7 GB: up to
+# here the group-end update runs as ONE gather + GEMM instead of strips.
+# Transients peak ~3 copies of the first group's (n-w)^2 trailing block
+# plus the matrix — ~4 * npad^2 * itemsize bytes total — vs 16 GB HBM.
+# The bound is in BYTES, not rows, so f64 inputs halve the admitted n
+# (ADVICE r4 #1: a rows bound calibrated for f32 would admit ~13.4 GB of
+# f64 transients). At f32 it equals the measured n=20480 limit; the strip
+# loop's extra serialized gathers cost +2.3 ms at n=8192 (sweep_strip r4).
 
 # The Pallas panel kernel holds one transposed (panel, npad) block in VMEM
 # plus pipeline copies and per-row pivot bookkeeping. The per-row cost
@@ -761,7 +764,8 @@ def lu_factor_blocked_chunked(a: jax.Array,
                 old = m[gs + rows_idx][:, gs + w:]   # gathered old rows
                 return old - jnp.dot(l21_strip, u12, precision=gemm_prec)
 
-            sw = ((gh - w) if npad <= GROUP_UPDATE_UNSTRIPPED_MAX_N
+            sw = ((gh - w) if 4 * npad * npad * itemsize
+                  <= GROUP_UPDATE_UNSTRIPPED_MAX_BYTES
                   else min(GROUP_UPDATE_STRIP, gh - w))
             nfull = (gh - w) // sw
             fresh = jnp.zeros((gh - w, rt), dtype)

@@ -85,6 +85,30 @@ def _kernel_precision(precision: str, dtype):
     return resolve_precision(precision), False
 
 
+MM_VMEM_BUDGET = 14 * 1024 * 1024  # tile working set, under the ~16 MB limit
+
+
+def _mm_blocks(bm: int, bn: int, bk: int, itemsize: int,
+               acc_itemsize: int) -> tuple:
+    """Shrink (bm, bn, bk) until the tile working set — double-buffered
+    operand blocks, double-buffered output block, accumulator scratch —
+    fits VMEM. The defaults are sized for f32 (~11 MB) and pass through
+    unchanged there; f64 doubles every term and would exceed the budget at
+    the same tiles (ADVICE r4 #2), so bk halves first (pipeline granularity
+    only), then bn, then bm."""
+    def vmem(bm, bn, bk):
+        return ((2 * (bm * bk + bk * bn) + 2 * bm * bn) * itemsize
+                + bm * bn * acc_itemsize)
+
+    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bk > 128:
+        bk //= 2
+    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bn > 128:
+        bn //= 2
+    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bm > 8:
+        bm //= 2
+    return bm, bn, bk
+
+
 def _pad2(x, bm, bn):
     m, n = x.shape
     mp = -(-m // bm) * bm
@@ -119,6 +143,9 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 512, bn: int = 512,
     m, k = a.shape
     _, n = b.shape
     bm_, bn_, bk_ = min(bm, max(m, 8)), min(bn, max(n, 128)), min(bk, max(k, 128))
+    acc_itemsize = 8 if a.dtype == jnp.float64 else 4
+    bm_, bn_, bk_ = _mm_blocks(bm_, bn_, bk_, jnp.dtype(a.dtype).itemsize,
+                               acc_itemsize)
     ap = _pad2(a, bm_, bk_)
     bp = _pad2(b, bk_, bn_)
     mp, kp = ap.shape

@@ -1,0 +1,199 @@
+"""Ablation study of the panel kernel's per-step cost on the real chip.
+
+The two-level (deferred) kernel at h=2048/panel=256/seg=32 still runs
+~170 us per call (~0.66 us per pivot step); the (seg, h) tile passes are
+~35 us of that, so the floor is per-step serial bookkeeping. This strips
+one per-step component at a time from a standalone copy of the kernel and
+slope-times each variant, so the floor has names. The stripped variants
+compute WRONG factorizations (that is the point); everything feeds the
+result scalar so nothing folds away.
+
+Usage: python scripts/ablate_panel.py [h [panel [seg]]]
+"""
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from gauss_tpu.bench.slope import PERTURB, measure_slope_info
+
+h = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+panel = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+seg = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+
+def kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
+           chosen_ref, done_ref, mult_ref, pt_ref, *, ablate):
+    kb = kb_ref[0]
+    out_ref[:] = t_ref[:]
+    lanes = lax.broadcasted_iota(jnp.int32, (1, h), 1)
+    inv_ref[:] = lax.broadcasted_iota(jnp.int32, (h, 1), 0)
+    chosen_ref[:] = jnp.zeros((h, 1), jnp.int32)
+    done_ref[:] = (lanes < kb).astype(jnp.int32)
+    minpiv_ref[0] = jnp.asarray(jnp.inf, out_ref.dtype)
+    dtype = out_ref.dtype
+    zero = jnp.zeros((), dtype)
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+
+    def make_step(s0, s1):
+        w = s1 - s0
+        subs = s0 + lax.broadcasted_iota(jnp.int32, (w, 1), 0)
+
+        def step(j, _):
+            j = j.astype(jnp.int32)
+            c = kb + j
+            col = out_ref[pl.ds(j, 1), :]
+            if ablate == "argmax":
+                p_idx = c  # no pivot search
+            elif ablate == "argmax_maxmin":
+                # max-reduce then first-index-of-max: two plain reductions
+                # instead of one index-tracking argmax reduction.
+                cand = jnp.where(done_ref[:] != 0, neg_inf, jnp.abs(col))
+                mx = jnp.max(cand)
+                p_idx = jnp.min(jnp.where(cand == mx, lanes,
+                                          jnp.asarray(h, jnp.int32))
+                                ).astype(jnp.int32)
+            else:
+                cand = jnp.where(done_ref[:] != 0, neg_inf, jnp.abs(col))
+                p_idx = jnp.argmax(cand).astype(jnp.int32)
+            ipiv_ref[j] = p_idx
+            if ablate != "invstores":
+                inv_ref[pl.ds(p_idx, 1), :] = jnp.full((1, 1), c, jnp.int32)
+                chosen_ref[pl.ds(p_idx, 1), :] = jnp.ones((1, 1), jnp.int32)
+            lane_p = lanes == p_idx
+            if ablate != "pivextract":
+                piv = jnp.sum(jnp.where(lane_p, col, zero))
+            else:
+                piv = jnp.asarray(1.0, dtype)
+            if ablate != "minpiv":
+                apiv = jnp.abs(piv)
+                minpiv_ref[0] = jnp.minimum(
+                    minpiv_ref[0], jnp.where(jnp.isnan(apiv), zero, apiv))
+            if ablate != "donemask":
+                done = (done_ref[:] != 0) | lane_p
+                done_ref[:] = done.astype(jnp.int32)
+            else:
+                done = lane_p
+            mult = jnp.where(done, zero, col / piv)
+            mult_ref[pl.ds(j - s0, 1), :] = mult
+            pt_ref[pl.ds(j - s0, 1), :] = lane_p.astype(dtype)
+            if ablate != "tilepass":
+                T = out_ref[pl.ds(s0, w), :]
+                u = jnp.sum(jnp.where(lane_p, T, zero), axis=1, keepdims=True)
+                upd = jnp.where(subs > j, u, zero)
+                row_j_new = jnp.where(done, col, col / piv)
+                out_ref[pl.ds(s0, w), :] = jnp.where(
+                    subs == j, row_j_new, T - upd * mult)
+            else:
+                out_ref[pl.ds(j, 1), :] = mult
+            return 0
+
+        return step
+
+    def deferred_update(s0, s1):
+        w, wt = s1 - s0, panel - s1
+        hi = lax.Precision.HIGHEST
+        t0 = out_ref[pl.ds(s1, wt), :]
+        m_blk = mult_ref[pl.ds(0, w), :]
+        pt = pt_ref[pl.ds(0, w), :]
+        dn = (((1,), (1,)), ((), ()))
+        if ablate == "extract_dots":
+            u = t0[:, :w] * 0.5
+            lp = m_blk[:, :w] * 0.5
+        else:
+            u = lax.dot_general(t0, pt, dn, precision=hi,
+                                preferred_element_type=dtype)
+            lp = lax.dot_general(m_blk, pt, dn, precision=hi,
+                                 preferred_element_type=dtype)
+        if ablate != "neumann":
+            p2, e = None, 1
+            while e < w:
+                term = lp if e == 1 else p2
+                corr = jnp.dot(u, term, precision=hi,
+                               preferred_element_type=dtype)
+                u = u - corr if e == 1 else u + corr
+                if e * 2 < w:
+                    p2 = jnp.dot(term, term, precision=hi,
+                                 preferred_element_type=dtype)
+                e *= 2
+        else:
+            u = u + lp * 0.5
+        out_ref[pl.ds(s1, wt), :] = t0 - jnp.dot(
+            u, m_blk, precision=hi, preferred_element_type=dtype)
+
+    for s0 in range(0, panel, seg):
+        s1 = min(s0 + seg, panel)
+        lax.fori_loop(s0, s1, make_step(s0, s1), 0)
+        if ablate != "defupdate" and s1 < panel:
+            deferred_update(s0, s1)
+
+
+@partial(jax.jit, static_argnames=("ablate",))
+def run_variant(p, ablate):
+    kb = jnp.zeros((1,), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((panel, h), lambda i, kb_ref: (0, 0))],
+        out_specs=[
+            pl.BlockSpec((panel, h), lambda i, kb_ref: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((h, 1), lambda i, kb_ref: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((h, 1), lambda i, kb_ref: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, h), jnp.int32),
+                        pltpu.VMEM((seg, h), p.dtype),
+                        pltpu.VMEM((seg, h), p.dtype)],
+    )
+    out_t, ipiv, inv, minpiv, chosen = pl.pallas_call(
+        partial(kernel, ablate=ablate),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((panel, h), p.dtype),
+            jax.ShapeDtypeStruct((panel,), jnp.int32),
+            jax.ShapeDtypeStruct((h, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1,), p.dtype),
+            jax.ShapeDtypeStruct((h, 1), jnp.int32),
+        ],
+    )(kb, p.T)
+    return (out_t[0, 0] + minpiv[0]
+            + (ipiv[0] + inv[0, 0] + chosen[0, 0]).astype(p.dtype) * 1e-30)
+
+
+rng = np.random.default_rng(0)
+ad = jax.block_until_ready(
+    jnp.asarray(rng.standard_normal((h, panel)), jnp.float32))
+zero = jnp.zeros((), jnp.float32)
+
+
+def make(ablate):
+    def mk(k):
+        @jax.jit
+        def run(a_, x0):
+            def body(_, x):
+                return x + run_variant(a_ + x * jnp.asarray(PERTURB, a_.dtype),
+                                       ablate)
+            return lax.fori_loop(0, k, body, x0)
+        return run
+    return mk
+
+
+base = None
+for ablate in ("none", "argmax", "argmax_maxmin", "pivextract",
+               "defupdate", "neumann", "extract_dots"):
+    sec, k1, k2, s = measure_slope_info(make(ablate), (ad, zero),
+                                        k_small=16, k_large=64, rounds=6)
+    if ablate == "none":
+        base = sec
+        print(f"full kernel: {sec*1e6:.1f} us (K={k1}/{k2})", flush=True)
+    else:
+        print(f"without {ablate}: {sec*1e6:.1f} us "
+              f"(saves {max(0.0, base - sec)*1e6:.1f})", flush=True)

@@ -41,7 +41,7 @@ for strip in strips:
     # factorization would ignore GROUP_UPDATE_STRIP and every config would
     # time the same single-pass program. strip 0 sweeps the unstripped form
     # explicitly, so the gate value is irrelevant there.
-    blocked.GROUP_UPDATE_UNSTRIPPED_MAX_N = 1 << 30 if not strip else 0
+    blocked.GROUP_UPDATE_UNSTRIPPED_MAX_BYTES = 1 << 62 if not strip else 0
 
     factor = blocked.resolve_factor(n, "auto")
     # Guard against a silent no-op: GROUP_UPDATE_STRIP is read only by the

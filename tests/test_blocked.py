@@ -263,9 +263,9 @@ def test_chunked_strip_form_multi_strip_and_tail(rng, monkeypatch):
     fac_ref = jax.tree.map(np.asarray, fac_ref)  # hold values, not buffers
     monkeypatch.setattr(blocked, "GROUP_UPDATE_STRIP", 48)  # strips + tail
     # The unstripped gate must ALSO be forced off: npad=224 sits far below
-    # GROUP_UPDATE_UNSTRIPPED_MAX_N, so without this the strip constant is
+    # the unstripped byte bound, so without this the strip constant is
     # never read and the test trivially compares identical programs.
-    monkeypatch.setattr(blocked, "GROUP_UPDATE_UNSTRIPPED_MAX_N", 0)
+    monkeypatch.setattr(blocked, "GROUP_UPDATE_UNSTRIPPED_MAX_BYTES", 0)
     # The strip width is a trace-time constant, not a jit static arg: a
     # cached executable for this signature would silently ignore the patch
     # and make the test vacuous.
