@@ -43,6 +43,14 @@ DIST_BACKENDS = ("tpu-dist", "tpu-dist2d", "tpu-dist-blocked",
                  "tpu-dist-blocked2d")
 DIST_SHARD_SWEEP = (2, 4, 8)   # reference sweep is mpirun -np {2,16,32,70}
 DIST_NOTE = "virtual CPU mesh (scaling shape + correctness; NOT ICI)"
+# --dist-device default: build dist meshes from jax.devices() instead of
+# the forced CPU pool — a 1-chip mesh on the real TPU proves the shard_map
+# programs lower and run on actual TPU hardware, not only under the CPU
+# backend (VERDICT r4 next #7; the reference validated MPI on its real
+# cluster, OpenMP_and_MPI/README.txt:39-48). Wall-clock here includes the
+# ~0.1-0.7 s tunnel dispatch span, so these cells prove lowering +
+# verification, not per-op speed (the note says which device ran).
+DIST_DEVICE = "cpu"
 
 
 @dataclass
@@ -415,7 +423,21 @@ def _run_gauss_dist(ctx, n: int, backend: str, shards: int,
 
     a32, b32, a64, b64 = ctx
     shards = shards or DIST_SHARD_SWEEP[-1]
-    devs = _cpu_mesh_devices(shards)
+    if DIST_DEVICE == "default":
+        import jax
+
+        devs = list(jax.devices())
+        if len(devs) < shards:
+            raise RuntimeError(
+                f"--dist-device default: need {shards} devices, have "
+                f"{len(devs)} on platform {devs[0].platform}; pass -t "
+                f"{len(devs)} (a 1-chip mesh still proves real-TPU lowering)")
+        devs = devs[:shards]
+        note = (f"real {devs[0].platform} mesh={shards} (lowering + "
+                f"verification; span includes tunnel dispatch)")
+    else:
+        devs = _cpu_mesh_devices(shards)
+        note = DIST_NOTE
     if backend == "tpu-dist":
         from gauss_tpu.dist import gauss_dist as eng
         from gauss_tpu.dist.mesh import make_mesh
@@ -451,7 +473,7 @@ def _run_gauss_dist(ctx, n: int, backend: str, shards: int,
     res = checks.residual_norm(a64, np.asarray(x, np.float64), b64)
     return Cell("gauss-dist", str(n), backend, seconds, res < RESIDUAL_BAR,
                 res, baselines.reference_seconds("gauss-dist", n, backend),
-                note=DIST_NOTE)
+                note=note)
 
 
 _SUITE_FNS = {
@@ -572,8 +594,10 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
             thread_sweep = [nthreads] if nthreads else DIST_SHARD_SWEEP
         # Force the LARGEST shard count before the CPU backend initializes:
         # the forced-device-count flag is latched at first backend init, so
-        # asking for 2 first would cap the whole sweep at 2.
-        _cpu_mesh_devices(max(thread_sweep))
+        # asking for 2 first would cap the whole sweep at 2. (Not when the
+        # meshes come from the default platform's real devices.)
+        if DIST_DEVICE != "default":
+            _cpu_mesh_devices(max(thread_sweep))
     sweep = list(thread_sweep) if thread_sweep else [None]
     cells = []
     for key in keys:
@@ -715,7 +739,16 @@ def main(argv=None) -> int:
                         "operands device-resident (bench.slope)")
     p.add_argument("--json", dest="json_path", default=None,
                    help="also write cells as a JSON array to this path")
+    p.add_argument("--dist-device", choices=("cpu", "default"),
+                   default="cpu",
+                   help="gauss-dist mesh devices: 'cpu' = the forced "
+                        "virtual CPU pool (shard-sweep scaling); 'default' "
+                        "= jax.devices() of the default platform — on one "
+                        "real TPU, pass -t 1 to prove the shard_map "
+                        "programs lower and run on actual hardware")
     args = p.parse_args(argv)
+    global DIST_DEVICE
+    DIST_DEVICE = args.dist_device
 
     if args.keys and args.suite == "all":
         p.error("--keys requires a single --suite (sizes and dataset names "

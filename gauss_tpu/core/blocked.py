@@ -468,7 +468,10 @@ def lu_factor_blocked_unrolled(a: jax.Array,
     dtype = m.dtype
     perm = jnp.arange(npad)
     min_piv = jnp.asarray(jnp.inf, dtype)
-    linvs, uinvs = [], []
+    linvs = []
+    rows_p = jnp.arange(panel)
+    lmask = rows_p[:, None] > rows_p[None, :]
+    eye_p = jnp.eye(panel, dtype=dtype)
 
     for kb in range(0, npad, panel):
         tail = npad - kb
@@ -493,12 +496,15 @@ def lu_factor_blocked_unrolled(a: jax.Array,
         live = m[kb:][perm_local]
         perm = perm.at[kb:].set(perm[kb:][perm_local])
         live = live.at[:, kb:kb + panel].set(p)
-        # Explicit diagonal-block inverses: U12 and lu_solve become GEMMs
-        # (log-depth) instead of panel-length substitution chains.
-        linv, uinv = _diag_block_invs(live[:panel, kb:kb + panel], panel,
-                                      dtype)
+        # Explicit diagonal-block L inverse: U12 becomes a GEMM (log-depth)
+        # instead of a panel-length substitution chain. The U inverses are
+        # needed only by lu_solve, not inside this loop — they are computed
+        # batched after it, off the serial critical path (measured ~0.06 ms
+        # of the 2.0 ms n=2048 factor when computed per panel here).
+        d = live[:panel, kb:kb + panel]
+        linv = unit_lower_inv(jnp.where(lmask, d, jnp.zeros((), dtype))
+                              + eye_p)
         linvs.append(linv)
-        uinvs.append(uinv)
         if kb + panel < npad:
             u12 = jnp.dot(linv, live[:panel, kb + panel:],
                           precision=gemm_prec)
@@ -509,8 +515,15 @@ def lu_factor_blocked_unrolled(a: jax.Array,
                 trail - jnp.dot(l21, u12, precision=gemm_prec))
         m = m.at[kb:].set(live)
 
+    # Batched U diagonal-block inverses: one vmapped TRTRI over the nb
+    # finished diagonal blocks (parallel MXU work) instead of nb serial
+    # per-panel inversions inside the loop above.
+    diags = jnp.stack([m[kb:kb + panel, kb:kb + panel]
+                       for kb in range(0, npad, panel)])
+    uinvs = jax.vmap(upper_inv)(
+        jnp.where(~lmask[None], diags, jnp.zeros((), dtype)))
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
-                     linv=jnp.stack(linvs), uinv=jnp.stack(uinvs))
+                     linv=jnp.stack(linvs), uinv=uinvs)
 
 
 # Blockwise lu_solve trace form: unrolled below this many blocks (every

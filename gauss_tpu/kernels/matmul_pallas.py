@@ -119,8 +119,9 @@ def _pad2(x, bm, bn):
 
 
 @partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret", "precision"))
-def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 512, bn: int = 512,
-                  bk: int = 1024, interpret: bool | None = None,
+def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int | None = None,
+                  bn: int | None = None, bk: int | None = None,
+                  interpret: bool | None = None,
                   precision: str = "high") -> jax.Array:
     """C = A @ B with an explicit (m, n, k) tile grid. Any shapes; inputs are
     zero-padded to tile multiples (zeros contribute nothing to the products).
@@ -142,10 +143,18 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int = 512, bn: int = 512,
         raise ValueError(f"bad matmul shapes {a.shape} x {b.shape}")
     m, k = a.shape
     _, n = b.shape
-    bm_, bn_, bk_ = min(bm, max(m, 8)), min(bn, max(n, 128)), min(bk, max(k, 128))
-    acc_itemsize = 8 if a.dtype == jnp.float64 else 4
-    bm_, bn_, bk_ = _mm_blocks(bm_, bn_, bk_, jnp.dtype(a.dtype).itemsize,
-                               acc_itemsize)
+    # Explicit tiles are honored verbatim (a tile sweep must measure the
+    # config it names — past-budget requests fail at compile, loudly); only
+    # the None defaults route through the VMEM clamp, which passes f32
+    # through at (512, 512, 1024) and shrinks for wider dtypes (ADVICE r4).
+    auto = (bm is None, bn is None, bk is None)
+    bm_ = min(bm or 512, max(m, 8))
+    bn_ = min(bn or 512, max(n, 128))
+    bk_ = min(bk or 1024, max(k, 128))
+    if all(auto):
+        acc_itemsize = 8 if a.dtype == jnp.float64 else 4
+        bm_, bn_, bk_ = _mm_blocks(bm_, bn_, bk_,
+                                   jnp.dtype(a.dtype).itemsize, acc_itemsize)
     ap = _pad2(a, bm_, bk_)
     bp = _pad2(b, bk_, bn_)
     mp, kp = ap.shape

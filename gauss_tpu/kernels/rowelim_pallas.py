@@ -196,7 +196,7 @@ def auto_rowelim_k(n: int) -> int:
     ~20k, 64 beyond)."""
     from gauss_tpu.core.blocked import panel_fits_vmem
 
-    for k in (256, 128, 64):
+    for k in (256, 128):
         if panel_fits_vmem(n, k):
             return k
     # No k fits the VMEM kernel (64's per-row overhead puts its ceiling

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Assemble reports/REPORT.md + graphs/ from the round-5 regenerated cells
+# (/tmp/r5_*.json) plus the round-3 cells that remain current:
+#   - cells_precision.json      (MXU precision sweep; code path unchanged)
+#   - cells_gauss_dist.json     (virtual-mesh shard sweep n=128..2048)
+#   - cells_gauss_dist_4096.json (round-4 extension, blocked engines)
+#   - cells_gauss_internal_threads.json / _4096_native.json (native thread
+#     sweep; native engines unchanged)
+# Run AFTER scripts/regen_round5.sh reports all stages done; copies the
+# fresh cells into reports/ under their round-3 names so the committed
+# artifact set stays stable.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+declare -A dest=(
+    [gi]=cells_gauss_internal.json
+    [gid]=cells_gauss_internal_device.json
+    [gil]=cells_gauss_internal_large.json
+    [gi16]=cells_gauss_internal_16384.json
+    [gi32]=cells_gauss_internal_32768.json
+    [mm24]=cells_matmul_24576.json
+    [ge]=cells_gauss_external.json
+    [gem]=cells_gauss_external_memplus.json
+    [gemd]=cells_gauss_external_memplus_dev.json
+    [ged]=cells_gauss_external_device.json
+    [mm]=cells_matmul.json
+    [mmd]=cells_matmul_device.json
+    [mm16]=cells_matmul_16384.json
+    [mm48]=cells_matmul_4096_8192.json
+)
+missing=0
+for k in "${!dest[@]}"; do
+    if [ -s "/tmp/r5_$k.json" ]; then
+        cp "/tmp/r5_$k.json" "reports/${dest[$k]}"
+    else
+        echo "MISSING /tmp/r5_$k.json (keeping old reports/${dest[$k]} if present)"
+        missing=$((missing+1))
+    fi
+done
+# Old per-size matmul files are superseded by cells_matmul_4096_8192.json.
+[ -s reports/cells_matmul_4096_8192.json ] && rm -f reports/cells_matmul_4096.json reports/cells_matmul_8192.json
+
+files=(reports/cells_gauss_internal.json reports/cells_gauss_internal_device.json
+       reports/cells_gauss_internal_large.json reports/cells_gauss_internal_16384.json
+       reports/cells_gauss_internal_threads.json reports/cells_gauss_internal_4096_native.json
+       reports/cells_gauss_external.json reports/cells_gauss_external_memplus.json
+       reports/cells_gauss_external_memplus_dev.json reports/cells_gauss_external_device.json
+       reports/cells_matmul.json reports/cells_matmul_device.json)
+[ -s reports/cells_matmul_16384.json ] && files+=(reports/cells_matmul_16384.json)
+[ -s reports/cells_gauss_internal_32768.json ] && files+=(reports/cells_gauss_internal_32768.json)
+[ -s reports/cells_matmul_24576.json ] && files+=(reports/cells_matmul_24576.json)
+if [ -s reports/cells_matmul_4096_8192.json ]; then
+    files+=(reports/cells_matmul_4096_8192.json)
+else
+    # mm48 stage missing: keep the round-3 per-size cells so the 4096/8192
+    # matmul rows never silently vanish from the report.
+    [ -s reports/cells_matmul_4096.json ] && files+=(reports/cells_matmul_4096.json)
+    [ -s reports/cells_matmul_8192.json ] && files+=(reports/cells_matmul_8192.json)
+fi
+files+=(reports/cells_precision.json reports/cells_gauss_dist.json reports/cells_gauss_dist_4096.json)
+# Round-5: all four dist engines run on the REAL chip as a 1-device mesh
+# (lowering + verification proof; --dist-device default).
+[ -s reports/cells_gauss_dist_tpu1.json ] && files+=(reports/cells_gauss_dist_tpu1.json)
+
+python -m gauss_tpu.bench.report "${files[@]}" \
+    --title "gauss-tpu benchmark report" --out reports/REPORT.md --profile 1024
+python -m gauss_tpu.bench.plots reports/cells_gauss_internal.json \
+    reports/cells_gauss_internal_device.json reports/cells_matmul_device.json \
+    --outdir graphs
+echo "REPORT.md + graphs regenerated (missing stages: $missing)"

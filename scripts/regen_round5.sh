@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Round-5 report regeneration, staged so partial results survive
+# interruption (same shape as regen_round4.sh, which was never fully
+# adopted — VERDICT r4 missing #1). Run on the TPU host; takes a few hours
+# behind the tunnel. Stages write /tmp/r5_*.json; adopt with
+# scripts/assemble_report_round5.sh when all stages are done.
+#
+# Every device-span gauss cell exercises the round-5 two-level (deferred)
+# panel kernel, so ALL stages regenerate — no round-4 cells are current.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+stage() {  # stage <name> <args...>: skip if the json already exists
+    local out="/tmp/r5_$1.json"; shift
+    if [ -s "$out" ]; then echo "== skip $out (exists)"; return 0; fi
+    echo "== running $out ($(date +%H:%M:%S))"
+    python -m gauss_tpu.bench.grid "$@" --json "$out" || echo "== FAILED $out"
+}
+
+stage gid  --suite gauss-internal \
+           --backends tpu,tpu-rowelim,tpu-rowelim-step,jax-linalg --span device
+stage mmd  --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,tpu-dist \
+           --span device
+stage mm48 --suite matmul --keys 4096,8192 --backends tpu,tpu-pallas \
+           --span device
+stage gi   --suite gauss-internal \
+           --backends tpu,tpu-unblocked,seq,omp,threads,forkjoin,tiled
+stage gil  --suite gauss-internal --keys 4096,8192 \
+           --backends tpu,tpu-rowelim,jax-linalg --span device
+stage gi16 --suite gauss-internal --keys 16384 \
+           --backends tpu,tpu-rowelim,jax-linalg --span device
+# The 24.5k-34k band: the chunk-escalated deferred-update route must beat
+# the flat fori fallback all the way to the HBM ceiling — these are the
+# REPORT cells that back the README/DESIGN claims (VERDICT r4 missing #1).
+stage gi32 --suite gauss-internal --keys 24576,32768 \
+           --backends tpu --span device
+stage ge   --suite gauss-external --backends tpu,seq,omp \
+           --keys matrix_10,jpwh_991,orsreg_1,sherman5,saylr4,sherman3
+stage ged  --suite gauss-external --backends tpu --span device
+stage mm   --suite matmul --backends tpu,tpu-pallas,tpu-pallas-v1,seq,omp
+stage mm16 --suite matmul --keys 16384 --backends tpu,tpu-pallas --span device
+stage mm24 --suite matmul --keys 24576 --backends tpu --span device
+# memplus last: its ds-chain compile at n=17758 is the longest pole and has
+# hung behind a dropped tunnel once; isolated so the rest of the grid lands.
+stage gem  --suite gauss-external --keys memplus --backends tpu
+stage gemd --suite gauss-external --keys memplus --backends tpu --span device
+
+echo "== all stages done ($(date +%H:%M:%S)); artifacts in /tmp/r5_*.json"

@@ -289,3 +289,27 @@ def test_gauss_dist_suite_rejects_non_dist_backend():
 
     cells = grid.run_suite("gauss-dist", [32], ["seq"], thread_sweep=[2])
     assert len(cells) == 1 and not cells[0].verified
+
+
+def test_gauss_dist_default_device_mesh(monkeypatch):
+    """--dist-device default builds the mesh from jax.devices() of the
+    default platform instead of the forced CPU pool (the real-TPU
+    1-chip-mesh proof of VERDICT r4 next #7; on the CPU test mesh the
+    default platform IS cpu, so this exercises the routing and the
+    provenance note, and the committed reports/cells_gauss_dist_tpu1.json
+    carries the real-chip run). Shard counts past the device pool raise
+    the sizing error, not an obscure mesh failure."""
+    from gauss_tpu.bench import grid
+
+    monkeypatch.setattr(grid, "DIST_DEVICE", "default")
+    cells = grid.run_suite("gauss-dist", [64], ["tpu-dist"], thread_sweep=[1])
+    assert len(cells) == 1 and cells[0].verified
+    assert cells[0].note.startswith("real cpu mesh=1")
+
+    import jax
+
+    too_many = len(jax.devices()) + 1
+    bad = grid.run_suite("gauss-dist", [64], ["tpu-dist"],
+                         thread_sweep=[too_many])
+    assert len(bad) == 1 and not bad[0].verified
+    assert "devices" in (bad[0].note or "")
