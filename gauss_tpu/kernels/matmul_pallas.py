@@ -88,23 +88,25 @@ def _kernel_precision(precision: str, dtype):
 MM_VMEM_BUDGET = 14 * 1024 * 1024  # tile working set, under the ~16 MB limit
 
 
-def _mm_blocks(bm: int, bn: int, bk: int, itemsize: int,
-               acc_itemsize: int) -> tuple:
-    """Shrink (bm, bn, bk) until the tile working set — double-buffered
-    operand blocks, double-buffered output block, accumulator scratch —
-    fits VMEM. The defaults are sized for f32 (~11 MB) and pass through
-    unchanged there; f64 doubles every term and would exceed the budget at
-    the same tiles (ADVICE r4 #2), so bk halves first (pipeline granularity
-    only), then bn, then bm."""
+def _mm_blocks(bm: int, bn: int, bk: int, itemsize: int, acc_itemsize: int,
+               frozen=(False, False, False)) -> tuple:
+    """Shrink the non-``frozen`` tile dims until the working set —
+    double-buffered operand blocks, double-buffered output block,
+    accumulator scratch — fits VMEM. The defaults are sized for f32
+    (~11 MB) and pass through unchanged; f64 doubles every term and would
+    exceed the budget at the same tiles (ADVICE r4 #2), so bk halves first
+    (pipeline granularity only), then bn, then bm. Explicitly requested
+    dims are frozen — they are measured as named, and a past-budget
+    combination fails at compile, loudly."""
     def vmem(bm, bn, bk):
         return ((2 * (bm * bk + bk * bn) + 2 * bm * bn) * itemsize
                 + bm * bn * acc_itemsize)
 
-    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bk > 128:
+    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bk > 128 and not frozen[2]:
         bk //= 2
-    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bn > 128:
+    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bn > 128 and not frozen[1]:
         bn //= 2
-    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bm > 8:
+    while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bm > 8 and not frozen[0]:
         bm //= 2
     return bm, bn, bk
 
@@ -144,17 +146,18 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int | None = None,
     m, k = a.shape
     _, n = b.shape
     # Explicit tiles are honored verbatim (a tile sweep must measure the
-    # config it names — past-budget requests fail at compile, loudly); only
-    # the None defaults route through the VMEM clamp, which passes f32
-    # through at (512, 512, 1024) and shrinks for wider dtypes (ADVICE r4).
-    auto = (bm is None, bn is None, bk is None)
+    # config it names); dims left at their None defaults still route
+    # through the VMEM clamp — f32 defaults pass through at
+    # (512, 512, 1024), wider dtypes shrink (ADVICE r4).
+    frozen = (bm is not None, bn is not None, bk is not None)
     bm_ = min(bm or 512, max(m, 8))
     bn_ = min(bn or 512, max(n, 128))
     bk_ = min(bk or 1024, max(k, 128))
-    if all(auto):
+    if not all(frozen):
         acc_itemsize = 8 if a.dtype == jnp.float64 else 4
         bm_, bn_, bk_ = _mm_blocks(bm_, bn_, bk_,
-                                   jnp.dtype(a.dtype).itemsize, acc_itemsize)
+                                   jnp.dtype(a.dtype).itemsize, acc_itemsize,
+                                   frozen)
     ap = _pad2(a, bm_, bk_)
     bp = _pad2(b, bk_, bn_)
     mp, kp = ap.shape

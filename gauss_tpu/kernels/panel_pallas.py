@@ -192,28 +192,33 @@ def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
 DEFAULT_SEG = 64  # sub-panel segment width; see _panel_kernel (64 best on v5e)
 
 
+DEFER_WORKSET_FACTOR = 5  # empirical VMEM multiple of the block bytes for
+# the deferred form: its segment-boundary dot_generals materialize
+# transposed copies of the (wt, h) trailing slice whose size the simple
+# block+scratch model misses entirely — (256-wide, h=4096, seg=32)
+# reported 18.1 M scoped bytes against a 5.2 M block+scratch estimate and
+# failed to compile on the chip. 5x the block admits every config that
+# measured fast (h <= 2048 at panel 256) and excludes every observed OOM.
+
+
 def defer_seg(h: int, panel: int, itemsize: int = 4) -> int:
     """Sub-panel width for the two-level (deferred-update) kernel form, or 0
-    when only the classic form fits VMEM. The deferred form adds two (seg, h)
-    scratch blocks (multipliers + one-hot pivot lanes) on top of the classic
-    working set, so its reach is shorter; past it the classic segmented form
-    still runs wherever core.blocked.panel_fits_vmem approves the launch."""
-    from gauss_tpu.core.blocked import (PANEL_VMEM_BUDGET,
-                                        _panel_row_overhead, panel_fits_vmem)
+    when only the classic form fits VMEM. The deferred form adds (seg, h)
+    multiplier/pivot scratch AND large Mosaic transposition transients in
+    its boundary dots (see DEFER_WORKSET_FACTOR), so its reach is far
+    shorter than the classic form's; past it the classic segmented kernel
+    — whose input is aliased into its output — runs to the HBM ceiling."""
+    from gauss_tpu.core.blocked import PANEL_VMEM_BUDGET, panel_fits_vmem
 
     if not panel_fits_vmem(h, panel, itemsize):
         return 0
-    base = h * (panel * itemsize + _panel_row_overhead(panel))
+    if h * panel * itemsize * DEFER_WORKSET_FACTOR > PANEL_VMEM_BUDGET:
+        return 0
     # 32 measured best on v5e at h=2048/panel=256 (170 us vs 220 at 64 and
     # 225 at 16: the per-step tile passes shrink with seg, the per-boundary
-    # deferred-update dot chain grows as panel/seg — 32 is the saddle).
-    # 16 is the fallback only where 32's scratch misses the budget.
-    for seg in (32, 16):
-        if seg >= panel:
-            continue
-        if base + 2 * seg * h * itemsize <= PANEL_VMEM_BUDGET:
-            return seg
-    return 0
+    # deferred-update dot chain grows as panel/seg — 32 is the saddle);
+    # narrower panels take the widest seg that still leaves a sub-panel.
+    return 32 if panel > 32 else 16 if panel > 16 else 0
 
 
 @partial(jax.jit, static_argnames=("interpret", "seg", "defer"))
@@ -280,6 +285,13 @@ def panel_factor_pallas(p: jax.Array, kb: jax.Array,
             jax.ShapeDtypeStruct((1,), p.dtype),
             jax.ShapeDtypeStruct((h, 1), jnp.int32),
         ],
+        # The transposed input IS the factored output's buffer: the kernel
+        # copies t_ref into out_ref up front and never reads t_ref again,
+        # so aliasing them (index 1 counts the scalar-prefetch operand)
+        # removes one full (panel, h) block from the scoped-VMEM working
+        # set — the h-ceiling roughly doubles for free (VERDICT r4 next
+        # #5: in-kernel pivoting to the HBM ceiling).
+        input_output_aliases={1: 0},
         interpret=interpret,
     )(kb, p.T)
     # Unchosen rows keep their original relative order after the pivots

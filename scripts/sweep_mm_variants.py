@@ -11,7 +11,9 @@ so the gap is pipeline efficiency, not traffic. Variants tried here:
              bf16 inputs and runs 3 dots with no per-tile VPU split work
   presplit+s presplit + dimension_semantics
 
-Usage: python scripts/sweep_mm_variants.py [n [reps]]
+Usage: python scripts/sweep_mm_variants.py [n]
+(n must be a multiple of 1024: these experimental variants tile without
+padding, unlike the shipped matmul_pallas.)
 """
 import sys
 from functools import partial
@@ -30,6 +32,9 @@ from gauss_tpu.bench.slope import matmul_chain
 from gauss_tpu.kernels.matmul_pallas import matmul_pallas
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+if n % 1024:
+    sys.exit(f"n={n} must be a multiple of 1024 (no padding in these "
+             f"experimental variants)")
 rng = np.random.default_rng(0)
 a = rng.standard_normal((n, n)).astype(np.float32)
 b = rng.standard_normal((n, n)).astype(np.float32)
