@@ -157,6 +157,39 @@ def test_panel_defer_accuracy(rng):
     assert errs[(True, 32)] <= 3 * max(errs[(False, 16)], 1e-5)
 
 
+def test_panel_defer_singular_reports_zero_pivot():
+    """A rank-deficient column block through the DEFERRED form still reports
+    min_abs_pivot == 0 (the singular-abort signal every engine keys on);
+    the deferred rank-seg dots must not mask the classic form's policy."""
+    from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
+
+    h, panel = 96, 48
+    p = np.ones((h, panel), np.float32)  # rank 1: step 2 meets a zero pivot
+    for defer in (False, True):
+        out, ipiv, perm, mp = panel_factor_pallas(p, 0, defer=defer,
+                                                  seg=16 if defer else None)
+        assert float(mp) == 0.0, defer
+
+
+def test_defer_seg_policy():
+    """defer_seg: 0 past panel_fits_vmem or past the transient-inclusive
+    budget (the h=4096/panel=256 chip OOM of round 5); 32 where the
+    deferred form measured fastest; narrower only for narrow panels."""
+    from gauss_tpu.kernels.panel_pallas import (DEFER_WORKSET_FACTOR,
+                                                defer_seg)
+    from gauss_tpu.core.blocked import PANEL_VMEM_BUDGET
+
+    assert defer_seg(2048, 256) == 32
+    assert defer_seg(4096, 256) == 0      # the observed chip OOM config
+    assert defer_seg(2048, 32) == 16
+    assert defer_seg(2048, 16) == 0       # no sub-panel narrower than 16
+    assert defer_seg(65536, 128) == 0     # past panel_fits_vmem entirely
+    # The budget rule itself, at the boundary.
+    h_edge = PANEL_VMEM_BUDGET // (128 * 4 * DEFER_WORKSET_FACTOR)
+    assert defer_seg(h_edge, 128) in (0, 32)
+    assert defer_seg(h_edge * 2, 128) == 0
+
+
 @pytest.mark.parametrize("shape", [(64, 64, 64), (100, 70, 130)])
 def test_matmul_pallas_stripe(rng, shape):
     from gauss_tpu.kernels.matmul_pallas import matmul_pallas_stripe
