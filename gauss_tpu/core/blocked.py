@@ -46,48 +46,56 @@ GROUP_UPDATE_UNSTRIPPED_MAX_BYTES = 16 * 20480 * 20480  # ~6.7 GB: up to
 # f64 transients). At f32 it equals the measured n=20480 limit; the strip
 # loop's extra serialized gathers cost +2.3 ms at n=8192 (sweep_strip r4).
 
-# The Pallas panel kernel holds one transposed (panel, npad) block in VMEM
-# plus pipeline copies and per-row pivot bookkeeping. The per-row cost
-# beyond the raw panel*itemsize block bytes is panel-dependent — narrower
-# panels pay proportionally more copy/bookkeeping per row. Calibrated from
-# the chip's scoped-vmem reports (requested bytes / rows - block bytes,
-# decimal M):
-#   (256, 17920): 19.12 M -> ~43 B/row      (64, 24576): 25.50 M -> ~782 B/row
-#   (128, 24576): 17.58 M -> ~203 B/row
-# Table values round the measurements up for margin. The old flat 256 B/row
-# under-modeled panel 64 by 3x and let the chunked route emit a 25.5 M
-# kernel for any group of height 15k-30k at panel 64 — the round-4 gi32
-# compile failure. Budget = 16 M scoped limit - headroom.
+# Round 5: the panel kernel's transposed input is ALIASED into its output
+# buffer, so its scoped working set is ONE (panel, npad) block plus per-row
+# bookkeeping (inv/chosen (h,1) outputs at 16 B/row each after (8,128)
+# tiling, the done-mask scratch and a few (1, h) mask temporaries at
+# 32 B/row each) — the round-4 two-buffer model and its width-dependent
+# pipeline-copy overheads (43-800 B/row, commit 7e6cfc4) no longer apply.
+# Calibrated against the chip's in-route scoped reports: (128, 24576)
+# inside the chunked loop = 16.33 M = 12.58 M block + ~153 B/row of
+# vectors/temps; 160 B/row flat (width-independent) with margin.
+# Ceilings: 256 -> ~13.1k, 128 -> ~23.1k, 64 -> ~37.3k — panel 64 now
+# carries in-kernel pivoting PAST the single-chip HBM ceiling (~34k),
+# where it measures 1.9x faster than the stock-JAX panel it previously
+# handed those groups to (VERDICT r4 next #5; DESIGN.md #10).
 PANEL_VMEM_BUDGET = 15_500_000
-PANEL_VMEM_ROW_OVERHEAD = {64: 800, 128: 210, 256: 48}
+PANEL_VMEM_ROW_OVERHEAD = 160  # flat (width-independent; see above)
 
+# The aliasing holds only when the kernel operand stays a standalone
+# buffer. Slicing a 64-wide panel out of a group block NARROWER than 2048
+# columns fuses the slice+transpose INTO the aliased call and the block
+# double-counts in scoped VMEM (25.5 M at (64, 24576) with W=1024 groups;
+# every probed W=2048 config compiles, n in 24576..34048). Slices from
+# full-width arrays (the rowelim engine's augmented matrix) are immune —
+# compile-probed at 24576/32768.
+PANEL64_MIN_SLICE_W = 2048
 
-def _panel_row_overhead(panel: int) -> int:
-    # Unknown panels: conservative 1/panel extrapolation through the
-    # measured points (halving panel roughly doubles per-row overhead).
-    return PANEL_VMEM_ROW_OVERHEAD.get(panel, max(48, 55_000 // panel))
+# The deferred (two-level) kernel form additionally materializes large
+# transposition transients in its boundary dots (the h=4096/panel=256
+# chip OOM, kernels.panel_pallas DEFER_WORKSET_FACTOR); defer_seg budgets
+# those against this same scoped limit via its own workset rule.
+DEFER_VMEM_BUDGET = 15_500_000
 
 
 def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
     """Whether the Pallas panel kernel's VMEM working set fits the scoped
-    limit: npad * (panel * itemsize + per-panel row overhead)."""
+    limit: npad * (panel * itemsize + flat row overhead)."""
     npad = -(-n // panel) * panel
-    return npad * (panel * itemsize + _panel_row_overhead(panel)) \
+    return npad * (panel * itemsize + PANEL_VMEM_ROW_OVERHEAD) \
         <= PANEL_VMEM_BUDGET
 
 
 def auto_panel(n: int, itemsize: int = 4) -> int:
-    """The widest panel in {256, 128, 64} whose kernel block fits VMEM.
-
-    256 wins on v5e for n >= 1024 (fewer XLA glue steps beat the extra VPU
-    work); 128 extends the reachable n to ~21.5k. Panel 64's per-row
-    overhead is so large (see the calibration above) that its ceiling
-    (~14.5k) sits BELOW 128's — narrower never extends reach past 128, so
-    beyond ~21.5k no panel fits the VMEM kernel; 64 is returned anyway and
-    panel-impl resolution (per GROUP in the chunked route) falls back to
-    the stock-JAX panel path, which has no VMEM ceiling (on one v5e chip
-    HBM binds first anyway, around n~33k f32 — see fits_single_chip /
-    solve_handoff for the size routing).
+    """The widest panel in {256, 128, 64} whose ALIASED kernel block fits
+    the scoped budget (see the round-5 calibration above): 256 to ~13.1k
+    (the end-to-end winner there — fewer XLA glue steps), 128 to ~23.1k,
+    64 to ~37.3k. Width preference and VMEM reach now AGREE with the
+    per-column measurements (4.5 us/col at (16384, 128) vs 5.3 at 256;
+    panel 64 1.9x faster than the stock-JAX panel at 32768), so the
+    ladder is both the preference and the constraint; past 64's ceiling
+    (academic on one chip — HBM binds at ~34k) the per-group impl
+    resolution falls back to the stock-JAX panel as before.
     Every factorization entry point resolves panel=None through this.
     """
     if n < 1024:
@@ -716,14 +724,18 @@ def lu_factor_blocked_chunked(a: jax.Array,
         w = gpanels * panel          # group block width (static)
         rt = gh - w                  # right-of-group trailing width (static)
         grp = m[gs:, gs:gs + w]      # (gh, w) group column block
-        # Panel-impl resolution is PER GROUP on the group height: the Pallas
-        # kernel's VMEM block is (panel, gh), so even when the FIRST groups
-        # of a very large n exceed the budget (n=32768 at panel 64 does),
-        # every group past the ceiling runs the fast kernel — only the
-        # early ones fall back to the stock-JAX panel. This is what extends
-        # the chunked route to the single-chip HBM ceiling (VERDICT r3
-        # next #2); explicit "jax"/"pallas" requests stay global.
+        # Panel-impl resolution is PER GROUP on the group height; explicit
+        # "jax"/"pallas" requests stay global. Narrow panel-64 groups
+        # additionally drop to the stock-JAX panel in auto mode: slicing
+        # the panel from a group block under PANEL64_MIN_SLICE_W columns
+        # fuses into the aliased kernel call and double-counts its block
+        # in scoped VMEM (the round-5 compile probes) — resolve_factor
+        # never produces such a config, but explicit chunk/panel
+        # combinations can.
         impl_g = _resolve_panel_impl(panel_impl, gh, panel, itemsize)
+        if (impl_g == "pallas" and panel_impl == "auto" and panel <= 64
+                and w < PANEL64_MIN_SLICE_W):
+            impl_g = "jax"
 
         def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
             grp, gperm, min_piv, linvs, uinvs = carry
@@ -748,9 +760,23 @@ def lu_factor_blocked_chunked(a: jax.Array,
         grp, gperm, min_piv, linvs, uinvs = lax.fori_loop(
             0, gpanels, body, (grp, gperm0, min_piv, linvs0, uinvs0))
 
-        # One fix-up per group: realign the left L-multiplier columns
-        # (written by earlier groups) with this group's composed permutation.
-        if gs:
+        unstripped = (4 * npad * npad * itemsize
+                      <= GROUP_UPDATE_UNSTRIPPED_MAX_BYTES)
+        # One fix-up per group: realign the L-multiplier columns written by
+        # earlier groups (left of gs) with this group's composed
+        # permutation. In the strip form (HBM-ceiling band) the SAME gather
+        # realigns the right columns too: full rows, one gather, so the
+        # strip updates below can run in place on row-aligned data — peak
+        # HBM stays ~2 matrix copies. (Round 4 realigned left-only and
+        # gathered permuted rows per strip into a full (gh-w, rt) `fresh`
+        # accumulator; at n=34048 that schedule needed 19.7 GB and failed
+        # to compile — a claim the round-4 report never actually backed.)
+        if not unstripped:
+            # Offset indices, not slice-then-gather: m[gs:][gperm] makes the
+            # compiler materialize the (gh, npad) slice AND the gather
+            # output (2 x 3.75 GB at n=32768, 70 MB over budget).
+            m = m.at[gs:].set(m[gs + gperm])
+        elif gs:
             left = m[gs:, :gs][gperm]
             m = m.at[gs:, :gs].set(left)
         m = m.at[gs:, gs:gs + w].set(grp)
@@ -759,18 +785,19 @@ def lu_factor_blocked_chunked(a: jax.Array,
         uinvs_all.append(uinvs)
 
         if rt:
-            # Deferred right-of-group update: gather the group's block rows
-            # of the right columns with the composed permutation, compute
+            # Deferred right-of-group update: the group's block rows of the
+            # right columns (already row-permuted in the strip form; via a
+            # composed-permutation gather otherwise), then
             # U12 = L_group^-1 A12 as a blockwise scan over the group's
             # chunk block rows (same zero-meets-U argument as
             # _blockwise_substitution_scan), then the whole group's
             # trailing contribution as one logical (gh-w, w) x (w, rt) MXU
-            # GEMM — executed in bounded ROW STRIPS so peak HBM residency
-            # stays ~2 matrix copies + O(strip) transients: the full-size
-            # gather + GEMM temporaries of the unstripped form OOMed the
-            # chip at n=32768 (4.3 GB matrix, ~16 GB peak), while the strip
-            # form keeps the whole 24.5k-34k band on this route.
-            top = m[gs + gperm[:w]][:, gs + w:]     # (w, rt) block rows
+            # GEMM — one pass in the unstripped form, bounded in-place ROW
+            # STRIPS in the HBM-ceiling band.
+            if unstripped:
+                top = m[gs + gperm[:w]][:, gs + w:]  # (w, rt) block rows
+            else:
+                top = lax.dynamic_slice(m, (gs, gs + w), (w, rt))
 
             def usolve(x, i, grp=grp):
                 rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
@@ -782,35 +809,41 @@ def lu_factor_blocked_chunked(a: jax.Array,
             u12, _ = lax.scan(usolve, jnp.zeros((w, rt), dtype),
                               jnp.arange(gpanels))
 
-            def a22_strip(rows_idx, l21_strip):
-                old = m[gs + rows_idx][:, gs + w:]   # gathered old rows
-                return old - jnp.dot(l21_strip, u12, precision=gemm_prec)
+            if unstripped:
+                # One gather + one GEMM; transients peak ~3 trailing-block
+                # copies, fine while the byte gate holds.
+                def a22_full(rows_idx, l21_full):
+                    old = m[gs + rows_idx][:, gs + w:]
+                    return old - jnp.dot(l21_full, u12, precision=gemm_prec)
 
-            sw = ((gh - w) if 4 * npad * npad * itemsize
-                  <= GROUP_UPDATE_UNSTRIPPED_MAX_BYTES
-                  else min(GROUP_UPDATE_STRIP, gh - w))
-            nfull = (gh - w) // sw
-            fresh = jnp.zeros((gh - w, rt), dtype)
+                fresh = a22_full(gperm[w:], grp[w:])
+                # Writes come LAST: gperm[w:] can name original rows < w,
+                # so the gather must read the right region's OLD data — the
+                # u12 block-row write would clobber exactly those rows.
+                m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
+                m = lax.dynamic_update_slice(m, fresh, (gs + w, gs + w))
+            else:
+                # Rows are already permutation-aligned: each strip reads
+                # and writes only its own rows of m — in place, no
+                # accumulator, no read-after-write hazard.
+                m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
+                sw = min(GROUP_UPDATE_STRIP, gh - w)
+                nfull = (gh - w) // sw
 
-            def strip_body(s, fresh):
-                r0 = w + s * sw
-                idx = lax.dynamic_slice(gperm, (r0,), (sw,))
-                l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
-                return lax.dynamic_update_slice(
-                    fresh, a22_strip(idx, l21), (s * sw, 0))
+                def strip_body(s, m):
+                    r0 = w + s * sw
+                    old = lax.dynamic_slice(m, (gs + r0, gs + w), (sw, rt))
+                    l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
+                    new = old - jnp.dot(l21, u12, precision=gemm_prec)
+                    return lax.dynamic_update_slice(m, new, (gs + r0, gs + w))
 
-            fresh = lax.fori_loop(0, nfull, strip_body, fresh)
-            tail = (gh - w) - nfull * sw
-            if tail:
-                fresh = lax.dynamic_update_slice(
-                    fresh,
-                    a22_strip(gperm[w + nfull * sw:], grp[w + nfull * sw:]),
-                    (nfull * sw, 0))
-            # Writes come LAST: gperm[w:] can name original rows < w, so
-            # every strip must read the right region's OLD data — the u12
-            # block-row write would clobber exactly those rows.
-            m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
-            m = lax.dynamic_update_slice(m, fresh, (gs + w, gs + w))
+                m = lax.fori_loop(0, nfull, strip_body, m)
+                tail = (gh - w) - nfull * sw
+                if tail:
+                    old = m[gs + w + nfull * sw:gs + gh, gs + w:]
+                    new = old - jnp.dot(grp[w + nfull * sw:], u12,
+                                        precision=gemm_prec)
+                    m = m.at[gs + w + nfull * sw:gs + gh, gs + w:].set(new)
 
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=jnp.concatenate(linvs_all),
@@ -864,6 +897,12 @@ def resolve_factor(n: int, unroll):
                 chunk *= 2
             if -(-nb // chunk) > MAX_CHUNK_GROUPS:
                 return lu_factor_blocked
+            # Panel-64 groups must be >= PANEL64_MIN_SLICE_W columns wide
+            # or the aliasing degrades (see the constant's note). Wider
+            # chunks only shrink the group count, so the compile-payload
+            # cap stays satisfied.
+            if panel == 64:
+                chunk = max(chunk, PANEL64_MIN_SLICE_W // panel)
             if chunk == CHUNK_DEFAULT:
                 return lu_factor_blocked_chunked
             return partial(lu_factor_blocked_chunked, chunk=chunk)

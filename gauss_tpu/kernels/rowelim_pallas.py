@@ -196,17 +196,19 @@ def auto_rowelim_k(n: int) -> int:
     ~20k, 64 beyond)."""
     from gauss_tpu.core.blocked import panel_fits_vmem
 
-    for k in (256, 128):
+    # With the round-5 aliased kernel the width ladder is monotone in
+    # reach (64's ceiling ~37k now EXTENDS past 128's ~23k — the old
+    # two-buffer model inverted that), so 64 is a real rung, carrying
+    # in-kernel pivoting past the HBM ceiling.
+    for k in (256, 128, 64):
         if panel_fits_vmem(n, k):
             return k
-    # No k fits the VMEM kernel (64's per-row overhead puts its ceiling
-    # BELOW 128's — see core.blocked.auto_panel): the engine's shared
-    # panel-impl resolution then routes every panel to the stock-JAX
+    # Nothing fits (academic on one chip — HBM binds first): the engine's
+    # shared panel-impl resolution routes every panel to the stock-JAX
     # factorizer, which has no VMEM ceiling. There the WIDEST k wins
     # (fewer serial groups, fuller rank-k MXU updates), so return 256 —
     # never a narrow k that panel_fits_vmem has not approved anyway
-    # (ADVICE r3 #2 / VERDICT r4 weak #3: the bare 64 fallback implied a
-    # Pallas launch past the budget).
+    # (ADVICE r3 #2 / VERDICT r4 weak #3).
     return 256
 
 

@@ -313,23 +313,23 @@ def test_auto_panel_vmem_budget():
     fac = lu_factor_blocked_unrolled(np.eye(64, dtype=np.float32), panel=None)
     assert fac.linv.shape[1] == 128 or fac.m.shape[0] == 128
     assert auto_panel(512) == 128          # below the 1024 crossover
-    assert auto_panel(17758) == 128        # 256 would blow the kernel VMEM
-    assert auto_panel(24576) == 64
-    # Beyond the VMEM ceiling auto_panel no longer raises (VERDICT r1 #8):
-    # 64 comes back as the fallthrough and the panel impl resolves to the
-    # stock-JAX path (panel_fits_vmem is the calibrated working-set model).
+    assert auto_panel(2048) == 256         # end-to-end winner to ~13.1k
+    assert auto_panel(17758) == 128        # 256-block past the budget
+    # Round 5: the aliased kernel made 64 a real rung (ceiling ~37.3k,
+    # past 128's ~23.1k — the old two-buffer model had it inverted), so
+    # in-kernel pivoting covers the whole single-chip range.
+    for n in (24576, 32768, 34048):
+        assert auto_panel(n) == 64
     from gauss_tpu.core.blocked import panel_fits_vmem
 
-    # 24576 joins the no-fit band after the round-4 recalibration: the
-    # panel-64 kernel's real footprint is ~4x its block bytes (25.5 M
-    # scoped-vmem request on the chip), so past the ~21.7k panel-128
-    # ceiling NO panel fits and the per-group impl resolution hands tall
-    # groups to the stock-JAX panel path.
-    for n in (24576, 40000, 60000):
+    for n in (100, 1024, 17758, 20480, 32768, 34048):
+        assert panel_fits_vmem(n, auto_panel(n))
+    # Past 64's ceiling (academic on one chip) nothing fits; 64 falls
+    # through and the per-group impl resolution hands those heights to the
+    # stock-JAX panel.
+    for n in (40000, 60000):
         assert auto_panel(n) == 64
         assert not panel_fits_vmem(n, 64)
-    for n in (100, 1024, 17758, 20480):
-        assert panel_fits_vmem(n, auto_panel(n))
 
 
 def test_lu_solve_substitution_method(rng):
@@ -356,11 +356,14 @@ def test_lu_solve_substitution_method(rng):
 def test_auto_panel_no_ceiling():
     """auto_panel must not raise beyond the VMEM ceiling (VERDICT r1 #8):
     it returns 64 and panel-impl resolution falls back to the stock-JAX
-    panel, which has no VMEM limit."""
+    panel, which has no VMEM limit. (Round 5 pushed 64's ceiling to
+    ~37.3k — past the single-chip HBM bound — so the fallback is academic
+    on this hardware.)"""
     from gauss_tpu.core import blocked
 
     assert blocked.auto_panel(65536) == 64
     assert not blocked.panel_fits_vmem(65536, 64)
+    assert blocked.panel_fits_vmem(34048, 64)
     assert blocked.panel_fits_vmem(2048, 256)
 
 
@@ -371,6 +374,10 @@ def test_resolve_panel_impl_vmem_fallback(monkeypatch):
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert blocked._resolve_panel_impl("auto", 2048, 256) == "pallas"
+    # Round 5: in-kernel pivoting covers the whole single-chip range
+    # (aliased kernel, panel 64 to ~37.3k); the stock-JAX fallback engages
+    # only past that, academic on one chip.
+    assert blocked._resolve_panel_impl("auto", 32768, 64) == "pallas"
     assert blocked._resolve_panel_impl("auto", 65536, 64) == "jax"
     # An explicit pallas request past the ceiling raises a sizing error on
     # a real TPU (ADVICE r3) instead of dying in Mosaic.
@@ -508,9 +515,13 @@ def test_resolve_factor_policy(monkeypatch):
     f = blocked.resolve_factor(17758, "auto")
     assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
     assert f.keywords["chunk"] == 8
+    # Panel-64 groups are pinned >= 2048 columns wide (W=1024 groups fuse
+    # the panel slice into the aliased kernel call and double-count its
+    # block in scoped VMEM — the round-5 compile probes), so 24576 jumps
+    # straight to chunk 32.
     f = blocked.resolve_factor(24576, "auto")  # panel 64 -> 384 blocks
     assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
-    assert f.keywords["chunk"] == 16
+    assert f.keywords["chunk"] == 32
     # Round 4: chunk escalates to 32, so the chunked route covers the whole
     # single-chip range — the flat fori fallback is never the route below
     # the HBM ceiling (~34k) anymore (VERDICT r3 next #2).

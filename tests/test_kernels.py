@@ -354,28 +354,29 @@ def test_rowelim_explicit_pallas_past_vmem_ceiling_raises(monkeypatch):
                                        panel_impl="pallas")
 
 
-def test_auto_rowelim_k_past_ceiling_routes_to_jax_panel(monkeypatch):
-    """Past every panel's VMEM ceiling auto_rowelim_k must return a k the
-    engine's shared panel-impl resolution routes to the stock-JAX panel —
-    never a narrow k implying a Pallas launch panel_fits_vmem has not
-    approved (ADVICE r3 #2 / VERDICT r4 weak #3). The widest k wins there:
-    the jax panel has no VMEM ceiling and fewer groups mean fewer serial
-    steps."""
+def test_auto_rowelim_k_never_implies_unapproved_launch(monkeypatch):
+    """auto_rowelim_k must always return a k that either fits the VMEM
+    model (Pallas launch approved) or that the engine's shared panel-impl
+    resolution routes to the stock-JAX panel — never a k implying a Pallas
+    launch panel_fits_vmem has not approved (ADVICE r3 #2 / VERDICT r4
+    weak #3). With the round-5 aliased kernel this holds to absurd sizes;
+    the fallback behavior is preserved under a shrunk budget."""
     import jax
 
     from gauss_tpu.core import blocked
     from gauss_tpu.kernels.rowelim_pallas import auto_rowelim_k
 
-    # In-range picks unchanged (the calibrated working-set model).
-    assert auto_rowelim_k(2048) == 256
-    assert auto_rowelim_k(16384) == 128
-
-    n = 65536  # past the ~21.5k ceiling of every panel width
-    k = auto_rowelim_k(n)
-    assert k == 256
-    assert not blocked.panel_fits_vmem(n, k)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert blocked._resolve_panel_impl("auto", n, k) == "jax"
+    for n in (2048, 16384, 65536):
+        k = auto_rowelim_k(n)
+        assert blocked.panel_fits_vmem(n, k) or \
+            blocked._resolve_panel_impl("auto", n, k) == "jax"
+    # Shrink the budget so nothing fits: the fallback must be the WIDEST k
+    # (fewest serial groups on the no-ceiling stock-JAX path), routed jax.
+    monkeypatch.setattr(blocked, "PANEL_VMEM_BUDGET", 1024)
+    k = auto_rowelim_k(4096)
+    assert k == 256
+    assert blocked._resolve_panel_impl("auto", 4096, k) == "jax"
 
 
 def test_rowelim_batched_matches_per_step(rng):
@@ -411,9 +412,13 @@ def test_auto_rowelim_k_policy():
     assert auto_rowelim_k(2048) == 256
     assert auto_rowelim_k(8192) == 256
     assert auto_rowelim_k(16384) == 128   # 256-block no longer fits VMEM
-    # Past 128's ceiling NO width fits (64's ceiling is lower still): the
-    # stock-JAX panel takes over, where the widest k wins.
-    assert auto_rowelim_k(24576) == 256
+    # Round 5: the aliased kernel made 64 a real rung (ceiling ~37k, past
+    # 128's ~23k) — in-kernel pivoting continues to the HBM ceiling.
+    assert auto_rowelim_k(24576) == 64
+    assert auto_rowelim_k(34048) == 64
+    # Nothing fits only at academic sizes; the widest k falls back and the
+    # impl resolution routes it to the stock-JAX panel.
+    assert auto_rowelim_k(65536) == 256
 
 
 def test_rowelim_batched_auto_k(rng):
