@@ -101,8 +101,15 @@ def _stage(*arrays):
     import jax
     import jax.numpy as jnp
 
+    from gauss_tpu.utils.timing import fetch_staged
+
     staged = [jnp.asarray(a, jnp.float32) for a in arrays]
-    return jax.block_until_ready(staged)
+    jax.block_until_ready(staged)
+    # block_until_ready can return before tunneled uploads finish; bound
+    # each staged buffer with a scalar fetch so the H2D cannot bill to the
+    # caller's timed span (see timing.fetch_staged).
+    fetch_staged(*staged)
+    return staged
 
 
 def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel, refine_tol):
@@ -137,9 +144,15 @@ def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel, refine_tol):
                                   dsfloat.to_ds(np.zeros(n)), panel,
                                   iters=refine_iters))
 
+        from gauss_tpu.utils.timing import fetch_staged
+
         a_dev = _stage(a64c)[0]
         at_ds = jax.block_until_ready(dsfloat.to_ds(a64c.T))
         b_ds = jax.block_until_ready(dsfloat.to_ds(b64c))
+        # The ds operand pair is ~2.5 GB over a ~21 MB/s tunnel; without
+        # the completion fetches the in-flight upload bills to the timed
+        # span below (measured 86-100 s around a 0.4 s solve).
+        fetch_staged(at_ds, b_ds)
         elapsed, x = timed_fetch(
             lambda: dsfloat.ds_to_f64(
                 dsfloat.solve_once_ds(a_dev, at_ds, b_ds, panel,

@@ -74,8 +74,9 @@ PANEL64_MIN_SLICE_W = 2048
 # The deferred (two-level) kernel form additionally materializes large
 # transposition transients in its boundary dots (the h=4096/panel=256
 # chip OOM, kernels.panel_pallas DEFER_WORKSET_FACTOR); defer_seg budgets
-# those against this same scoped limit via its own workset rule.
-DEFER_VMEM_BUDGET = 15_500_000
+# those against the same physical scoped limit via its own workset rule —
+# aliased to the panel budget so a future recalibration cannot drift.
+DEFER_VMEM_BUDGET = PANEL_VMEM_BUDGET
 
 
 def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
@@ -285,7 +286,7 @@ def _resolve_panel_impl(panel_impl, n: int | None = None,
         # The Pallas VMEM-resident panel kernel uses TPU-only Mosaic
         # features; it is the fast path on real TPUs — when its block fits
         # VMEM — and stock JAX everywhere else (CPU test mesh, GPU) and
-        # beyond the ~57k VMEM ceiling (slower per panel but unlimited).
+        # beyond panel 64's ~37.3k ceiling (slower per panel, unlimited).
         if jax.default_backend() != "tpu":
             return "jax"
         if (n is not None and panel is not None
@@ -733,9 +734,19 @@ def lu_factor_blocked_chunked(a: jax.Array,
         # never produces such a config, but explicit chunk/panel
         # combinations can.
         impl_g = _resolve_panel_impl(panel_impl, gh, panel, itemsize)
-        if (impl_g == "pallas" and panel_impl == "auto" and panel <= 64
-                and w < PANEL64_MIN_SLICE_W):
-            impl_g = "jax"
+        if (impl_g == "pallas" and panel <= 64 and w < PANEL64_MIN_SLICE_W):
+            if panel_impl == "auto":
+                impl_g = "jax"
+            elif jax.default_backend() == "tpu":
+                # Same contract as _resolve_panel_impl's explicit-pallas
+                # sizing check (ADVICE r3): fail with a clear error, not a
+                # Mosaic scoped-VMEM crash — the narrow slice would fuse
+                # into the aliased kernel call and double-count its block.
+                raise ValueError(
+                    f"panel_impl='pallas' with panel={panel} needs groups "
+                    f">= {PANEL64_MIN_SLICE_W} columns wide (got "
+                    f"chunk*panel={w}); raise chunk, or use "
+                    f"panel_impl='auto' (stock-JAX panel for these groups)")
 
         def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
             grp, gperm, min_piv, linvs, uinvs = carry
@@ -1022,8 +1033,8 @@ def solve_handoff(a, b, budget: int | None = None, mesh=None,
     it raises rather than silently ignoring the request.
 
     The single-chip ceiling this lifts: the f32 blocked path fits one v5e
-    chip to n ~ 33k (HBM-bound; the Pallas panel kernel's own VMEM ceiling
-    at ~57k no longer raises — panel-impl resolution falls back to the
+    chip to n ~ 34k (HBM-bound; the Pallas panel kernel's own VMEM ceiling
+    at ~37.3k never raises — panel-impl resolution falls back to the
     stock-JAX panel beyond it). Past the budget the solve needs the sharded
     engine's aggregate memory; with no multi-device mesh available that is
     an explicit error, not an OOM.

@@ -63,7 +63,7 @@ files+=(reports/cells_precision.json reports/cells_gauss_dist.json reports/cells
 [ -s reports/cells_gauss_dist_tpu1.json ] && files+=(reports/cells_gauss_dist_tpu1.json)
 
 python -m gauss_tpu.bench.report "${files[@]}" \
-    --title "gauss-tpu benchmark report" --out reports/REPORT.md --profile 1024
+    --title "gauss-tpu benchmark report (round 5)" --out reports/REPORT.md --profile 1024
 python -m gauss_tpu.bench.plots reports/cells_gauss_internal.json \
     reports/cells_gauss_internal_device.json reports/cells_matmul_device.json \
     --outdir graphs
