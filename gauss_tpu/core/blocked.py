@@ -48,19 +48,20 @@ GROUP_UPDATE_UNSTRIPPED_MAX_BYTES = 16 * 20480 * 20480  # ~6.7 GB: up to
 
 # Round 5: the panel kernel's transposed input is ALIASED into its output
 # buffer, so its scoped working set is ONE (panel, npad) block plus per-row
-# bookkeeping (inv/chosen (h,1) outputs at 16 B/row each after (8,128)
-# tiling, the done-mask scratch and a few (1, h) mask temporaries at
-# 32 B/row each) — the round-4 two-buffer model and its width-dependent
-# pipeline-copy overheads (43-800 B/row, commit 7e6cfc4) no longer apply.
-# Calibrated against the chip's in-route scoped reports: (128, 24576)
-# inside the chunked loop = 16.33 M = 12.58 M block + ~153 B/row of
-# vectors/temps; 160 B/row flat (width-independent) with margin.
-# Ceilings: 256 -> ~13.1k, 128 -> ~23.1k, 64 -> ~37.3k — panel 64 now
-# carries in-kernel pivoting PAST the single-chip HBM ceiling (~34k),
-# where it measures 1.9x faster than the stock-JAX panel it previously
-# handed those groups to (VERDICT r4 next #5; DESIGN.md #10).
+# bookkeeping (inv/chosen (h,1) outputs, the done-mask scratch and mask
+# temporaries) — the round-4 two-buffer model and its 43-800 B/row
+# pipeline-copy overheads (commit 7e6cfc4) no longer apply. The residual
+# overhead is context-dependent (the chip reported 153 B/row for
+# (128, 24576) in one chunk width and ~210 B/row for (128, ~22.5k) in
+# another — the enclosing group width changes which temporaries the
+# scheduler keeps live), so the table below rounds the WORST observation
+# per width up for margin; a borderline group that false-approves costs a
+# whole route its compile. Ceilings: 256 -> ~12.4k, 128 -> ~21.1k,
+# 64 -> ~34.7k — in-kernel pivoting covers the single-chip HBM ceiling
+# (~34k), where the kernel measures 1.9-3.3x faster than the stock-JAX
+# panel it previously handed tall groups to (VERDICT r4 next #5).
 PANEL_VMEM_BUDGET = 15_500_000
-PANEL_VMEM_ROW_OVERHEAD = 160  # flat (width-independent; see above)
+PANEL_VMEM_ROW_OVERHEAD = {64: 190, 128: 220, 256: 220}
 
 # The aliasing holds only when the kernel operand stays a standalone
 # buffer. Slicing a 64-wide panel out of a group block NARROWER than 2048
@@ -81,30 +82,29 @@ DEFER_VMEM_BUDGET = PANEL_VMEM_BUDGET
 
 def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
     """Whether the Pallas panel kernel's VMEM working set fits the scoped
-    limit: npad * (panel * itemsize + flat row overhead)."""
+    limit: npad * (panel * itemsize + per-width row overhead)."""
     npad = -(-n // panel) * panel
-    return npad * (panel * itemsize + PANEL_VMEM_ROW_OVERHEAD) \
-        <= PANEL_VMEM_BUDGET
+    overhead = PANEL_VMEM_ROW_OVERHEAD.get(panel, 220)
+    return npad * (panel * itemsize + overhead) <= PANEL_VMEM_BUDGET
 
 
 def auto_panel(n: int, itemsize: int = 4) -> int:
-    """The widest panel in {256, 128, 64} whose ALIASED kernel block fits
-    the scoped budget (see the round-5 calibration above): 256 to ~13.1k
-    (the end-to-end winner there — fewer XLA glue steps), 128 to ~23.1k,
-    64 to ~37.3k. Width preference and VMEM reach now AGREE with the
-    per-column measurements (4.5 us/col at (16384, 128) vs 5.3 at 256;
-    panel 64 1.9x faster than the stock-JAX panel at 32768), so the
-    ladder is both the preference and the constraint; past 64's ceiling
-    (academic on one chip — HBM binds at ~34k) the per-group impl
-    resolution falls back to the stock-JAX panel as before.
+    """Measured-best panel width: 256 while its kernel block fits the
+    scoped budget (~12.4k — the end-to-end winner there: fewer XLA glue
+    steps), 128 everywhere beyond. The full (n, 128) block stops fitting
+    at ~21.1k, but that does NOT route the width away from 128: the
+    chunked route resolves the panel impl PER GROUP, so only the first
+    (tallest) groups run the stock-JAX panel and every later group runs
+    the kernel — measured at n=24576 this mixed-128 route beats the
+    all-in-kernel panel-64 route 0.79 vs 1.02 s (the narrower kernel's
+    extra serial steps cost more than the few stock-JAX panels save).
     Every factorization entry point resolves panel=None through this.
     """
     if n < 1024:
         return DEFAULT_PANEL  # crossover heuristic; VMEM is never binding
-    for panel in (256, 128, 64):
-        if panel_fits_vmem(n, panel, itemsize):
-            return panel
-    return 64
+    if panel_fits_vmem(n, 256, itemsize):
+        return 256
+    return 128
 
 
 def _resolve_panel(n: int, panel, itemsize: int = 4) -> int:
@@ -286,7 +286,7 @@ def _resolve_panel_impl(panel_impl, n: int | None = None,
         # The Pallas VMEM-resident panel kernel uses TPU-only Mosaic
         # features; it is the fast path on real TPUs — when its block fits
         # VMEM — and stock JAX everywhere else (CPU test mesh, GPU) and
-        # beyond panel 64's ~37.3k ceiling (slower per panel, unlimited).
+        # beyond the kernel budget (slower per panel, but unlimited).
         if jax.default_backend() != "tpu":
             return "jax"
         if (n is not None and panel is not None
@@ -734,18 +734,29 @@ def lu_factor_blocked_chunked(a: jax.Array,
         # never produces such a config, but explicit chunk/panel
         # combinations can.
         impl_g = _resolve_panel_impl(panel_impl, gh, panel, itemsize)
-        if (impl_g == "pallas" and panel <= 64 and w < PANEL64_MIN_SLICE_W):
+        # Two group-width contexts degrade the kernel's aliasing into a
+        # full block double-count (round-5 compile probes): panel-64
+        # slices from groups NARROWER than PANEL64_MIN_SLICE_W, and
+        # panel-128 slices from groups EXACTLY 2048 columns wide (W=1024
+        # and W=4096 alias fine at 128; the fusion decision is
+        # whole-program-context dependent — the same (128, 14336) shape
+        # compiled inside n=24576 and double-counted inside n=32768, so
+        # this guard is necessarily approximate and explicit
+        # outside-the-auto-envelope configs can still hit raw Mosaic
+        # scoped-VMEM errors). Auto mode drops guarded groups to the
+        # stock-JAX panel; explicit pallas requests get the clear sizing
+        # error (same contract as _resolve_panel_impl, ADVICE r3).
+        narrow64 = panel <= 64 and w < PANEL64_MIN_SLICE_W
+        wide128 = (panel == 128 and w == 2048
+                   and gh * (2 * panel * itemsize + 128) > PANEL_VMEM_BUDGET)
+        if impl_g == "pallas" and (narrow64 or wide128):
             if panel_impl == "auto":
                 impl_g = "jax"
             elif jax.default_backend() == "tpu":
-                # Same contract as _resolve_panel_impl's explicit-pallas
-                # sizing check (ADVICE r3): fail with a clear error, not a
-                # Mosaic scoped-VMEM crash — the narrow slice would fuse
-                # into the aliased kernel call and double-count its block.
                 raise ValueError(
-                    f"panel_impl='pallas' with panel={panel} needs groups "
-                    f">= {PANEL64_MIN_SLICE_W} columns wide (got "
-                    f"chunk*panel={w}); raise chunk, or use "
+                    f"panel_impl='pallas': the (h={gh}, panel={panel}) "
+                    f"kernel block does not fit scoped VMEM in a "
+                    f"{w}-column group context; adjust chunk, or use "
                     f"panel_impl='auto' (stock-JAX panel for these groups)")
 
         def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
@@ -908,12 +919,17 @@ def resolve_factor(n: int, unroll):
                 chunk *= 2
             if -(-nb // chunk) > MAX_CHUNK_GROUPS:
                 return lu_factor_blocked
-            # Panel-64 groups must be >= PANEL64_MIN_SLICE_W columns wide
-            # or the aliasing degrades (see the constant's note). Wider
-            # chunks only shrink the group count, so the compile-payload
-            # cap stays satisfied.
-            if panel == 64:
-                chunk = max(chunk, PANEL64_MIN_SLICE_W // panel)
+            # Panel-128 chunk-16 (W=2048 groups) inflates the aliased
+            # kernel's scoped overhead at the top sizes (27.3 M at
+            # n=34048, 16.3 M at 32768) and would push the tallest
+            # kernel-eligible groups back onto the stock-JAX panel; chunk
+            # 8 and chunk 32 both compile and measure faster everywhere
+            # probed, so the escalation skips that rung. (auto_panel no
+            # longer returns 64, so no narrow-group pin is needed here;
+            # explicit panel-64 configs are guarded per group in
+            # lu_factor_blocked_chunked.)
+            if panel == 128 and chunk == 16:
+                chunk = 32
             if chunk == CHUNK_DEFAULT:
                 return lu_factor_blocked_chunked
             return partial(lu_factor_blocked_chunked, chunk=chunk)

@@ -197,9 +197,12 @@ def auto_rowelim_k(n: int) -> int:
     from gauss_tpu.core.blocked import panel_fits_vmem
 
     # With the round-5 aliased kernel the width ladder is monotone in
-    # reach (64's ceiling ~37k now EXTENDS past 128's ~23k — the old
+    # reach (64's ceiling ~34.7k now EXTENDS past 128's ~21.1k — the old
     # two-buffer model inverted that), so 64 is a real rung, carrying
-    # in-kernel pivoting past the HBM ceiling.
+    # in-kernel pivoting to the HBM ceiling. This engine slices its
+    # panels from the full-width augmented matrix, which is immune to
+    # the group-width fusion hazards of the chunked route (compile-probed
+    # at 24576/32768).
     for k in (256, 128, 64):
         if panel_fits_vmem(n, k):
             return k

@@ -313,23 +313,23 @@ def test_auto_panel_vmem_budget():
     fac = lu_factor_blocked_unrolled(np.eye(64, dtype=np.float32), panel=None)
     assert fac.linv.shape[1] == 128 or fac.m.shape[0] == 128
     assert auto_panel(512) == 128          # below the 1024 crossover
-    assert auto_panel(2048) == 256         # end-to-end winner to ~13.1k
-    assert auto_panel(17758) == 128        # 256-block past the budget
-    # Round 5: the aliased kernel made 64 a real rung (ceiling ~37.3k,
-    # past 128's ~23.1k — the old two-buffer model had it inverted), so
-    # in-kernel pivoting covers the whole single-chip range.
-    for n in (24576, 32768, 34048):
-        assert auto_panel(n) == 64
+    assert auto_panel(2048) == 256         # end-to-end winner to ~12.4k
+    # Round 5 final policy: 128 everywhere past 256's ceiling. The full
+    # (n, 128) block stops fitting at ~21.1k but the width stays 128:
+    # the chunked route resolves the impl per GROUP, so only the tallest
+    # groups run the stock-JAX panel (measured: mixed-128 beats all-64 at
+    # every probed top size — 0.79 vs 1.02 s at 24576).
+    for n in (17758, 24576, 32768, 34048, 60000):
+        assert auto_panel(n) == 128
     from gauss_tpu.core.blocked import panel_fits_vmem
 
-    for n in (100, 1024, 17758, 20480, 32768, 34048):
+    for n in (100, 1024, 17758, 20480):
         assert panel_fits_vmem(n, auto_panel(n))
-    # Past 64's ceiling (academic on one chip) nothing fits; 64 falls
-    # through and the per-group impl resolution hands those heights to the
-    # stock-JAX panel.
-    for n in (40000, 60000):
-        assert auto_panel(n) == 64
-        assert not panel_fits_vmem(n, 64)
+    # The tall-group band: the returned width deliberately does NOT fit at
+    # full height; per-group resolution covers it.
+    assert not panel_fits_vmem(24576, 128)
+    assert panel_fits_vmem(20480, 128)
+    assert panel_fits_vmem(34048, 64)      # the explicit-64 path still works
 
 
 def test_lu_solve_substitution_method(rng):
@@ -354,15 +354,13 @@ def test_lu_solve_substitution_method(rng):
 
 
 def test_auto_panel_no_ceiling():
-    """auto_panel must not raise beyond the VMEM ceiling (VERDICT r1 #8):
-    it returns 64 and panel-impl resolution falls back to the stock-JAX
-    panel, which has no VMEM limit. (Round 5 pushed 64's ceiling to
-    ~37.3k — past the single-chip HBM bound — so the fallback is academic
-    on this hardware.)"""
+    """auto_panel must not raise at any size (VERDICT r1 #8): it returns
+    128 and the per-group panel-impl resolution hands heights past the
+    kernel budget to the stock-JAX panel, which has no VMEM limit."""
     from gauss_tpu.core import blocked
 
-    assert blocked.auto_panel(65536) == 64
-    assert not blocked.panel_fits_vmem(65536, 64)
+    assert blocked.auto_panel(65536) == 128
+    assert not blocked.panel_fits_vmem(65536, 128)
     assert blocked.panel_fits_vmem(34048, 64)
     assert blocked.panel_fits_vmem(2048, 256)
 
@@ -515,16 +513,15 @@ def test_resolve_factor_policy(monkeypatch):
     f = blocked.resolve_factor(17758, "auto")
     assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
     assert f.keywords["chunk"] == 8
-    # Panel-64 groups are pinned >= 2048 columns wide (W=1024 groups fuse
-    # the panel slice into the aliased kernel call and double-count its
-    # block in scoped VMEM — the round-5 compile probes), so 24576 jumps
-    # straight to chunk 32.
-    f = blocked.resolve_factor(24576, "auto")  # panel 64 -> 384 blocks
+    # Round-5 top band at panel 128: 24576 runs 192 blocks at chunk 8
+    # (24 groups, the measured-best config); 32768/34048 escalate to 32 —
+    # the chunk-16 rung is skipped at panel 128 (its W=2048 groups trip
+    # the aliasing fusion double-count; round-5 compile probes). The
+    # chunked route covers the whole single-chip range — the flat fori
+    # fallback never routes below the HBM ceiling (VERDICT r3 next #2).
+    f = blocked.resolve_factor(24576, "auto")  # panel 128 -> 192 blocks
     assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
-    assert f.keywords["chunk"] == 32
-    # Round 4: chunk escalates to 32, so the chunked route covers the whole
-    # single-chip range — the flat fori fallback is never the route below
-    # the HBM ceiling (~34k) anymore (VERDICT r3 next #2).
+    assert f.keywords["chunk"] == 8
     for big_n in (32768, 34048):
         f = blocked.resolve_factor(big_n, "auto")
         assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
