@@ -886,12 +886,13 @@ UNROLL_MAX_N = 4096  # above this, full unroll costs too much compile payload
 MAX_CHUNK_GROUPS = 24
 
 
-MAX_CHUNK = 32  # escalation ceiling: chunk-32 at panel 64 reaches
-# 24 * 32 * 64 = 49k — past the single-chip HBM ceiling (~34k), so the
-# flat fori fallback is never the route below it (VERDICT r3 next #2).
-# Group count, not group size, is what the tunneled compiler cannot
-# absorb (see MAX_CHUNK_GROUPS); wider groups also make the one deferred
-# trailing GEMM per group deeper (W = 2048 at panel 64).
+MAX_CHUNK = 32  # escalation ceiling: chunk-32 at panel 128 (the round-5
+# auto width past ~12.4k) reaches 24 * 32 * 128 = 98k — far past the
+# single-chip HBM ceiling (~34k), so the flat fori fallback is never the
+# route below it (VERDICT r3 next #2). Group count, not group size, is
+# what the tunneled compiler cannot absorb (see MAX_CHUNK_GROUPS); wider
+# groups also make the one deferred trailing GEMM per group deeper
+# (W = 4096 at panel 128, chunk 32).
 
 
 def resolve_factor(n: int, unroll):
@@ -1049,9 +1050,10 @@ def solve_handoff(a, b, budget: int | None = None, mesh=None,
     it raises rather than silently ignoring the request.
 
     The single-chip ceiling this lifts: the f32 blocked path fits one v5e
-    chip to n ~ 34k (HBM-bound; the Pallas panel kernel's own VMEM ceiling
-    at ~37.3k never raises — panel-impl resolution falls back to the
-    stock-JAX panel beyond it). Past the budget the solve needs the sharded
+    chip to n ~ 34k (HBM-bound; the Pallas panel kernel never binds — the
+    chunked route resolves its impl per group, handing heights past the
+    kernel budget to the stock-JAX panel). Past the budget the solve needs
+    the sharded
     engine's aggregate memory; with no multi-device mesh available that is
     an explicit error, not an OOM.
     """

@@ -25,6 +25,21 @@ def test_timed_fetch_fetches_tree():
     assert best >= 0.0
 
 
+def test_fetch_staged_bounds_pytrees():
+    """fetch_staged must touch one element of every leaf (the tunneled
+    completion bound for staged uploads — see the memplus 86-267 s staging
+    leak it fixes) and hand the arrays back unchanged, pytrees included."""
+    import jax.numpy as jnp
+
+    a = jnp.arange(6.0).reshape(2, 3)
+    tree = {"hi": jnp.ones(4), "lo": jnp.zeros((2, 2))}
+    scalar = jnp.asarray(7.0)
+    out = timing.fetch_staged(a, tree, scalar)
+    assert out[0] is a and out[1] is tree and out[2] is scalar
+    np.testing.assert_array_equal(np.asarray(out[0]),
+                                  np.arange(6.0).reshape(2, 3))
+
+
 def test_force_host_device_count_flag_logic(monkeypatch):
     from gauss_tpu.utils import env
 
