@@ -229,8 +229,10 @@ def _reference_table(cells: Sequence[dict]) -> Optional[List[str]]:
              "speedup |", "|---|---|---|---|"] + rows)
 
 
-def _scaling_exponent(cells: Sequence[dict], backend: str) -> Optional[float]:
-    """Fitted exponent p of t ~ n^p across this backend's verified cells."""
+def _scaling_exponent(cells: Sequence[dict],
+                      backend: str) -> Optional[tuple]:
+    """(fitted exponent p of t ~ n^p, n0, n1) over this backend's verified
+    cells, or None when no adequately-separated size pair exists."""
     import math
 
     best: Dict[float, float] = {}
@@ -241,12 +243,19 @@ def _scaling_exponent(cells: Sequence[dict], backend: str) -> Optional[float]:
             best[nval] = min(best.get(nval, float("inf")), c["seconds"])
     if len(best) < 2:
         return None
-    # Fit over the two LARGEST distinct sizes (best time per size — merged
-    # cell files can repeat a size): small sizes sit on the dispatch/launch
-    # latency floor and would drag the exponent toward 0 for engines that
-    # are genuinely cubic at scale.
-    (n0, t0), (n1, t1) = sorted(best.items())[-2:]
-    return math.log(t1 / t0) / math.log(n1 / n0)
+    # Fit over the two LARGEST distinct sizes at least 1.5x apart (best
+    # time per size — merged cell files can repeat a size): small sizes
+    # sit on the dispatch/launch latency floor and would drag the exponent
+    # toward 0 for engines that are genuinely cubic at scale, and
+    # NEAR-ADJACENT sizes (2001 vs 2048, the padding-edge pair) amplify
+    # timing noise into absurd exponents (n^33 was printed in an earlier
+    # draft) — log(n1/n0) in the denominator needs a real gap.
+    pairs = sorted(best.items())
+    n1, t1 = pairs[-1]
+    for n0, t0 in reversed(pairs[:-1]):
+        if n1 / n0 >= 1.5:
+            return (math.log(t1 / t0) / math.log(n1 / n0), n0, n1)
+    return None
 
 
 def _largest_key(keys: List[str]) -> Optional[str]:
@@ -289,12 +298,13 @@ def _inferences(suite: str, cells: Sequence[dict]) -> List[str]:
                     f"BASELINE.md), the margin is "
                     f"{ref_best / best['seconds']:.1f}x.")
     for backend in _backends_in_order(cells):
-        p = _scaling_exponent(cells, backend)
-        if p is not None and backend.startswith("tpu"):
+        fit = _scaling_exponent(cells, backend)
+        if fit is not None and backend.startswith("tpu"):
+            p, n0, n1 = fit
             note = ("dispatch/latency-dominated below the cubic-work regime"
                     if p < 2.0 else "approaching the cubic-FLOP regime")
-            out.append(f"`{backend}` scales as ~n^{p:.1f} across its two "
-                       f"largest measured sizes — {note}.")
+            out.append(f"`{backend}` scales as ~n^{p:.1f} across "
+                       f"n={n0:g}->{n1:g} — {note}.")
     failed = [c for c in cells if not c["verified"]]
     if failed:
         out.append(f"{len(failed)} cell(s) FAILED verification and report "

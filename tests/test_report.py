@@ -90,8 +90,9 @@ def test_scaling_exponent_cubic():
     cells = [{"suite": "s", "key": str(n), "backend": "b",
               "seconds": (n / 256) ** 3, "verified": True, "error": 0.0,
               "reference_s": None} for n in (256, 512, 1024)]
-    p = report._scaling_exponent(cells, "b")
+    p, n0, n1 = report._scaling_exponent(cells, "b")
     assert p == pytest.approx(3.0, abs=0.01)
+    assert (n0, n1) == (512, 1024)
 
 
 def test_scaling_exponent_ignores_latency_floor():
@@ -101,8 +102,27 @@ def test_scaling_exponent_ignores_latency_floor():
               "seconds": max(1e-4, (n / 2048) ** 3 * 0.002), "verified": True,
               "error": 0.0, "reference_s": None}
              for n in (128, 256, 4096, 8192)]
-    p = report._scaling_exponent(cells, "b")
+    p, _, _ = report._scaling_exponent(cells, "b")
     assert p == pytest.approx(3.0, abs=0.01)
+
+
+def test_scaling_exponent_skips_near_adjacent_sizes():
+    """Near-adjacent size pairs (2001 vs 2048 — the padding-edge pair)
+    amplify timing noise into absurd exponents (n^33 reached a report
+    draft); the fit must skip to a pair >= 1.5x apart, and return None
+    when no such pair exists."""
+    def cell(n, s):
+        return {"suite": "s", "key": str(n), "backend": "b", "seconds": s,
+                "verified": True, "error": 0.0, "reference_s": None}
+
+    # 2048/2001 is 1.02x apart: the fit must anchor 2048 against 1024.
+    cells = [cell(1024, 0.001), cell(2001, 0.009), cell(2048, 0.008)]
+    p, n0, n1 = report._scaling_exponent(cells, "b")
+    assert (n0, n1) == (1024, 2048)
+    assert p == pytest.approx(3.0, abs=0.01)
+    # All sizes near-adjacent: no valid pair, no exponent.
+    assert report._scaling_exponent(
+        [cell(2001, 0.009), cell(2048, 0.008)], "b") is None
 
 
 def test_reference_table_excludes_thread_sweep_rows():
@@ -160,7 +180,7 @@ def test_scaling_exponent_tolerates_duplicate_sizes():
     cells = [{"suite": "s", "key": k, "backend": "b", "seconds": s,
               "verified": True, "error": 0.0, "reference_s": None}
              for k, s in (("1024", 0.001), ("2048", 0.004), ("2048", 0.0041))]
-    p = report._scaling_exponent(cells, "b")
+    p, _, _ = report._scaling_exponent(cells, "b")
     assert p == pytest.approx(2.0, abs=0.01)
 
 
