@@ -1,9 +1,13 @@
 #!/usr/bin/env bash
-# Reproduce reports/REPORT.md and graphs/ from scratch (run on the TPU host;
-# the full sweep takes ~30-45 min behind a tunneled dev chip). External
-# suites read the REAL reference matrices in place when a checkout exists
-# (GAUSS_TPU_REFERENCE_ROOT, default /root/reference) and fall back to the
-# deterministic stand-ins otherwise; every cell records which one ran.
+# Reproduce the core of reports/REPORT.md and graphs/ from scratch (run on
+# the TPU host; this subset takes ~30-45 min behind a tunneled dev chip).
+# The COMMITTED report also carries the large-n band (16384-34048), the
+# per-size matmul cells, and the real-chip dist cells — regenerate those
+# with scripts/regen_round5.sh + scripts/assemble_report_round5.sh (a few
+# hours). External suites read the REAL reference matrices in place when a
+# checkout exists (GAUSS_TPU_REFERENCE_ROOT, default /root/reference) and
+# fall back to the deterministic stand-ins otherwise; every cell records
+# which one ran.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
