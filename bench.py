@@ -57,21 +57,33 @@ def best_prior_headline() -> float | None:
     return best
 
 
-def main() -> None:
+def main(metrics_out: str | None = None) -> None:
+    from gauss_tpu import obs
+
+    with obs.run(metrics_out=metrics_out, tool="bench", n=N) as rec:
+        _bench(rec)
+
+
+def _bench(rec) -> None:
     import jax.numpy as jnp
 
+    from gauss_tpu import obs
     from gauss_tpu.io import synthetic
+    from gauss_tpu.utils.profiling import PhaseTimer
     from gauss_tpu.verify import checks
 
-    a64 = synthetic.internal_matrix(N)
-    b64 = synthetic.internal_rhs(N)
-    a = jnp.asarray(a64, jnp.float32)
-    b = jnp.asarray(b64, jnp.float32)
+    pt = PhaseTimer()
+    with pt.phase("prepare_inputs"):
+        a64 = synthetic.internal_matrix(N)
+        b64 = synthetic.internal_rhs(N)
+        a = jnp.asarray(a64, jnp.float32)
+        b = jnp.asarray(b64, jnp.float32)
     # panel=256 beats 128 since the transposed panel kernel (2 full-tile
     # passes/step): fewer XLA glue steps now outweigh the extra VPU work.
     panel = 256
 
-    per_solve, k_small, k_large, is_slope = _measure_slope(a, b, panel)
+    with pt.phase("headline_slope"):
+        per_solve, k_small, k_large, is_slope = _measure_slope(a, b, panel)
     best_prior = best_prior_headline()
 
     # Correctness gate on EXACTLY the timed configuration (one f32 blocked
@@ -79,9 +91,12 @@ def main() -> None:
     # solve_refined exists for systems that need the mixed-precision path).
     from gauss_tpu.bench.slope import gauss_solve_once
 
-    x = np.asarray(gauss_solve_once(a, b, panel), np.float64)
-    residual = checks.residual_norm(a64, x, b64)
-    pattern_ok = checks.internal_pattern_ok(x, atol=1e-4)
+    with pt.phase("verify"):
+        x = np.asarray(gauss_solve_once(a, b, panel), np.float64)
+        residual = checks.residual_norm(a64, x, b64)
+        pattern_ok = checks.internal_pattern_ok(x, atol=1e-4)
+    obs.record_solve_health(a=a64, x=x, b=b64, backend="tpu",
+                            pattern_ok=pattern_ok)
 
     from gauss_tpu.bench.slope import ROUNDS
 
@@ -93,16 +108,27 @@ def main() -> None:
     from gauss_tpu.bench import slope as _slope
     from gauss_tpu.core import dsfloat
 
-    at_ds = dsfloat.to_ds(a64.T)
-    b_ds = dsfloat.to_ds(b64)
-    x_ds = dsfloat.ds_to_f64(_slope.gauss_solve_once_ds(
-        a, at_ds, b_ds, panel, dsfloat.DS_REFINE_STEPS))
-    refined_residual = checks.residual_norm(a64, x_ds, b64)
-    mk, ar = _slope.ds_solver_chain(a, at_ds, b_ds, panel,
-                                    dsfloat.DS_REFINE_STEPS)
-    refined_s, _, _, refined_is_slope = _slope.measure_slope_info(mk, ar)
+    with pt.phase("ds_stage"):
+        at_ds = dsfloat.to_ds(a64.T)
+        b_ds = dsfloat.to_ds(b64)
+    with pt.phase("ds_verify"):
+        x_ds = dsfloat.ds_to_f64(_slope.gauss_solve_once_ds(
+            a, at_ds, b_ds, panel, dsfloat.DS_REFINE_STEPS))
+        refined_residual = checks.residual_norm(a64, x_ds, b64)
+    with pt.phase("refined_slope"):
+        mk, ar = _slope.ds_solver_chain(a, at_ds, b_ds, panel,
+                                        dsfloat.DS_REFINE_STEPS)
+        refined_s, _, _, refined_is_slope = _slope.measure_slope_info(mk, ar)
 
+    obs.emit("reported_time", name="gauss_n2048_wallclock",
+             seconds=per_solve)
     print(json.dumps({
+        # Telemetry: the slope run's identity + its phase breakdown, so a
+        # headline swing (the unexplained 49% r3->r4 move) is attributable
+        # from the BENCH record alone — and, with --metrics-out, from the
+        # full JSONL event stream keyed by the same run_id.
+        "run_id": rec.run_id,
+        "phases_s": {k: round(v, 6) for k, v in pt.seconds.items()},
         "metric": "gauss_n2048_wallclock",
         "value": round(per_solve, 6),
         "unit": "s",
@@ -132,14 +158,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import argparse
     import sys
     import traceback
 
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="append the run's telemetry (phase spans, health, "
+                         "run id) as JSONL to PATH")
+    cli = ap.parse_args()
     try:
-        main()
+        main(metrics_out=cli.metrics_out)
     except Exception:
         # Transient tunnel/device failures have been observed; one retry
         # protects the driver's single once-per-round invocation.
         traceback.print_exc(file=sys.stderr)
         print("bench: transient failure, retrying once", file=sys.stderr)
-        main()
+        main(metrics_out=cli.metrics_out)

@@ -17,10 +17,15 @@ de-duplicates that into one algorithmic core with pluggable execution backends:
                           parser, seq/OpenMP/std::thread CPU baseline engines
 - ``gauss_tpu.cli``     — drivers with reference-parity flags and output
 - ``gauss_tpu.verify``  — manufactured-solution / residual / cross-backend checks
+- ``gauss_tpu.obs``     — unified telemetry: run metrics, solver-phase spans,
+                          numerical-health monitors, compile/memory accounting
+                          (the persistent equivalent of the reference's
+                          gettimeofday spans + gprof profiles)
 """
 
 __version__ = "0.1.0"
 
+from gauss_tpu import obs  # noqa: F401
 from gauss_tpu.core.gauss import (  # noqa: F401
     eliminate,
     back_substitute,

@@ -28,6 +28,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from gauss_tpu import obs
 from gauss_tpu.bench import baselines
 from gauss_tpu.cli import _common
 from gauss_tpu.verify import checks
@@ -630,7 +631,10 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                 print(f"bench-grid: running {suite}/{key_label}/{backend} ...",
                       file=sys.stderr, flush=True)
                 try:
-                    cell = run(ctx, key, backend, run_t, span=span)
+                    with obs.span(f"cell:{suite}/{key_label}/{backend}",
+                                  suite=suite, key=key_label,
+                                  backend=backend):
+                        cell = run(ctx, key, backend, run_t, span=span)
                 except Exception as e:  # keep the sweep on backend failure
                     print(f"bench-grid: {suite}/{key_label}/{backend} "
                           f"failed: {e}", file=sys.stderr)
@@ -652,6 +656,10 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                           file=sys.stderr, flush=True)
                 if cell.key != key_label:
                     cell = replace(cell, key=key_label)
+                obs.emit("cell", suite=cell.suite, key=cell.key,
+                         backend=cell.backend, seconds=cell.seconds,
+                         verified=cell.verified, span=cell.span,
+                         note=cell.note)
                 cells.append(cell)
     return cells
 
@@ -739,6 +747,10 @@ def main(argv=None) -> int:
                         "operands device-resident (bench.slope)")
     p.add_argument("--json", dest="json_path", default=None,
                    help="also write cells as a JSON array to this path")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="append the sweep's telemetry (per-cell spans and "
+                        "results, solver health, compile accounting) as "
+                        "JSONL to PATH")
     p.add_argument("--dist-device", choices=("cpu", "default"),
                    default="cpu",
                    help="gauss-dist mesh devices: 'cpu' = the forced "
@@ -783,6 +795,28 @@ def main(argv=None) -> int:
             p.error(f"--thread-sweep must be positive integers, got {bad or args.thread_sweep!r}")
         sweep = [int(x) for x in raw]
     all_cells: List[Cell] = []
+    with obs.run(metrics_out=args.metrics_out, tool="bench_grid") as rec:
+        rc = _run_suites(p, args, suites, backends, sweep, all_cells)
+    if rc is not None:
+        return rc
+    print(format_table(all_cells))
+    if args.metrics_out:
+        print(f"bench-grid: metrics run {rec.run_id} appended to "
+              f"{args.metrics_out}", file=sys.stderr)
+    if args.json_path:
+        # NaN (failed-cell error) is not valid JSON; emit null instead.
+        # Every cell carries the sweep's telemetry run id, so a table row
+        # links back to its full event stream in --metrics-out.
+        payload = [dict(asdict(c), speedup=c.speedup, run_id=rec.run_id,
+                        error=c.error if np.isfinite(c.error) else None)
+                   for c in all_cells]
+        with open(args.json_path, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {len(payload)} cells to {args.json_path}", file=sys.stderr)
+    return 0 if all(c.verified for c in all_cells) else 1
+
+
+def _run_suites(p, args, suites, backends, sweep, all_cells):
     for suite in suites:
         if args.keys:
             raw = [k.strip() for k in args.keys.split(",") if k.strip()]
@@ -812,21 +846,11 @@ def main(argv=None) -> int:
             continue
         all_cells += run_suite(suite, keys, suite_backends, args.threads,
                                span=args.span, thread_sweep=sweep)
-
     if not all_cells:
         print("bench-grid: nothing ran (no valid suite/backend combination)",
               file=sys.stderr)
         return 1
-    print(format_table(all_cells))
-    if args.json_path:
-        # NaN (failed-cell error) is not valid JSON; emit null instead.
-        payload = [dict(asdict(c), speedup=c.speedup,
-                        error=c.error if np.isfinite(c.error) else None)
-                   for c in all_cells]
-        with open(args.json_path, "w") as f:
-            json.dump(payload, f, indent=1)
-        print(f"wrote {len(payload)} cells to {args.json_path}", file=sys.stderr)
-    return 0 if all(c.verified for c in all_cells) else 1
+    return None
 
 
 if __name__ == "__main__":

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from gauss_tpu import obs
 from gauss_tpu.utils.timing import timed_fetch
 
 GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-rowelim-step",
@@ -103,12 +104,13 @@ def _stage(*arrays):
 
     from gauss_tpu.utils.timing import fetch_staged
 
-    staged = [jnp.asarray(a, jnp.float32) for a in arrays]
-    jax.block_until_ready(staged)
-    # block_until_ready can return before tunneled uploads finish; bound
-    # each staged buffer with a scalar fetch so the H2D cannot bill to the
-    # caller's timed span (see timing.fetch_staged).
-    fetch_staged(*staged)
+    with obs.span("host_staging"):
+        staged = [jnp.asarray(a, jnp.float32) for a in arrays]
+        jax.block_until_ready(staged)
+        # block_until_ready can return before tunneled uploads finish; bound
+        # each staged buffer with a scalar fetch so the H2D cannot bill to
+        # the caller's timed span (see timing.fetch_staged).
+        fetch_staged(*staged)
     return staged
 
 
@@ -139,42 +141,68 @@ def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel, refine_tol):
         # jit warmup at shape — BLOCKED on: the TPU executes enqueued
         # programs in order, so an un-fetched warmup would still be running
         # when the timed span below opens and would be billed to it.
-        jax.block_until_ready(
-            dsfloat.solve_once_ds(_stage(eye)[0], dsfloat.to_ds(eye.T),
-                                  dsfloat.to_ds(np.zeros(n)), panel,
-                                  iters=refine_iters))
+        with obs.compile_span("tpu_ds_warmup", n=n):
+            jax.block_until_ready(
+                dsfloat.solve_once_ds(_stage(eye)[0], dsfloat.to_ds(eye.T),
+                                      dsfloat.to_ds(np.zeros(n)), panel,
+                                      iters=refine_iters))
 
         from gauss_tpu.utils.timing import fetch_staged
 
-        a_dev = _stage(a64c)[0]
-        at_ds = jax.block_until_ready(dsfloat.to_ds(a64c.T))
-        b_ds = jax.block_until_ready(dsfloat.to_ds(b64c))
-        # The ds operand pair is ~2.5 GB over a ~21 MB/s tunnel; without
-        # the completion fetches the in-flight upload bills to the timed
-        # span below (measured 86-100 s around a 0.4 s solve).
-        fetch_staged(at_ds, b_ds)
-        elapsed, x = timed_fetch(
-            lambda: dsfloat.ds_to_f64(
-                dsfloat.solve_once_ds(a_dev, at_ds, b_ds, panel,
-                                      iters=refine_iters)[0]),
-            warmup=0, reps=1)
+        with obs.span("host_staging_ds"):
+            a_dev = _stage(a64c)[0]
+            at_ds = jax.block_until_ready(dsfloat.to_ds(a64c.T))
+            b_ds = jax.block_until_ready(dsfloat.to_ds(b64c))
+            # The ds operand pair is ~2.5 GB over a ~21 MB/s tunnel; without
+            # the completion fetches the in-flight upload bills to the timed
+            # span below (measured 86-100 s around a 0.4 s solve).
+            fetch_staged(at_ds, b_ds)
+        holder = {}
+
+        def _solve_ds():
+            x_ds, fac = dsfloat.solve_once_ds(a_dev, at_ds, b_ds, panel,
+                                              iters=refine_iters)
+            holder["fac"] = fac
+            return dsfloat.ds_to_f64(x_ds)
+
+        elapsed, x = timed_fetch(_solve_ds, warmup=0, reps=1)
+        with obs.span("health_monitors"):
+            obs.record_solve_health(a=a64c, x=x, b=b64c,
+                                    factors=holder.get("fac"), n=n,
+                                    backend="tpu[ds]")
         return x, elapsed
 
     # Warm up compile at the target shape through solve_refined itself: the
     # jit cache keys on the call-site kwarg signature, so warming the inner
     # functions directly with a different kwarg set would still recompile
     # (measured: +1.7 s) inside the timed span.
-    blocked.solve_refined(np.eye(n), np.zeros(n), panel=panel,
-                          iters=refine_iters)
+    with obs.compile_span("tpu_blocked_warmup", n=n):
+        blocked.solve_refined(np.eye(n), np.zeros(n), panel=panel,
+                              iters=refine_iters)
 
     a_dev, b_dev = _stage(a64, b64)
+    if obs.active() is not None:
+        # FLOPs/bytes accounting for the factorization the solve runs
+        # (lowering-level estimate — no second backend compile).
+        with obs.span("cost_analysis"):
+            obs.record_cost("lu_factor", blocked.resolve_factor(n, "auto"),
+                            a_dev, panel=panel, allow_compile=False)
     # Return only x from the span: fetching the factors too would time the
-    # D2H of the whole 16 MB factor matrix, not the solve.
-    elapsed, x = timed_fetch(
-        lambda: blocked.solve_refined(a64, b64, panel=panel,
-                                      iters=refine_iters, a_dev=a_dev,
-                                      b_dev=b_dev, tol=refine_tol)[0],
-        warmup=0, reps=1)
+    # D2H of the whole 16 MB factor matrix, not the solve. The factors stay
+    # device-resident in the holder for the health monitors below.
+    holder = {}
+
+    def _solve():
+        x, fac = blocked.solve_refined(a64, b64, panel=panel,
+                                       iters=refine_iters, a_dev=a_dev,
+                                       b_dev=b_dev, tol=refine_tol)
+        holder["fac"] = fac
+        return x
+
+    elapsed, x = timed_fetch(_solve, warmup=0, reps=1)
+    with obs.span("health_monitors"):
+        obs.record_solve_health(a=a64, x=x, b=b64, factors=holder.get("fac"),
+                                n=n, backend="tpu")
     return x, elapsed
 
 
@@ -200,11 +228,13 @@ def _solve_dist_generic(a64, b64, prepare_fn, solve_fn):
     shards, stage the real system OUTSIDE the timed span (like _stage for
     the single-chip engines), then time solve+fetch alone."""
     n = len(b64)
-    warm = prepare_fn(np.eye(n, dtype=np.float32),
-                      np.zeros(n, dtype=np.float32))
-    np.asarray(solve_fn(warm))
+    with obs.compile_span("dist_warmup", n=n):
+        warm = prepare_fn(np.eye(n, dtype=np.float32),
+                          np.zeros(n, dtype=np.float32))
+        np.asarray(solve_fn(warm))
     del warm  # free the warmup shards before staging the real system
-    staged = prepare_fn(a64.astype(np.float32), b64.astype(np.float32))
+    with obs.span("host_staging_dist"):
+        staged = prepare_fn(a64.astype(np.float32), b64.astype(np.float32))
     elapsed, x = timed_fetch(lambda: solve_fn(staged), warmup=0, reps=1)
     return np.asarray(x, np.float64), elapsed
 
@@ -309,22 +339,32 @@ def solve_with_backend(a64: np.ndarray, b64: np.ndarray, backend: str,
     """
     pivoting = resolve_pivoting(pivoting, backend)
     if backend == "tpu":
-        return _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel,
-                                  refine_tol)
-    if backend == "tpu-unblocked":
-        return _solve_tpu_unblocked(a64, b64, pivoting)
-    if backend == "tpu-dist":
-        return _solve_tpu_dist(a64, b64, nthreads)
-    if backend == "tpu-dist2d":
-        return _solve_tpu_dist2d(a64, b64, nthreads)
-    if backend == "tpu-dist-blocked":
-        return _solve_tpu_dist_blocked(a64, b64, nthreads)
-    if backend == "tpu-dist-blocked2d":
-        return _solve_tpu_dist_blocked2d(a64, b64, nthreads)
-    if backend == "tpu-rowelim":
-        return _solve_tpu_rowelim(a64, b64)
-    if backend == "tpu-rowelim-step":
-        return _solve_tpu_rowelim(a64, b64, batched=False)
-    if backend in ("seq", "omp", "threads", "forkjoin", "tiled"):
-        return _solve_native(a64, b64, backend, nthreads)
-    raise ValueError(f"unknown backend {backend!r}; options: {GAUSS_BACKENDS}")
+        x, elapsed = _solve_tpu_blocked(a64, b64, nthreads, refine_iters,
+                                        panel, refine_tol)
+    elif backend == "tpu-unblocked":
+        x, elapsed = _solve_tpu_unblocked(a64, b64, pivoting)
+    elif backend == "tpu-dist":
+        x, elapsed = _solve_tpu_dist(a64, b64, nthreads)
+    elif backend == "tpu-dist2d":
+        x, elapsed = _solve_tpu_dist2d(a64, b64, nthreads)
+    elif backend == "tpu-dist-blocked":
+        x, elapsed = _solve_tpu_dist_blocked(a64, b64, nthreads)
+    elif backend == "tpu-dist-blocked2d":
+        x, elapsed = _solve_tpu_dist_blocked2d(a64, b64, nthreads)
+    elif backend == "tpu-rowelim":
+        x, elapsed = _solve_tpu_rowelim(a64, b64)
+    elif backend == "tpu-rowelim-step":
+        x, elapsed = _solve_tpu_rowelim(a64, b64, batched=False)
+    elif backend in ("seq", "omp", "threads", "forkjoin", "tiled"):
+        x, elapsed = _solve_native(a64, b64, backend, nthreads)
+    else:
+        raise ValueError(
+            f"unknown backend {backend!r}; options: {GAUSS_BACKENDS}")
+    # Telemetry: the solve span (externally measured by each backend's
+    # protocol above) and, for backends whose path did not already record
+    # factor-level monitors, the generic solution-health event.
+    obs.record_span("computeGauss", elapsed, backend=backend)
+    if backend != "tpu" and obs.active() is not None:
+        with obs.span("health_monitors"):
+            obs.record_solve_health(a=a64, x=x, b=b64, backend=backend)
+    return x, elapsed

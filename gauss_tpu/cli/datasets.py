@@ -23,6 +23,8 @@ def main(argv=None) -> int:
     p.add_argument("names", nargs="*", help="registry names (default: all small)")
     p.add_argument("--out", default="matrices_dense", help="output directory")
     p.add_argument("--list", action="store_true", help="list the registry and exit")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="append per-dataset write telemetry as JSONL to PATH")
     args = p.parse_args(argv)
 
     if args.list:
@@ -37,13 +39,22 @@ def main(argv=None) -> int:
         print(f"datasets: unknown names {bad}; use --list", file=sys.stderr)
         return 1
 
+    from gauss_tpu import obs
+
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
-    for name in names:
-        path = out / f"{name}.dat"
-        datasets.write_dataset(name, path)
-        n, nnz = datasets.REGISTRY[name]
-        print(f"wrote {path} (n={n}, nnz={nnz})")
+    with obs.run(metrics_out=args.metrics_out, tool="datasets") as rec:
+        obs.emit("config", tool="datasets", names=",".join(names),
+                 out=str(out))
+        for name in names:
+            path = out / f"{name}.dat"
+            with obs.span("write_dataset", dataset=name):
+                datasets.write_dataset(name, path)
+            n, nnz = datasets.REGISTRY[name]
+            obs.counter("datasets_written")
+            print(f"wrote {path} (n={n}, nnz={nnz})")
+    if args.metrics_out:
+        print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}")
     return 0
 
 

@@ -46,8 +46,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--panel", type=int, default=None,
                    help="panel width for the blocked tpu backend "
                         "(default: auto — VMEM-aware)")
-    p.add_argument("--trace", metavar="DIR", default=None,
+    p.add_argument("--trace", "--trace-dir", dest="trace", metavar="DIR",
+                   default=None,
                    help="capture a jax.profiler device trace into DIR")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="append this run's telemetry (spans, numerical "
+                        "health, compile/memory accounting) as JSONL to "
+                        "PATH; render with `python -m "
+                        "gauss_tpu.obs.summarize PATH`")
     p.add_argument("--debug", action="store_true",
                    help="print parse and pivot diagnostics (the reference's "
                         "compile-time DEBUG define, gauss_external_input.c:17, "
@@ -58,34 +64,41 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
-    from gauss_tpu.utils.env import honor_jax_platforms
+def _run(args) -> int:
+    from gauss_tpu import obs
 
-    honor_jax_platforms()  # an explicit JAX_PLATFORMS beats the image's pin
-    from gauss_tpu.dist import multihost
+    with obs.span("setup_env"):
+        from gauss_tpu.utils.env import honor_jax_platforms
 
-    if multihost.maybe_initialize_from_args(args):
-        print(multihost.process_banner())
+        honor_jax_platforms()  # explicit JAX_PLATFORMS beats the image's pin
+        from gauss_tpu.dist import multihost
+
+        if multihost.maybe_initialize_from_args(args):
+            print(multihost.process_banner())
     try:
-        if args.debug:
-            n_hdr, rows, cols, vals = datfile.read_dat(args.matrixfile)
-            if len(vals):
-                stats = (f"coord range rows [{rows.min()},{rows.max()}] "
-                         f"cols [{cols.min()},{cols.max()}], |value| in "
-                         f"[{abs(vals).min():.3e},{abs(vals).max():.3e}]")
+        with obs.span("parse_dat"):
+            if args.debug:
+                n_hdr, rows, cols, vals = datfile.read_dat(args.matrixfile)
+                if len(vals):
+                    stats = (f"coord range rows [{rows.min()},{rows.max()}] "
+                             f"cols [{cols.min()},{cols.max()}], |value| in "
+                             f"[{abs(vals).min():.3e},{abs(vals).max():.3e}]")
+                else:
+                    stats = "no nonzeros (zero matrix)"
+                print(f"DEBUG: parsed header n={n_hdr}, nnz={len(vals)}, "
+                      f"{stats}")
+                a = datfile.densify(n_hdr, rows, cols, vals)
             else:
-                stats = "no nonzeros (zero matrix)"
-            print(f"DEBUG: parsed header n={n_hdr}, nnz={len(vals)}, {stats}")
-            a = datfile.densify(n_hdr, rows, cols, vals)
-        else:
-            a = datfile.read_dat_dense(args.matrixfile)
+                a = datfile.read_dat_dense(args.matrixfile)
     except (OSError, ValueError) as e:
         print(f"gauss_external: cannot read '{args.matrixfile}': {e}", file=sys.stderr)
         return 1
     n = a.shape[0]
-    x_true = synthetic.manufactured_solution(n)
-    b = synthetic.manufactured_rhs(a, x_true)
+    with obs.span("manufacture_rhs"):
+        x_true = synthetic.manufactured_solution(n)
+        b = synthetic.manufactured_rhs(a, x_true)
+    obs.emit("config", tool="gauss_external", n=n, backend=args.backend,
+             matrixfile=str(args.matrixfile))
 
     print(f"Matrix {args.matrixfile}: {n} x {n}, backend {args.backend}")
 
@@ -127,7 +140,10 @@ def main(argv=None) -> int:
                   f"min |pivot| = {pivots.min():.6e}")
 
     print(f"Time: {elapsed:f} seconds")
-    err = checks.max_rel_error(x, x_true)
+    obs.emit("reported_time", name="Time", seconds=elapsed)
+    with obs.span("verify"):
+        err = checks.max_rel_error(x, x_true)
+    obs.emit("health", backend=args.backend, max_rel_error=err)
     print(f"Error: {err:e}")
     if not np.isfinite(err):
         # Device engines signal a zero pivot through a NaN solution
@@ -141,6 +157,17 @@ def main(argv=None) -> int:
                   "not singularity)", file=sys.stderr)
         return 1
     return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from gauss_tpu import obs
+
+    with obs.run(metrics_out=args.metrics_out, tool="gauss_external") as rec:
+        rc = _run(args)
+    if args.metrics_out:
+        print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -69,28 +69,43 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--panel", type=int, default=None,
                    help="panel width for the blocked tpu backend "
                         "(default: auto — VMEM-aware)")
-    p.add_argument("--trace", metavar="DIR", default=None,
+    p.add_argument("--trace", "--trace-dir", dest="trace", metavar="DIR",
+                   default=None,
                    help="capture a jax.profiler device trace into DIR "
                         "(the gprof analog; view in TensorBoard/Perfetto)")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="append this run's telemetry (spans, numerical "
+                        "health, compile/memory accounting) as JSONL to "
+                        "PATH; render with `python -m "
+                        "gauss_tpu.obs.summarize PATH`")
     p.add_argument("--profile", action="store_true",
                    help="print a gprof-style per-phase wall-clock table")
+    p.add_argument("--phase-profile", action="store_true",
+                   help="tpu backend only: additionally run the "
+                        "phase-instrumented blocked factorization (panel "
+                        "factor / pivot apply / trailing update spans, one "
+                        "device dispatch per phase) and print its table")
     from gauss_tpu.dist.multihost import add_multihost_args
 
     add_multihost_args(p)
     return p
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
-    from gauss_tpu.utils.env import honor_jax_platforms
+def _run(args) -> int:
+    from gauss_tpu import obs
 
-    honor_jax_platforms()  # an explicit JAX_PLATFORMS beats the image's pin
-    from gauss_tpu.dist import multihost
+    with obs.span("setup_env"):
+        from gauss_tpu.utils.env import honor_jax_platforms
 
-    if multihost.maybe_initialize_from_args(args):
-        print(multihost.process_banner())
+        honor_jax_platforms()  # explicit JAX_PLATFORMS beats the image's pin
+        from gauss_tpu.dist import multihost
+
+        if multihost.maybe_initialize_from_args(args):
+            print(multihost.process_banner())
     n = positive_int_or_default(args.s, DEFAULT_N, "matrix size")
     t = positive_int_or_default(args.t, DEFAULT_THREADS, "thread count")
+    obs.emit("config", tool="gauss_internal", n=n, threads=t,
+             backend=args.backend)
 
     print(f"Computing Gaussian elimination: size {n} x {n}, "
           f"backend {args.backend}, threads/shards {t}")
@@ -122,25 +137,58 @@ def main(argv=None) -> int:
     # solve_with_backend's span excludes the JIT warmup; attribute the rest
     # of the wrapper time to compilation so the profile matches the printed
     # Application time instead of blaming compile time on the compute phase.
+    # (computeGauss and the warmup are already recorded as obs spans inside
+    # solve_with_backend, so neither is re-emitted here.)
     pt.seconds["computeGauss"] = solve_elapsed
     pt.seconds["jit compile+warmup"] = max(
         0.0, time.perf_counter() - t0 - solve_elapsed)
 
     print(f"Application time: {init_elapsed + solve_elapsed:f} Secs")
+    obs.emit("reported_time", name="Application time",
+             seconds=init_elapsed + solve_elapsed)
     if args.profile:
         print(pt.report())
+    if args.phase_profile and args.backend == "tpu":
+        # The solver-phase profile: re-factor with one device dispatch per
+        # phase (diagnostic path — core.blocked.lu_factor_blocked_phased),
+        # spans recorded on the run and the table printed like --profile.
+        import jax.numpy as jnp
+
+        from gauss_tpu.core import blocked
+
+        with obs.span("phase_profile"):
+            ppt = profiling.PhaseTimer()
+            blocked.lu_factor_blocked_phased(
+                jnp.asarray(a, jnp.float32), panel=args.panel, timer=ppt)
+        print("Solver phase profile (instrumented re-factorization):")
+        print(ppt.report())
+    elif args.phase_profile:
+        print(f"Note: --phase-profile applies to the tpu backend only "
+              f"(got '{args.backend}')", file=sys.stderr)
     if args.trace:
         print(f"Device trace written to {args.trace}")
 
     if args.verify:
-        ok = checks.internal_pattern_ok(x, atol=1e-4)
-        res = checks.residual_norm(a, x, b)
+        with obs.span("verify"):
+            ok = checks.internal_pattern_ok(x, atol=1e-4)
+            res = checks.residual_norm(a, x, b)
         print(f"Verification: solution pattern (-0.5, 0...0, 0.5) "
               f"{'OK' if ok else 'FAILED'}")
         print(f"Residual ||Ax-b||: {res:e}")
         if not ok or not np.isfinite(res):
             return 1
     return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from gauss_tpu import obs
+
+    with obs.run(metrics_out=args.metrics_out, tool="gauss_internal") as rec:
+        rc = _run(args)
+    if args.metrics_out:
+        print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}")
+    return rc
 
 
 if __name__ == "__main__":

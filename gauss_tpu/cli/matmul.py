@@ -67,10 +67,20 @@ def _tpu_engine_fn(engine: str, precision: str = None):
 def _run_tpu(a, b, engine: str, precision: str = None):
     import jax.numpy as jnp
 
+    from gauss_tpu import obs
+
     mm = _tpu_engine_fn(engine, precision)
     from gauss_tpu.utils.timing import timed_fetch
 
-    np.asarray(mm(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)))  # compile
+    with obs.compile_span(f"matmul_warmup:{engine}", n=a.shape[0]):
+        np.asarray(mm(jnp.asarray(a, jnp.float32),
+                      jnp.asarray(b, jnp.float32)))  # compile
+    if obs.active() is not None:
+        with obs.span("cost_analysis"):
+            obs.record_cost(f"matmul:{engine}", mm,
+                            jnp.asarray(a, jnp.float32),
+                            jnp.asarray(b, jnp.float32),
+                            allow_compile=False)
     elapsed, c = timed_fetch(
         lambda: mm(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)),
         warmup=0, reps=1)
@@ -104,6 +114,12 @@ def main(argv=None) -> int:
                    help="MXU precision for device engines (default 'high' "
                         "bf16x3 everywhere; the Pallas kernels implement it "
                         "in-kernel by manual operand splitting)")
+    p.add_argument("--trace", "--trace-dir", dest="trace", metavar="DIR",
+                   default=None,
+                   help="capture a jax.profiler device trace into DIR")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="append this run's telemetry as JSONL to PATH; "
+                        "render with `python -m gauss_tpu.obs.summarize`")
     args = p.parse_args(argv)
     n = args.nsize
     if n <= 0:
@@ -116,25 +132,43 @@ def main(argv=None) -> int:
               f"options: {_common.MATMUL_BACKENDS}", file=sys.stderr)
         return 1
 
-    a, b = _inputs(n)
-    truth = a @ b  # float64 host truth for the epsilon comparator
-    scale = float(np.abs(truth).max())
-    labels = {"tpu": "TPU", "tpu-pallas": "TPU-Pallas",
-              "tpu-pallas-v1": "TPU-Pallas-V1",
-              "tpu-dist": "TPU-Dist (sharded)",
-              "seq": "Sequential", "omp": "OpenMP"}
+    from gauss_tpu import obs
+    from gauss_tpu.utils import profiling
 
-    failed = False
-    for engine in engines:
-        if engine.startswith("tpu"):
-            c, elapsed = _run_tpu(a, b, engine, args.precision)
-        else:
-            c, elapsed = _run_native(a, b, engine, args.threads)
-        ok = checks.elementwise_match(c, truth, epsilon=checks.EPSILON * scale)
-        gflops = 2.0 * n ** 3 / elapsed / 1e9
-        print(f"{labels[engine]} time: {elapsed:f} seconds "
-              f"({gflops:.1f} GFLOP/s) verify: {'OK' if ok else 'MISMATCH'}")
-        failed |= not ok
+    with obs.run(metrics_out=args.metrics_out, tool="matmul") as rec:
+        obs.emit("config", tool="matmul", n=n, engines=",".join(engines))
+        with obs.span("prepare_inputs"):
+            a, b = _inputs(n)
+            truth = a @ b  # float64 host truth for the epsilon comparator
+            scale = float(np.abs(truth).max())
+        labels = {"tpu": "TPU", "tpu-pallas": "TPU-Pallas",
+                  "tpu-pallas-v1": "TPU-Pallas-V1",
+                  "tpu-dist": "TPU-Dist (sharded)",
+                  "seq": "Sequential", "omp": "OpenMP"}
+
+        failed = False
+        with profiling.trace(args.trace):
+            for engine in engines:
+                if engine.startswith("tpu"):
+                    c, elapsed = _run_tpu(a, b, engine, args.precision)
+                else:
+                    c, elapsed = _run_native(a, b, engine, args.threads)
+                with obs.span("verify"):
+                    ok = checks.elementwise_match(
+                        c, truth, epsilon=checks.EPSILON * scale)
+                    diff = float(np.max(np.abs(c - truth))) / scale
+                obs.record_span(f"matmul:{engine}", elapsed, backend=engine)
+                obs.emit("reported_time", name=f"{labels[engine]} time",
+                         seconds=elapsed)
+                obs.emit("health", backend=engine, max_rel_diff=diff,
+                         verified=ok)
+                gflops = 2.0 * n ** 3 / elapsed / 1e9
+                print(f"{labels[engine]} time: {elapsed:f} seconds "
+                      f"({gflops:.1f} GFLOP/s) "
+                      f"verify: {'OK' if ok else 'MISMATCH'}")
+                failed |= not ok
+    if args.metrics_out:
+        print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}")
     return 1 if failed else 0
 
 

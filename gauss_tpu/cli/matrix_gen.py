@@ -21,24 +21,38 @@ def main(argv=None) -> int:
     p.add_argument("n", type=int, help="matrix dimension")
     p.add_argument("--python", action="store_true",
                    help="force the Python writer (skip the native tool)")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="append generation telemetry as JSONL to PATH")
     args = p.parse_args(argv)
     if args.n <= 0:
         print("matrix_gen: n must be positive", file=sys.stderr)
         return 1
 
-    if not args.python:
-        try:
-            from gauss_tpu import native
+    from gauss_tpu import obs
 
-            rc = subprocess.run([native.matrix_gen_path(), str(args.n)],
-                                stdout=sys.stdout)
-            return rc.returncode
-        except Exception:
-            pass  # fall back to Python below
+    with obs.run(metrics_out=args.metrics_out, tool="matrix_gen") as rec:
+        obs.emit("config", tool="matrix_gen", n=args.n)
+        rc = None
+        if not args.python:
+            try:
+                from gauss_tpu import native
 
-    # Values are small integers; the .17g format prints them exactly.
-    datfile.write_dat(sys.stdout, synthetic.generator_matrix(args.n))
-    return 0
+                with obs.span("generate_native"):
+                    rc = subprocess.run(
+                        [native.matrix_gen_path(), str(args.n)],
+                        stdout=sys.stdout).returncode
+            except Exception:
+                rc = None  # fall back to Python below
+        if rc is None:
+            # Values are small integers; .17g prints them exactly.
+            with obs.span("generate_python"):
+                datfile.write_dat(sys.stdout,
+                                  synthetic.generator_matrix(args.n))
+            rc = 0
+    if args.metrics_out:
+        print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ so one factorization serves many right-hand sides and iterative refinement.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import NamedTuple
 
@@ -84,8 +85,20 @@ def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
     """Whether the Pallas panel kernel's VMEM working set fits the scoped
     limit: npad * (panel * itemsize + per-width row overhead)."""
     npad = -(-n // panel) * panel
-    overhead = PANEL_VMEM_ROW_OVERHEAD.get(panel, 220)
-    return npad * (panel * itemsize + overhead) <= PANEL_VMEM_BUDGET
+    # Unmeasured widths at or above the narrowest rung keep the widest
+    # measured overhead; BELOW it the per-row overhead grows ~1/panel
+    # (round-4 data), so narrow widths extrapolate conservatively instead of
+    # false-approving a launch that dies with a raw Mosaic error (ADVICE r5).
+    overhead = PANEL_VMEM_ROW_OVERHEAD.get(
+        panel, 220 if panel >= 64 else max(220, 55_000 // panel))
+    est = npad * (panel * itemsize + overhead)
+    fits = est <= PANEL_VMEM_BUDGET
+    from gauss_tpu.obs import compile as _obs_compile
+
+    _obs_compile.record_vmem_estimate(
+        "panel_kernel", n=n, panel=panel, itemsize=itemsize, bytes=est,
+        budget=PANEL_VMEM_BUDGET, fits=fits)
+    return fits
 
 
 def auto_panel(n: int, itemsize: int = 4) -> int:
@@ -280,6 +293,49 @@ def _panel_factor_jax(p: jax.Array, kb, zero_pivot_safe: bool = False):
     return lax.fori_loop(0, panel, step, (p, ipiv0, minpiv0))
 
 
+def _looks_like_scoped_vmem_error(e: BaseException) -> bool:
+    """Mosaic scoped-VMEM compile failures, as they surface through jit:
+    'Ran out of memory in memory space vmem' / 'exceeds available scoped
+    vmem' wrapped in XlaRuntimeError or Mosaic's own exception text."""
+    msg = str(e).lower()
+    return "vmem" in msg and ("ran out of memory" in msg or "scoped" in msg
+                              or "exceed" in msg)
+
+
+def _reraise_scoped_vmem(fn):
+    """Hold the explicit-pallas clear-error contract (ADVICE r3) where the
+    VMEM probe table is incomplete (ADVICE r5): the guards in
+    :func:`_resolve_panel_impl` and the chunked group loop encode a
+    whole-program-context-dependent Mosaic fusion decision from a finite set
+    of compile probes, so an explicit ``panel_impl='pallas'`` outside the
+    auto envelope can still reach the Mosaic compiler and die there. This
+    wrapper catches that raw failure at the entry point and re-raises it as
+    the documented sizing ValueError (original error chained). Auto-mode
+    routes never request the kernel past the table, so only explicit
+    requests pay the except path."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except ValueError:
+            raise
+        except Exception as e:
+            if (kwargs.get("panel_impl") == "pallas"
+                    and _looks_like_scoped_vmem_error(e)):
+                raise ValueError(
+                    "panel_impl='pallas': Mosaic ran out of scoped VMEM "
+                    "compiling the panel kernel — this (h, panel, group "
+                    "width) context is outside the measured probe table "
+                    "(PANEL_VMEM_ROW_OVERHEAD / PANEL64_MIN_SLICE_W). Use "
+                    "panel_impl='auto' (stock-JAX panel for these groups), "
+                    "a narrower panel, or a different chunk") from e
+            raise
+    # The AOT surface of the wrapped jit function stays reachable for
+    # cost accounting (obs.compile) and tests.
+    wrapper.lower = getattr(fn, "lower", None)
+    return wrapper
+
+
 def _resolve_panel_impl(panel_impl, n: int | None = None,
                         panel: int | None = None, itemsize: int = 4):
     if panel_impl == "auto":
@@ -374,6 +430,7 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype,
     return sub, linv_k, uinv_k
 
 
+@_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision",
                                    "swap_impl"))
 def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
@@ -460,6 +517,7 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
                      linv=linvs, uinv=uinvs)
 
 
+@_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision"))
 def lu_factor_blocked_unrolled(a: jax.Array,
                                panel: int | None = DEFAULT_PANEL,
@@ -662,6 +720,7 @@ def lu_solve(factors: BlockedLU, b: jax.Array,
     return x[:, 0] if was_vector else x
 
 
+@_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "chunk", "panel_impl",
                                    "gemm_precision"))
 def lu_factor_blocked_chunked(a: jax.Array,
@@ -872,6 +931,74 @@ def lu_factor_blocked_chunked(a: jax.Array,
                      uinv=jnp.concatenate(uinvs_all))
 
 
+def lu_factor_blocked_phased(a: jax.Array, panel: int | None = None,
+                             panel_impl: str = "auto",
+                             gemm_precision: str = "highest",
+                             timer=None) -> BlockedLU:
+    """Blocked LU with per-phase telemetry spans — the solver-phase profile.
+
+    Same math, helpers, and factor layout as :func:`lu_factor_blocked`, but
+    the panel loop runs at HOST level with a device-completion-bounded span
+    around each phase (``panel_factor`` / ``pivot_apply`` /
+    ``trailing_update``), reported through the PhaseTimer -> obs bridge —
+    the TPU equivalent of the reference's per-phase ``gettimeofday``
+    instrumentation, at the granularity its gprof profile resolved
+    (computeGauss vs subtractElim). One dispatch per phase instead of one
+    fused program: this is the diagnostic path (use the jitted
+    factorizations for production numbers); the phase RATIOS are the
+    payload — e.g. a trailing_update share far off ~O(n/panel) x the
+    panel_factor share flags a mis-tiled GEMM.
+
+    ``timer``: an optional :class:`gauss_tpu.utils.profiling.PhaseTimer` to
+    accumulate into — pass your own to read the table afterwards (a private
+    one is used otherwise). Spans land on the active obs recorder either
+    way, via the PhaseTimer bridge.
+    """
+    from gauss_tpu.kernels.matmul_pallas import resolve_precision
+    from gauss_tpu.utils.profiling import PhaseTimer
+
+    gemm_prec = resolve_precision(gemm_precision)
+    pt = PhaseTimer() if timer is None else timer
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = _resolve_panel(n, panel, itemsize)
+    panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
+    with pt.phase("pad_stage"):
+        m = jax.block_until_ready(_pad_to_panel(a, panel))
+    npad = m.shape[0]
+    nb = npad // panel
+    dtype = m.dtype
+    perm = jnp.arange(npad)
+    min_piv = jnp.asarray(jnp.inf, dtype)
+    linvs, uinvs = [], []
+
+    for k in range(nb):
+        kb = k * panel
+        with pt.phase("panel_factor"):
+            p, ipiv, perm_local, mp = _factor_panel(m, kb, npad, panel,
+                                                    panel_impl)
+            jax.block_until_ready(p)
+        min_piv = jnp.minimum(min_piv, mp)
+        with pt.phase("pivot_apply"):
+            if perm_local is None:
+                perm_local = _fold_transpositions(ipiv, kb, npad, panel)
+            m = m[perm_local]
+            perm = perm[perm_local]
+            jax.block_until_ready(m)
+        with pt.phase("trailing_update"):
+            m, linv_k, uinv_k = _install_and_update(m, kb, npad, panel, p,
+                                                   gemm_prec, dtype)
+            jax.block_until_ready(m)
+        linvs.append(linv_k)
+        uinvs.append(uinv_k)
+
+    return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
+                     linv=jnp.stack(linvs), uinv=jnp.stack(uinvs))
+
+
 UNROLL_MAX_N = 4096  # above this, full unroll costs too much compile payload
 # Above this many trace-time GROUPS the chunked form's compile payload
 # overwhelms the tunneled compiler (observed r2: 96 groups at n=24576,
@@ -943,6 +1070,7 @@ def resolve_factor(n: int, unroll):
     return lu_factor_blocked_unrolled if unroll else lu_factor_blocked
 
 
+@_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "unroll",
                                    "gemm_precision"))
 def gauss_solve_blocked(a: jax.Array, b: jax.Array,
@@ -1030,7 +1158,14 @@ def fits_single_chip(n: int, itemsize: int = 4,
     inverses are nb * panel^2, negligible beside them.
     """
     budget = device_memory_budget() if budget is None else budget
-    return 3 * n * n * itemsize <= budget
+    est = 3 * n * n * itemsize
+    fits = est <= budget
+    from gauss_tpu.obs import compile as _obs_compile
+
+    _obs_compile.record_vmem_estimate("single_chip_hbm", n=n,
+                                      itemsize=itemsize, bytes=est,
+                                      budget=budget, fits=fits)
+    return fits
 
 
 def solve_handoff(a, b, budget: int | None = None, mesh=None,

@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gauss_tpu.dist.mesh import ROWS_AXIS, make_mesh
+from gauss_tpu.utils import compat
 
 
 def _cyclic_perm(npad: int, nshards: int) -> np.ndarray:
@@ -121,7 +122,7 @@ def _build_solver(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
         x = lax.fori_loop(0, npad, back_step, jnp.zeros((npad,), dtype))
         return x
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(axis, None), P(axis)),
         out_specs=P(None))

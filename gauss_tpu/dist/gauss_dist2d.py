@@ -39,12 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
-
-from jax.sharding import NamedSharding
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gauss_tpu.dist.gauss_dist import _cyclic_perm, _host_dtype
 from gauss_tpu.dist.mesh import make_mesh_2d_auto
+from gauss_tpu.utils import compat
 
 
 @lru_cache(maxsize=32)
@@ -63,7 +62,7 @@ def _build_solver_2d(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
         zero = jnp.zeros((), dtype)
         # b arrives replicated over cols; the loop body makes it vary there
         # (it mixes in col-psum'd terms), so widen its varying set up front.
-        b_loc = lax.pcast(b_loc, (cax,), to="varying")
+        b_loc = compat.pcast_varying(b_loc, (cax,))
 
         def elim_step(i, carry):
             A, rhs = carry
@@ -133,11 +132,11 @@ def _build_solver_2d(mesh: jax.sharding.Mesh, npad: int, dtype_name: str):
 
         # xi is row-invariant (it ends in a psum over rows), so x stays
         # varying over cols only — matching the P(cols) out_spec.
-        x0 = lax.pcast(jnp.zeros((mc,), dtype), (cax,), to="varying")
+        x0 = compat.pcast_varying(jnp.zeros((mc,), dtype), (cax,))
         x_loc = lax.fori_loop(0, npad, back_step, x0)
         return x_loc
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(rax, cax), P(rax)),
         out_specs=P(cax))

@@ -53,6 +53,7 @@ from gauss_tpu.core.blocked import (_fold_transpositions, _panel_factor_jax,
                                     unit_lower_inv)
 from gauss_tpu.dist.gauss_dist import _host_dtype
 from gauss_tpu.dist.mesh import make_mesh
+from gauss_tpu.utils import compat
 
 DEFAULT_PANEL_DIST = 128
 
@@ -203,7 +204,7 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
         # the replication provable for out_specs.
         return (x, A, lax.pmin(gperm, axis), lax.pmin(min_piv, axis))
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(axis, None),),
         out_specs=(P(None), P(axis, None), P(None), P()))
@@ -280,7 +281,7 @@ def _build_resolver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
             a_loc, lambda rows, kb: lax.dynamic_slice(y, (kb,), (panel,)),
             axis, d, npad, panel, nshards, lower=False)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(axis, None), P(None), P(None)),
         out_specs=P(None))
@@ -314,17 +315,25 @@ def prepare_dist_blocked(a, b, mesh: jax.sharding.Mesh,
     """Stage a system; returns an opaque handle for
     :func:`solve_dist_blocked_staged` (staging/solve split as in gauss_dist).
     panel=None resolves through :func:`auto_panel_dist`."""
+    from gauss_tpu import obs
+
     n = np.shape(a)[0]
     if panel is None:
         panel = auto_panel_dist(n, mesh.devices.shape[0])
-    a_c, npad = _prepare_blocked(a, b, mesh, panel)
+    with obs.span("dist_host_staging", n=n, panel=panel,
+                  shards=int(mesh.devices.size)):
+        a_c, npad = _prepare_blocked(a, b, mesh, panel)
+        jax.block_until_ready(a_c)
     return (a_c, n, npad, panel)
 
 
 def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
+    from gauss_tpu import obs
+
     a_c, n, npad, panel = staged
     solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
-    x, *_ = solver(a_c)
+    with obs.span("dist_factor_solve", n=n, panel=panel):
+        x, *_ = jax.block_until_ready(solver(a_c))
     return x[:n]
 
 
@@ -370,13 +379,17 @@ def host_refine(a64, b64, x0, lu_solve_fn, iters: int,
     refactorization). Same tol contract as core.blocked.solve_refined:
     stop once ||Ax - b||_2 <= tol * min(1, ||b||_2); tol=0 runs exactly
     ``iters``."""
+    from gauss_tpu import obs
+
     x = np.asarray(x0, np.float64)
     tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
     for _ in range(iters):
-        r = b64 - a64 @ x
+        with obs.span("refine_residual"):
+            r = b64 - a64 @ x
         if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
             break
-        x = x + np.asarray(lu_solve_fn(r), np.float64)
+        with obs.span("refine_correction"):
+            x = x + np.asarray(lu_solve_fn(r), np.float64)
     return x
 
 

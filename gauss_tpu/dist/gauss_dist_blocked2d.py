@@ -61,6 +61,7 @@ from gauss_tpu.dist.gauss_dist_blocked import (DEFAULT_PANEL_DIST,
                                                _block_cyclic_perm,
                                                auto_panel_dist)
 from gauss_tpu.dist.mesh import make_mesh_2d_auto
+from gauss_tpu.utils import compat
 
 
 def auto_panel_dist2d(n: int, R: int, C: int,
@@ -268,7 +269,7 @@ def _build_factor_2d(mesh: jax.sharding.Mesh, npad: int, panel: int,
         pm = lambda t: lax.pmin(lax.pmin(t, rax), cax)  # noqa: E731
         return A, pm(gperm), pm(linvs), pm(uinvs), pm(min_piv)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(rax, cax),),
         out_specs=(P(rax, cax), P(None), P(None), P(None), P()))
@@ -322,7 +323,7 @@ def _build_solver_2d(mesh: jax.sharding.Mesh, npad: int, panel: int,
             jnp.arange(nblocks - 1, -1, -1))
         return lax.pmin(lax.pmin(x, rax), cax)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(rax, cax), P(None), P(None), P(None), P(None)),
         out_specs=P(None))

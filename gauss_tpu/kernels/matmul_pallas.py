@@ -102,12 +102,19 @@ def _mm_blocks(bm: int, bn: int, bk: int, itemsize: int, acc_itemsize: int,
         return ((2 * (bm * bk + bk * bn) + 2 * bm * bn) * itemsize
                 + bm * bn * acc_itemsize)
 
+    req = (bm, bn, bk)
     while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bk > 128 and not frozen[2]:
         bk //= 2
     while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bn > 128 and not frozen[1]:
         bn //= 2
     while vmem(bm, bn, bk) > MM_VMEM_BUDGET and bm > 8 and not frozen[0]:
         bm //= 2
+    from gauss_tpu.obs import compile as _obs_compile
+
+    _obs_compile.record_vmem_estimate(
+        "matmul_pallas_tiles", bm=bm, bn=bn, bk=bk, requested_bm=req[0],
+        requested_bn=req[1], requested_bk=req[2], bytes=vmem(bm, bn, bk),
+        budget=MM_VMEM_BUDGET, clamped=(bm, bn, bk) != req)
     return bm, bn, bk
 
 
@@ -211,6 +218,12 @@ def _stripe_blocks(m: int, k: int, n: int, bm: int, bk: int,
         raise ValueError(
             f"stripe kernel cannot hold an n={n} output stripe in VMEM even "
             f"at minimum blocks; use matmul_pallas (the tiled V2 analog)")
+    from gauss_tpu.obs import compile as _obs_compile
+
+    _obs_compile.record_vmem_estimate(
+        "matmul_pallas_stripe", bm=bm_, bk=bk_, n=n,
+        bytes=_stripe_vmem_bytes(bm_, bk_, npad, itemsize),
+        budget=STRIPE_VMEM_BUDGET, clamped=(bm_, bk_) != (bm, bk))
     return bm_, bk_
 
 

@@ -8,7 +8,7 @@
 //   seq     — sequential partial-pivot elimination (the reference's baseline,
 //             upgraded from swap-on-zero to partial pivoting per SURVEY.md §7c)
 //   omp     — OpenMP `parallel for` over elimination rows (reference C4)
-//   threads — persistent std::thread workers, cyclic row striping, std::barrier
+//   threads — persistent std::thread workers, cyclic row striping, barrier
 //             synchronization: the modern-C++ re-expression of reference C3's
 //             persistent pthreads + hand-rolled condvar barrier (and of C1's
 //             cyclic striping); threads are spawned once, not n*T times
@@ -18,11 +18,18 @@
 // into every translation unit. Return codes: 0 ok, -1 singular, -2 bad args.
 
 #include <atomic>
+#if defined(__has_include)
+#if __has_include(<barrier>)
 #include <barrier>
+#define GT_HAVE_STD_BARRIER 1
+#endif
+#endif
 #include <cmath>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <thread>
 #ifdef __linux__
 #include <pthread.h>
@@ -35,6 +42,36 @@
 #endif
 
 namespace {
+
+#ifdef GT_HAVE_STD_BARRIER
+using Barrier = std::barrier<>;
+#else
+// libstdc++ < 11 ships C++20 without <barrier>; this condvar barrier has the
+// same arrive_and_wait contract (and is exactly the hand-rolled barrier the
+// reference C3 uses, Pthreads/Version-3/gauss_internal_input.c).
+class Barrier {
+ public:
+  explicit Barrier(long count) : threshold_(count), count_(count) {}
+  void arrive_and_wait() {
+    std::unique_lock<std::mutex> lk(m_);
+    const unsigned long gen = generation_;
+    if (--count_ == 0) {
+      ++generation_;
+      count_ = threshold_;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] { return generation_ != gen; });
+    }
+  }
+
+ private:
+  std::mutex m_;
+  std::condition_variable cv_;
+  const long threshold_;
+  long count_;
+  unsigned long generation_ = 0;
+};
+#endif
 
 // Select the partial pivot for column i, swap rows of A and b, scale the
 // pivot row to unit diagonal. Returns false if the column is exactly singular.
@@ -147,7 +184,7 @@ int gt_gauss_solve_tiled(double* A, double* b, double* x, long n, int nthreads) 
   constexpr long kBlock = 64;
 
   std::atomic<bool> singular{false};
-  std::barrier sync(nthreads);
+  Barrier sync(nthreads);
 
   auto worker = [&](int tid) {
     for (long i = 0; i < n; ++i) {
@@ -215,7 +252,7 @@ int gt_gauss_solve_threads(double* A, double* b, double* x, long n, int nthreads
   if (nthreads == 1) return gt_gauss_solve_seq(A, b, x, n);
 
   std::atomic<bool> singular{false};
-  std::barrier sync(nthreads);
+  Barrier sync(nthreads);
 
   auto worker = [&](int tid) {
     for (long i = 0; i < n; ++i) {

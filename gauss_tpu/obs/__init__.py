@@ -1,0 +1,47 @@
+"""gauss_tpu.obs — unified telemetry: metrics, spans, health, accounting.
+
+One per-run event stream that every layer reports into (the persistent
+equivalent of the reference's gettimeofday spans + gprof flat profiles,
+SURVEY §5), flushed as JSONL via ``--metrics-out`` and rendered offline by
+``python -m gauss_tpu.obs.summarize``.
+
+Quick tour::
+
+    from gauss_tpu import obs
+
+    with obs.run(metrics_out="run.jsonl", tool="my_sweep") as rec:
+        with obs.span("factor"):
+            fac = lu_factor_blocked(a)
+        obs.record_solve_health(a=a, x=x, b=b, factors=fac, n=n)
+        obs.gauge("panel_width", 128)
+    # run.jsonl now holds the run; `python -m gauss_tpu.obs.summarize
+    # run.jsonl` renders the flat profile + health report.
+
+Every hook is a no-op without an active recorder, so instrumentation lives
+permanently in the library's host-side setup paths at zero cost on
+unobserved runs. Nothing here imports jax at module load; device-touching
+helpers (health reductions, cost analysis) import it lazily.
+"""
+
+from gauss_tpu.obs.compile import (  # noqa: F401
+    compile_span,
+    cost_summary,
+    record_cost,
+    record_vmem_estimate,
+)
+from gauss_tpu.obs.health import record_solve_health  # noqa: F401
+from gauss_tpu.obs.registry import Recorder, new_run_id, read_events  # noqa: F401
+from gauss_tpu.obs.spans import (  # noqa: F401
+    active,
+    counter,
+    emit,
+    gauge,
+    histogram,
+    record_span,
+    run,
+    span,
+)
+
+# NOTE: gauss_tpu.obs.summarize is deliberately NOT imported here — it is a
+# `python -m` entry point, and importing it from the package __init__ would
+# trip runpy's double-import warning.

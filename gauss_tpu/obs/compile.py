@@ -1,0 +1,140 @@
+"""Compile and memory accounting for jit/pallas entry points.
+
+The repo's recurring blind spots are compile-side (ROADMAP history: the
+49% r3->r4 headline swing, tunneled remote-compiles that never finished,
+VMEM probe-table surprises). These helpers make that side data:
+
+- :func:`compile_span` — wall-clock of a warmup/compile region as a
+  ``compile`` event (+ span), so JIT cost is attributed instead of leaking
+  into whatever phase runs next;
+- :func:`record_cost` — XLA's own ``cost_analysis()`` FLOPs/bytes and
+  ``memory_analysis()`` sizes for a jitted callable at concrete operands,
+  via the AOT API (lowering only when the backend compile is unavailable);
+- :func:`record_vmem_estimate` — the analytic VMEM/HBM working-set numbers
+  the kernel-sizing code already computes internally (core.blocked,
+  kernels.matmul_pallas), recorded at resolution time so probe-table gaps
+  are visible data instead of only compile crashes.
+
+Everything no-ops without an active recorder and never raises: accounting
+must not take down a solve.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+from gauss_tpu.obs import spans as _spans
+
+
+@contextlib.contextmanager
+def compile_span(label: str, **attrs):
+    """Record a compile/warmup region: emits both a span (so the flat
+    profile accounts the time) and a ``compile`` event keyed by label."""
+    rec = _spans.active()
+    if rec is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    with _spans.span(f"compile:{label}", **attrs):
+        yield
+    _spans.emit("compile", label=label,
+                compile_wall_s=round(time.perf_counter() - t0, 6), **attrs)
+
+
+def _first_dict(cost) -> Dict[str, Any]:
+    """``cost_analysis()`` returns a dict on new jax, a list of per-module
+    dicts on older releases."""
+    if isinstance(cost, dict):
+        return cost
+    if isinstance(cost, (list, tuple)) and cost and isinstance(cost[0], dict):
+        return cost[0]
+    return {}
+
+
+def _lowerable(fn, args, kwargs):
+    """Resolve decorator/partial wrappings to a jit object with ``.lower``
+    (folding a functools.partial's bound arguments back into the call)."""
+    import functools
+
+    while True:
+        if isinstance(fn, functools.partial):
+            args = fn.args + args
+            kwargs = {**fn.keywords, **kwargs}
+            fn = fn.func
+            continue
+        lower = getattr(fn, "lower", None)
+        if callable(lower):
+            return fn, args, kwargs
+        wrapped = getattr(fn, "__wrapped__", None)
+        if wrapped is None:
+            return None, args, kwargs
+        fn = wrapped
+
+
+def cost_summary(jitted_fn, *args, allow_compile: bool = True,
+                 **kwargs) -> Optional[Dict[str, Any]]:
+    """FLOPs/bytes/memory estimates for ``jitted_fn(*args, **kwargs)``.
+
+    With ``allow_compile`` the AOT path compiles for the full
+    ``cost_analysis`` + ``memory_analysis`` numbers — only do that where a
+    (re)compile is affordable; ``allow_compile=False`` stops at the
+    lowering-level HLO estimate, which costs one trace. Never raises."""
+    fn, args, kwargs = _lowerable(jitted_fn, args, kwargs)
+    if fn is None:
+        return None
+    try:
+        lowered = fn.lower(*args, **kwargs)
+    except Exception:
+        return None
+    out: Dict[str, Any] = {}
+    if allow_compile:
+        try:
+            compiled = lowered.compile()
+            cost = _first_dict(compiled.cost_analysis())
+            out["flops"] = cost.get("flops")
+            out["bytes_accessed"] = cost.get("bytes accessed")
+            try:
+                mem = compiled.memory_analysis()
+                for attr in ("argument_size_in_bytes",
+                             "output_size_in_bytes", "temp_size_in_bytes",
+                             "generated_code_size_in_bytes"):
+                    val = getattr(mem, attr, None)
+                    if val is not None:
+                        out[attr] = int(val)
+            except Exception:
+                pass
+        except Exception:
+            pass
+    if "flops" not in out or out.get("flops") is None:
+        try:
+            cost = _first_dict(lowered.cost_analysis())
+            out["flops"] = cost.get("flops")
+            out.setdefault("bytes_accessed", cost.get("bytes accessed"))
+        except Exception:
+            pass
+    return {k: v for k, v in out.items() if v is not None} or None
+
+
+def record_cost(label: str, jitted_fn, *args, allow_compile: bool = True,
+                **kwargs) -> Optional[Dict[str, Any]]:
+    """Emit a ``cost`` event with :func:`cost_summary`'s numbers (no-op and
+    zero work when no recorder is active)."""
+    if _spans.active() is None:
+        return None
+    t0 = time.perf_counter()
+    summary = cost_summary(jitted_fn, *args, allow_compile=allow_compile,
+                           **kwargs)
+    if summary is None:
+        return None
+    _spans.emit("cost", label=label,
+                analysis_wall_s=round(time.perf_counter() - t0, 6), **summary)
+    return summary
+
+
+def record_vmem_estimate(label: str, **fields) -> None:
+    """Record an analytic working-set estimate (bytes vs budget, fits flag,
+    clamped tile dims, ...) computed by kernel-sizing code. Call sites run
+    at trace/resolution time, never inside compiled code."""
+    _spans.emit("vmem_estimate", label=label, **fields)

@@ -1,0 +1,108 @@
+"""Numerical-health monitors: per-solve conditioning and stability signals.
+
+The reference's only numerical telemetry is the external flavor's ``Error:``
+line; a production solver needs the factorization-side signals too, recorded
+per run (VERDICT history: the saylr4 refinement stall and the memplus f32
+floor were both diagnosed by hand — these monitors make them data):
+
+- ``min_abs_pivot`` — smallest |U diagonal| actually used; 0 means singular,
+  tiny means the solve is leaning on refinement.
+- ``growth_factor`` — max |entry of the factor| / max |entry of A|: the
+  element-growth bound behind partial pivoting's stability argument
+  (Wilkinson); large growth explains a bad residual with healthy pivots.
+- ``nan`` / ``inf`` flags on the solution (device engines signal singularity
+  through NaN rather than exceptions inside jit).
+- ``residual`` / ``rel_residual`` — ||Ax - b||_2 in f64 on host, absolute
+  (the BASELINE.json bar) and b-relative.
+
+All device-side numbers come from cheap O(n^2) reductions (one pass over the
+factor) fetched as scalars; the residual is the one O(n^2) host matvec the
+refinement loop already pays.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from gauss_tpu.obs import spans as _spans
+
+
+def _finite_float(v) -> float:
+    return float(v)
+
+
+def solution_health(x) -> Dict[str, Any]:
+    """NaN/Inf flags + magnitude of a solution vector (host or device)."""
+    x = np.asarray(x, dtype=np.float64)
+    return {
+        "nan": bool(np.isnan(x).any()),
+        "inf": bool(np.isinf(x).any()),
+        "max_abs_x": float(np.max(np.abs(x))) if x.size else 0.0,
+    }
+
+
+def residual_health(a, x, b) -> Dict[str, Any]:
+    """Absolute + relative residual norms in f64 on host."""
+    from gauss_tpu.verify import checks
+
+    res = checks.residual_norm(a, x, b)
+    nb = float(np.linalg.norm(np.asarray(b, np.float64)))
+    return {"residual": res,
+            "rel_residual": res / nb if nb > 0 else res}
+
+
+def factor_health(factors, a=None, n: Optional[int] = None) -> Dict[str, Any]:
+    """Pivot/growth monitors from a BlockedLU-shaped factorization.
+
+    ``n``: the true system size — the identity padding's 1.0 diagonal
+    entries would otherwise clamp the reported min |pivot| at <= 1 (same
+    trap the gauss_external ``--debug`` path documents). On-device
+    reductions; only scalars cross to host.
+    """
+    import jax.numpy as jnp
+
+    m = factors.m
+    n = int(m.shape[0]) if n is None else int(n)
+    diag = jnp.abs(jnp.diagonal(m)[:n])
+    out: Dict[str, Any] = {
+        "min_abs_pivot": _finite_float(jnp.min(diag)),
+        "max_abs_pivot": _finite_float(jnp.max(diag)),
+    }
+    max_factor = _finite_float(jnp.max(jnp.abs(m[:n, :n])))
+    if a is not None:
+        max_a = float(np.max(np.abs(np.asarray(a))))
+        if max_a > 0 and math.isfinite(max_factor):
+            out["growth_factor"] = max_factor / max_a
+    if getattr(factors, "min_abs_pivot", None) is not None:
+        # The loop-recorded minimum (includes padded steps; kept for
+        # cross-checking the diagonal read).
+        out["loop_min_abs_pivot"] = _finite_float(factors.min_abs_pivot)
+    return out
+
+
+def record_solve_health(a=None, x=None, b=None, factors=None,
+                        n: Optional[int] = None, backend: Optional[str] = None,
+                        **extra) -> Optional[Dict[str, Any]]:
+    """Assemble whichever monitors the inputs allow and emit ONE ``health``
+    event on the active recorder. Returns the metrics dict (None when no
+    recorder is active — the reductions are skipped entirely, so permanent
+    call sites stay free on unobserved runs)."""
+    if _spans.active() is None:
+        return None
+    metrics: Dict[str, Any] = {}
+    if x is not None:
+        metrics.update(solution_health(x))
+    if a is not None and x is not None and b is not None:
+        metrics.update(residual_health(a, x, b))
+    if factors is not None:
+        try:
+            metrics.update(factor_health(factors, a=a, n=n))
+        except Exception:
+            # Hand-built/partial factor objects must not break a solve.
+            pass
+    metrics.update(extra)
+    _spans.emit("health", backend=backend, **metrics)
+    return metrics

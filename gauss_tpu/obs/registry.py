@@ -1,0 +1,161 @@
+"""Metrics registry + structured run events, flushed as JSONL.
+
+The reference project's whole analysis story is observability — per-phase
+``gettimeofday`` spans and gprof flat profiles (PAPER.md, SURVEY §5) — but
+its numbers die in stdout. This registry is the persistent equivalent: every
+layer reports counters, gauges, histograms, spans, health monitors, and
+compile/memory accounting into ONE per-run event stream, written as JSON
+Lines so any run can be re-analysed later (``gauss_tpu.obs.summarize``).
+
+Design rules:
+
+- **No jax import at module load** — the registry must be usable before the
+  platform is pinned (CLI drivers import it pre-``honor_jax_platforms``).
+- **Zero-cost when inactive**: every module-level hook is a no-op unless a
+  recorder is active, so instrumentation can live permanently in hot setup
+  paths (never inside traced code — events are host-side by construction).
+- **Append-only events**: an event is one flat JSON object with ``type``,
+  ``run``, ``seq`` and ``t`` (seconds since run start); consumers aggregate,
+  producers never mutate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+
+def new_run_id() -> str:
+    """Short unique run ID (hex; collision-safe across hosts via uuid4)."""
+    return uuid.uuid4().hex[:12]
+
+
+def _jsonable(v):
+    """Coerce numpy/jax scalars and other oddballs to JSON-safe values."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        # NaN/Inf are not valid JSON; encode as strings so the flags survive.
+        if v != v:
+            return "nan"
+        if v in (float("inf"), float("-inf")):
+            return "inf" if v > 0 else "-inf"
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    try:  # numpy / jax scalars and 0-d arrays
+        return _jsonable(float(v))
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class Recorder:
+    """One run's event stream plus its counter/gauge/histogram registry.
+
+    Thread-safe appends (bench sweeps may record from worker threads); the
+    registry state is also folded into ``metric`` summary events at flush so
+    the JSONL alone reconstructs everything.
+    """
+
+    def __init__(self, run_id: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        self.run_id = run_id or new_run_id()
+        self.t0 = time.perf_counter()
+        self.events: List[Dict[str, Any]] = []
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, List[float]] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.emit("run_start", time_unix=time.time(),
+                  schema=SCHEMA_VERSION, **(meta or {}))
+
+    # -- event stream -----------------------------------------------------
+    def emit(self, type_: str, **fields) -> Dict[str, Any]:
+        """Append one structured event; returns it (already stamped)."""
+        with self._lock:
+            ev = {"type": type_, "run": self.run_id, "seq": self._seq,
+                  "t": round(time.perf_counter() - self.t0, 6)}
+            self._seq += 1
+        for k, v in fields.items():
+            ev[k] = _jsonable(v)
+        with self._lock:
+            self.events.append(ev)
+        return ev
+
+    # -- registry ---------------------------------------------------------
+    def counter(self, name: str, inc: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + inc
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def histogram(self, name: str, value: float) -> None:
+        with self._lock:
+            self.histograms.setdefault(name, []).append(float(value))
+
+    # -- output -----------------------------------------------------------
+    def _registry_events(self) -> List[Dict[str, Any]]:
+        evs = []
+        for name, v in sorted(self.counters.items()):
+            evs.append({"type": "metric", "kind": "counter", "name": name,
+                        "value": _jsonable(v)})
+        for name, v in sorted(self.gauges.items()):
+            evs.append({"type": "metric", "kind": "gauge", "name": name,
+                        "value": _jsonable(v)})
+        for name, vals in sorted(self.histograms.items()):
+            svals = sorted(vals)
+            evs.append({
+                "type": "metric", "kind": "histogram", "name": name,
+                "count": len(vals), "min": _jsonable(svals[0]),
+                "max": _jsonable(svals[-1]),
+                "mean": _jsonable(sum(vals) / len(vals)),
+                "p50": _jsonable(svals[len(svals) // 2])})
+        for ev in evs:
+            ev["run"] = self.run_id
+        return evs
+
+    def close(self) -> None:
+        """Stamp the run_end event (wall-clock of the whole run)."""
+        self.emit("run_end", wall_s=time.perf_counter() - self.t0)
+
+    def flush(self, path) -> int:
+        """Append every event (+ registry summaries) to ``path`` as JSONL;
+        returns the number of lines written. Appending, not truncating:
+        several runs (a bench sweep) can share one file and the summarizer
+        splits them by run ID."""
+        lines = [json.dumps(ev, sort_keys=True)
+                 for ev in self.events + self._registry_events()]
+        path = os.fspath(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+        return len(lines)
+
+
+def read_events(path) -> List[Dict[str, Any]]:
+    """Parse a JSONL events file; skips blank/corrupt lines (a crashed run
+    may truncate its last line — the surviving prefix is still data)."""
+    events = []
+    with open(os.fspath(path)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events

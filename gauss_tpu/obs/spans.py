@@ -1,0 +1,127 @@
+"""Span-based tracing over the active recorder: nested wall-clock regions.
+
+Generalizes :class:`gauss_tpu.utils.profiling.PhaseTimer` (which keeps its
+print-a-table surface and now ALSO reports here): a span is one named
+wall-clock region with a parent, so the summarizer can render both a
+gprof-style flat profile (aggregate by name) and a nesting-aware coverage
+check (leaf spans vs the root's duration). Spans measure HOST wall-clock;
+callers bounding device work must block/fetch before the span closes, same
+rule as ``PhaseTimer.phase(block_on=...)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from gauss_tpu.obs import registry as _registry
+
+# One active recorder per process (drivers are single-run); a lock guards
+# hand-over, and the span stack is thread-local so bench worker threads
+# cannot corrupt each other's nesting.
+_state_lock = threading.Lock()
+_active: Optional[_registry.Recorder] = None
+_tls = threading.local()
+
+
+def active() -> Optional[_registry.Recorder]:
+    """The recorder events currently report into (None -> hooks no-op)."""
+    return _active
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def run(metrics_out=None, run_id: Optional[str] = None, **meta):
+    """Activate a recorder for the duration of the block; flush to
+    ``metrics_out`` (JSONL, append) on exit when given. Re-entrant use nests
+    harmlessly: an inner ``run`` with no ``metrics_out`` reuses the outer
+    recorder instead of shadowing it, so library code can declare a run
+    without stealing the driver's."""
+    global _active
+    with _state_lock:
+        outer = _active
+        if outer is not None and metrics_out is None:
+            rec = outer
+        else:
+            rec = _registry.Recorder(run_id=run_id, meta=meta)
+            _active = rec
+    try:
+        yield rec
+    finally:
+        if rec is not outer:
+            rec.close()
+            with _state_lock:
+                _active = outer
+            if metrics_out:
+                rec.flush(metrics_out)
+
+
+def emit(type_: str, **fields):
+    """Record one event on the active recorder (no-op when inactive)."""
+    rec = _active
+    return rec.emit(type_, **fields) if rec is not None else None
+
+
+def counter(name: str, inc: float = 1) -> None:
+    rec = _active
+    if rec is not None:
+        rec.counter(name, inc)
+
+
+def gauge(name: str, value: float) -> None:
+    rec = _active
+    if rec is not None:
+        rec.gauge(name, value)
+
+
+def histogram(name: str, value: float) -> None:
+    rec = _active
+    if rec is not None:
+        rec.histogram(name, value)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a named region; records a ``span`` event with parent/depth on
+    exit. Zero-cost (single global read) when no recorder is active."""
+    rec = _active
+    if rec is None:
+        yield
+        return
+    stack = _stack()
+    parent = stack[-1] if stack else None
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        stack.pop()
+        rec.emit("span", name=name, dur_s=round(dur, 6), parent=parent,
+                 depth=len(stack), **attrs)
+        rec.histogram(f"span.{name}.s", dur)
+
+
+def record_span(name: str, seconds: float, parent: Optional[str] = None,
+                **attrs) -> None:
+    """Record an externally measured duration as a span (for spans whose
+    wall-clock was produced elsewhere — ``timed_fetch`` results, PhaseTimer
+    phases, the reference-parity CLI timing numbers). Parent defaults to the
+    currently open span of THIS thread, so these interleave correctly with
+    ``with span(...)`` nesting."""
+    rec = _active
+    if rec is None:
+        return
+    stack = _stack()
+    if parent is None and stack:
+        parent = stack[-1]
+    rec.emit("span", name=name, dur_s=round(float(seconds), 6),
+             parent=parent, depth=len(stack), **attrs)
+    rec.histogram(f"span.{name}.s", float(seconds))

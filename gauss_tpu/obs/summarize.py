@@ -1,0 +1,173 @@
+"""Render a recorded run: gprof-style flat profile + health + accounting.
+
+``python -m gauss_tpu.obs.summarize run.jsonl [--run ID] [--json]`` — the
+offline consumer of the JSONL event stream (the gprof step of the reference's
+workflow, SURVEY §5, replayed from persistent data instead of a one-shot
+stdout table).
+
+The flat profile aggregates LEAF spans (spans that are never some other
+span's parent), so nested regions are not double-counted, and reports the
+leaf total against the run's wall-clock — the coverage line is the honesty
+check that the spans actually tile the run instead of sampling it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from gauss_tpu.obs import registry
+
+
+def _runs(events: List[Dict[str, Any]]) -> List[str]:
+    seen = []
+    for ev in events:
+        rid = ev.get("run")
+        if rid and rid not in seen:
+            seen.append(rid)
+    return seen
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e5 or abs(v) < 1e-4:
+            return f"{v:.3e}"
+        return f"{v:.6g}"
+    return str(v)
+
+
+def flat_profile(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate span events into {name: {seconds, calls}} over leaves, plus
+    totals. Returns a dict so tests and JSON output share the numbers."""
+    spans = [ev for ev in events if ev.get("type") == "span"]
+    parents = {ev.get("parent") for ev in spans if ev.get("parent")}
+    leaves = [ev for ev in spans if ev["name"] not in parents]
+    agg: Dict[str, Dict[str, float]] = {}
+    for ev in leaves:
+        a = agg.setdefault(ev["name"], {"seconds": 0.0, "calls": 0})
+        a["seconds"] += float(ev.get("dur_s", 0.0))
+        a["calls"] += 1
+    total = sum(a["seconds"] for a in agg.values())
+    wall = None
+    for ev in events:
+        if ev.get("type") == "run_end" and ev.get("wall_s") is not None:
+            wall = float(ev["wall_s"])
+    return {"phases": agg, "span_total_s": total, "wall_s": wall}
+
+
+def _profile_lines(prof: Dict[str, Any]) -> List[str]:
+    agg, total = prof["phases"], prof["span_total_s"]
+    lines = ["  %time    seconds   calls  phase"]
+    denom = total or 1.0
+    for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["seconds"]):
+        lines.append(f"  {100.0 * a['seconds'] / denom:5.1f}  "
+                     f"{a['seconds']:9.6f}  {a['calls']:6d}  {name}")
+    lines.append(f"  span total {total:.6f} s")
+    if prof["wall_s"]:
+        cov = 100.0 * total / prof["wall_s"]
+        lines.append(f"  run wall-clock {prof['wall_s']:.6f} s "
+                     f"({cov:.1f}% covered by leaf spans)")
+    return lines
+
+
+_SKIP_FIELDS = {"type", "run", "seq", "t"}
+
+
+def _event_kv(ev: Dict[str, Any], skip=()) -> str:
+    return " ".join(f"{k}={_fmt(v)}" for k, v in ev.items()
+                    if k not in _SKIP_FIELDS and k not in skip
+                    and v is not None)
+
+
+def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
+    evs = [ev for ev in events if ev.get("run") == run_id]
+    out = []
+    start = next((ev for ev in evs if ev.get("type") == "run_start"), {})
+    meta = _event_kv(start, skip=("time_unix", "schema"))
+    out.append(f"run {run_id}" + (f"  [{meta}]" if meta else ""))
+
+    reported = [ev for ev in evs if ev.get("type") == "reported_time"]
+    for ev in reported:
+        out.append(f"  reported: {ev.get('name')} = "
+                   f"{_fmt(ev.get('seconds'))} s")
+
+    prof = flat_profile(evs)
+    if prof["phases"]:
+        out.append("")
+        out.append("flat profile (leaf spans):")
+        out.extend(_profile_lines(prof))
+
+    health = [ev for ev in evs if ev.get("type") == "health"]
+    if health:
+        out.append("")
+        out.append("numerical health:")
+        for ev in health:
+            out.append("  " + _event_kv(ev))
+
+    compiles = [ev for ev in evs if ev.get("type") in ("compile", "cost")]
+    if compiles:
+        out.append("")
+        out.append("compile / cost accounting:")
+        for ev in compiles:
+            out.append("  " + _event_kv(ev))
+
+    vmem = [ev for ev in evs if ev.get("type") == "vmem_estimate"]
+    if vmem:
+        out.append("")
+        out.append("VMEM/HBM working-set estimates:")
+        for ev in vmem:
+            out.append("  " + _event_kv(ev))
+
+    metrics = [ev for ev in evs if ev.get("type") == "metric"
+               and not str(ev.get("name", "")).startswith("span.")]
+    if metrics:
+        out.append("")
+        out.append("metrics:")
+        for ev in metrics:
+            out.append(f"  {ev.get('kind')} " + _event_kv(ev, skip=("kind",)))
+    return "\n".join(out)
+
+
+def summarize_events(events: List[Dict[str, Any]],
+                     run_id: Optional[str] = None) -> str:
+    run_ids = [run_id] if run_id else _runs(events)
+    if not run_ids:
+        return "(no runs found)"
+    return "\n\n".join(summarize_run(events, rid) for rid in run_ids)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.obs.summarize",
+        description="Render a metrics JSONL file (gprof-style flat profile, "
+                    "numerical health, compile/memory accounting).")
+    p.add_argument("path", help="JSONL events file (--metrics-out output)")
+    p.add_argument("--run", default=None, help="summarize only this run ID")
+    p.add_argument("--json", action="store_true",
+                   help="emit the flat profile(s) as JSON instead of text")
+    args = p.parse_args(argv)
+    try:
+        events = registry.read_events(args.path)
+    except OSError as e:
+        print(f"summarize: cannot read '{args.path}': {e}", file=sys.stderr)
+        return 1
+    if args.run and args.run not in _runs(events):
+        print(f"summarize: run '{args.run}' not found; runs: "
+              f"{', '.join(_runs(events)) or '(none)'}", file=sys.stderr)
+        return 1
+    if args.json:
+        run_ids = [args.run] if args.run else _runs(events)
+        payload = {rid: flat_profile(
+            [ev for ev in events if ev.get("run") == rid]) for rid in run_ids}
+        print(json.dumps(payload, indent=1, sort_keys=True))
+        return 0
+    print(summarize_events(events, args.run))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""JAX version compatibility shims (single source; no jax import at load).
+
+The engines target the modern public APIs; some images pin older jax
+releases where the same functionality lives under ``jax.experimental`` or
+takes different keyword names. Every shim resolves at call time so the repo
+imports cleanly regardless of which jax is installed.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` where it exists (jax >= 0.6), else the
+    ``jax.experimental.shard_map`` form.
+
+    On the experimental form, replication checking is disabled: the engines
+    lean on varying-manual-axes inference (see core.blocked._panel_factor_jax
+    carry inits), which the old ``check_rep`` analysis predates — it rejects
+    valid scan carries whose replication type is refined inside the loop
+    ("Scan carry input and output got mismatched replication types"). The
+    modern path keeps full checking.
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def pcast_varying(x, axes):
+    """Mark a replicated value as varying over ``axes`` inside shard_map.
+
+    ``lax.pcast`` (newest) > ``lax.pvary`` (jax >= 0.6) > identity: on jax
+    releases that predate varying-manual-axes tracking the shim's
+    ``check_rep=False`` path performs no replication analysis, so the cast
+    has nothing to record and the value passes through unchanged.
+    """
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x

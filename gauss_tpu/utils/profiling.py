@@ -24,14 +24,21 @@ from typing import Dict, Optional
 class PhaseTimer:
     """Accumulates named wall-clock spans; renders a gprof-like table.
 
+    Every closed phase also reports into the telemetry subsystem
+    (``gauss_tpu.obs``) as a span event when a recorder is active — the
+    table stays the interactive surface, the JSONL stream the persistent
+    one. Pass ``emit=False`` to keep a timer table-only (e.g. a timer
+    replaying durations that were already recorded as spans).
+
     >>> pt = PhaseTimer()
     >>> with pt.phase("init"): ...
     >>> with pt.phase("computeGauss"): ...
     >>> print(pt.report())
     """
 
-    def __init__(self) -> None:
+    def __init__(self, emit: bool = True) -> None:
         self.seconds: Dict[str, float] = {}
+        self.emit = emit
 
     @contextlib.contextmanager
     def phase(self, name: str, block_on=None):
@@ -46,8 +53,12 @@ class PhaseTimer:
                 import jax
 
                 jax.block_until_ready(block_on)
-            self.seconds[name] = (
-                self.seconds.get(name, 0.0) + time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dur
+            if self.emit:
+                from gauss_tpu.obs import spans as _obs_spans
+
+                _obs_spans.record_span(name, dur)
 
     @property
     def total(self) -> float:

@@ -94,6 +94,12 @@ def test_two_process_distributed_solve():
         for p in procs:
             p.kill()
         pytest.fail("multihost processes timed out:\n" + "\n".join(outs))
+    if any("Multiprocess computations aren't implemented on the CPU backend"
+           in out for out in outs):
+        # jaxlib releases without gloo-backed CPU cross-process collectives
+        # can initialize the distributed runtime but cannot run the solve;
+        # the capability is only discoverable by trying it.
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess collectives")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"RESULT_OK process {pid}" in out

@@ -1,0 +1,306 @@
+"""Telemetry subsystem tests: registry/JSONL round trip, span nesting,
+numerical-health monitors, the summarizer, and the CLI --metrics-out path.
+All pure-CPU (conftest pins JAX_PLATFORMS=cpu); no device required."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import summarize
+
+
+def _events(path):
+    return obs.read_events(path)
+
+
+def test_registry_roundtrip_through_jsonl(tmp_path):
+    out = tmp_path / "run.jsonl"
+    with obs.run(metrics_out=str(out), tool="test") as rec:
+        obs.counter("solves", 2)
+        obs.counter("solves")
+        obs.gauge("panel", 128)
+        obs.histogram("lat", 0.25)
+        obs.histogram("lat", 0.75)
+        obs.emit("custom", payload="x")
+    events = _events(out)
+    assert all(ev["run"] == rec.run_id for ev in events)
+    by_type = {}
+    for ev in events:
+        by_type.setdefault(ev["type"], []).append(ev)
+    assert by_type["run_start"][0]["tool"] == "test"
+    assert by_type["run_end"][0]["wall_s"] > 0
+    assert by_type["custom"][0]["payload"] == "x"
+    metrics = {(m["kind"], m["name"]): m for m in by_type["metric"]}
+    assert metrics[("counter", "solves")]["value"] == 3
+    assert metrics[("gauge", "panel")]["value"] == 128
+    hist = metrics[("histogram", "lat")]
+    assert hist["count"] == 2 and hist["min"] == 0.25 and hist["max"] == 0.75
+    # Valid JSON on every line (the file IS the interface).
+    for line in out.read_text().strip().split("\n"):
+        json.loads(line)
+
+
+def test_jsonl_append_multiple_runs(tmp_path):
+    out = tmp_path / "multi.jsonl"
+    with obs.run(metrics_out=str(out)) as r1:
+        obs.emit("e")
+    with obs.run(metrics_out=str(out)) as r2:
+        obs.emit("e")
+    runs = {ev["run"] for ev in _events(out)}
+    assert runs == {r1.run_id, r2.run_id}
+
+
+def test_span_nesting_and_parents(tmp_path):
+    out = tmp_path / "spans.jsonl"
+    with obs.run(metrics_out=str(out)):
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+            obs.record_span("measured", 0.5)
+        obs.record_span("top", 1.0)
+    spans = {ev["name"]: ev for ev in _events(out) if ev["type"] == "span"}
+    assert spans["inner"]["parent"] == "outer" and spans["inner"]["depth"] == 1
+    assert spans["measured"]["parent"] == "outer"
+    assert spans["outer"]["parent"] is None and spans["outer"]["depth"] == 0
+    assert spans["top"]["parent"] is None
+    assert spans["measured"]["dur_s"] == 0.5
+    # outer covers inner+measured and must be excluded from the leaf profile.
+    prof = summarize.flat_profile(list(spans.values()))
+    assert "outer" not in prof["phases"]
+    assert set(prof["phases"]) == {"inner", "measured", "top"}
+    assert prof["span_total_s"] == pytest.approx(
+        1.5 + spans["inner"]["dur_s"])
+
+
+def test_hooks_are_noops_without_recorder():
+    assert obs.active() is None
+    obs.counter("x")
+    obs.gauge("x", 1)
+    obs.record_span("x", 1.0)
+    obs.emit("x")
+    with obs.span("x"):
+        pass
+    assert obs.record_solve_health(x=np.ones(3)) is None
+    assert obs.active() is None
+
+
+def test_nested_run_reuses_outer_recorder(tmp_path):
+    out = tmp_path / "nested.jsonl"
+    with obs.run(metrics_out=str(out)) as outer:
+        with obs.run() as inner:  # no metrics_out -> same recorder
+            assert inner is outer
+            obs.emit("from_inner")
+        # Outer run still active after the nested exit.
+        assert obs.active() is outer
+    types = [ev["type"] for ev in _events(out)]
+    assert "from_inner" in types and types.count("run_end") == 1
+
+
+def test_health_monitors_flag_singular_and_nan_system(tmp_path):
+    from gauss_tpu.core import blocked
+
+    out = tmp_path / "health.jsonl"
+    n = 12
+    rng = np.random.default_rng(0)
+    good = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    singular = np.ones((n, n), np.float32)  # rank 1
+    b = np.ones(n, np.float32)
+    with obs.run(metrics_out=str(out)):
+        fac = blocked.lu_factor_blocked(good, panel=4)
+        x = blocked.lu_solve(fac, b)
+        h_good = obs.record_solve_health(a=good, x=x, b=b, factors=fac, n=n,
+                                         backend="tpu")
+        fac_s = blocked.lu_factor_blocked(singular, panel=4)
+        x_s = blocked.lu_solve(fac_s, b)
+        h_bad = obs.record_solve_health(a=singular, x=x_s, b=b,
+                                        factors=fac_s, n=n, backend="tpu")
+    assert not h_good["nan"] and h_good["min_abs_pivot"] > 0
+    assert h_good["residual"] < 1e-3 and h_good["growth_factor"] > 0
+    # The singular system: zero pivot recorded, NaN solution flagged.
+    assert h_bad["loop_min_abs_pivot"] == 0.0
+    assert h_bad["nan"]
+    health = [ev for ev in _events(out) if ev["type"] == "health"]
+    assert len(health) == 2
+    # NaN residual survives the JSON round trip as the string "nan".
+    assert health[1]["residual"] == "nan"
+
+
+def test_min_pivot_reads_real_diagonal_not_padding(tmp_path):
+    """Identity padding clamps the loop-recorded min at <= 1; the health
+    monitor must report the true U diagonal (same trap as the
+    gauss_external --debug path)."""
+    from gauss_tpu.core import blocked
+
+    n = 6  # pads to 8 with panel=8 below
+    a = (10.0 * np.eye(n)).astype(np.float32)
+    with obs.run():
+        fac = blocked.lu_factor_blocked(a, panel=8)
+        h = obs.record_solve_health(a=a, factors=fac, n=n, backend="tpu")
+    assert h["min_abs_pivot"] == pytest.approx(10.0)
+    assert h["loop_min_abs_pivot"] == pytest.approx(1.0)  # the padded steps
+
+
+def test_summarizer_on_golden_events_file(tmp_path):
+    golden = tmp_path / "golden.jsonl"
+    events = [
+        {"type": "run_start", "run": "r1", "seq": 0, "t": 0.0,
+         "tool": "golden"},
+        {"type": "config", "run": "r1", "seq": 1, "t": 0.0, "n": 64},
+        {"type": "span", "run": "r1", "seq": 2, "t": 0.1,
+         "name": "initMatrix", "dur_s": 0.1, "parent": None, "depth": 0},
+        {"type": "span", "run": "r1", "seq": 3, "t": 0.9,
+         "name": "computeGauss", "dur_s": 0.8, "parent": None, "depth": 0},
+        {"type": "reported_time", "run": "r1", "seq": 4, "t": 0.9,
+         "name": "Application time", "seconds": 0.9},
+        {"type": "health", "run": "r1", "seq": 5, "t": 0.95,
+         "min_abs_pivot": 0.5, "growth_factor": 2.0, "residual": 1e-6,
+         "nan": False, "backend": "tpu"},
+        {"type": "vmem_estimate", "run": "r1", "seq": 6, "t": 0.95,
+         "label": "panel_kernel", "bytes": 100, "budget": 200, "fits": True},
+        {"type": "run_end", "run": "r1", "seq": 7, "t": 1.0, "wall_s": 1.0},
+    ]
+    golden.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    rc = summarize.main([str(golden)])
+    assert rc == 0
+    text = summarize.summarize_events(obs.read_events(golden))
+    assert "run r1" in text and "flat profile" in text
+    assert "computeGauss" in text and "initMatrix" in text
+    assert "Application time" in text
+    assert "min_abs_pivot=0.5" in text and "growth_factor=2" in text
+    assert "panel_kernel" in text
+    # The leaf total (0.9) sits within 10% of the run wall-clock (1.0).
+    prof = summarize.flat_profile(events)
+    assert prof["span_total_s"] == pytest.approx(0.9)
+    assert abs(prof["span_total_s"] - prof["wall_s"]) / prof["wall_s"] <= 0.1
+
+
+def test_summarizer_cli_errors(tmp_path, capsys):
+    assert summarize.main([str(tmp_path / "missing.jsonl")]) == 1
+    f = tmp_path / "e.jsonl"
+    f.write_text(json.dumps({"type": "run_start", "run": "abc", "seq": 0,
+                             "t": 0.0}) + "\n")
+    assert summarize.main([str(f), "--run", "nope"]) == 1
+    assert "not found" in capsys.readouterr().err
+
+
+def test_phase_timer_bridges_into_obs(tmp_path):
+    from gauss_tpu.utils.profiling import PhaseTimer
+
+    out = tmp_path / "pt.jsonl"
+    with obs.run(metrics_out=str(out)):
+        pt = PhaseTimer()
+        with pt.phase("phaseA"):
+            pass
+        silent = PhaseTimer(emit=False)
+        with silent.phase("phaseB"):
+            pass
+    names = [ev["name"] for ev in _events(out) if ev["type"] == "span"]
+    assert "phaseA" in names and "phaseB" not in names
+
+
+def test_vmem_estimates_recorded_from_blocked(tmp_path):
+    from gauss_tpu.core import blocked
+
+    out = tmp_path / "vmem.jsonl"
+    with obs.run(metrics_out=str(out)):
+        blocked.panel_fits_vmem(4096, 256)
+        blocked.panel_fits_vmem(65536, 32)  # narrow-width fallback rung
+        blocked.fits_single_chip(2048)
+    evs = [ev for ev in _events(out) if ev["type"] == "vmem_estimate"]
+    labels = [ev["label"] for ev in evs]
+    assert labels.count("panel_kernel") == 2
+    assert "single_chip_hbm" in labels
+    narrow = [ev for ev in evs if ev.get("panel") == 32][0]
+    # The conservative narrow-panel fallback (ADVICE r5): overhead
+    # max(220, 55000//32) = 1718 B/row, not the flat 220.
+    assert narrow["bytes"] == 65536 * (32 * 4 + max(220, 55_000 // 32))
+    assert narrow["fits"] is False
+
+
+def test_phased_factorization_matches_and_records_spans(tmp_path):
+    from gauss_tpu.core import blocked
+    from gauss_tpu.utils.profiling import PhaseTimer
+
+    rng = np.random.default_rng(3)
+    n = 40
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    out = tmp_path / "phased.jsonl"
+    with obs.run(metrics_out=str(out)):
+        pt = PhaseTimer()
+        fac = blocked.lu_factor_blocked_phased(a, panel=16, timer=pt)
+    ref = blocked.lu_factor_blocked(a, panel=16)
+    np.testing.assert_allclose(np.asarray(fac.m), np.asarray(ref.m),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(fac.perm), np.asarray(ref.perm))
+    x = blocked.lu_solve(fac, b)
+    resid = np.linalg.norm(np.asarray(a, np.float64) @ np.asarray(x, np.float64)
+                           - np.asarray(b, np.float64))
+    assert resid < 1e-3
+    assert {"panel_factor", "pivot_apply", "trailing_update"} <= set(pt.seconds)
+    names = {ev["name"] for ev in _events(out) if ev["type"] == "span"}
+    assert {"panel_factor", "pivot_apply", "trailing_update"} <= names
+
+
+def test_record_cost_on_jitted_fn(tmp_path):
+    import jax
+
+    out = tmp_path / "cost.jsonl"
+    f = jax.jit(lambda x: x @ x)
+    arg = np.ones((16, 16), np.float32)
+    with obs.run(metrics_out=str(out)):
+        summary = obs.record_cost("square", f, arg)
+    assert summary is not None and summary.get("flops", 0) > 0
+    cost = [ev for ev in _events(out) if ev["type"] == "cost"]
+    assert cost and cost[0]["label"] == "square"
+
+
+def test_cli_metrics_out_smoke(tmp_path, capsys):
+    """The acceptance path: one gauss_internal run with --metrics-out yields
+    a summarizable JSONL whose leaf-span total covers the run wall-clock
+    within 10% and whose health event carries min-pivot/growth/residual."""
+    from gauss_tpu.cli import gauss_internal
+
+    out = tmp_path / "cli.jsonl"
+    rc = gauss_internal.main(["-s", "64", "-t", "2", "--verify",
+                              "--metrics-out", str(out)])
+    stdout = capsys.readouterr().out
+    assert rc == 0
+    assert "Metrics: run" in stdout
+    events = obs.read_events(out)
+    prof = summarize.flat_profile(events)
+    assert "computeGauss" in prof["phases"]
+    assert prof["wall_s"] and prof["span_total_s"] > 0
+    coverage = prof["span_total_s"] / prof["wall_s"]
+    assert 0.9 <= coverage <= 1.01, f"leaf spans cover {coverage:.1%} of run"
+    health = [ev for ev in events if ev["type"] == "health"]
+    assert health, "no health event recorded"
+    h = health[0]
+    assert h["min_abs_pivot"] > 0 and "growth_factor" in h
+    assert h["residual"] == 0 or h["residual"] < 1e-4
+    reported = [ev for ev in events if ev["type"] == "reported_time"]
+    assert reported and reported[0]["name"] == "Application time"
+    text = summarize.summarize_events(events)
+    assert "flat profile" in text and "numerical health" in text
+
+
+def test_bench_grid_metrics_out(tmp_path):
+    """bench.grid --metrics-out: per-cell events recorded, JSON rows carry
+    the telemetry run_id."""
+    from gauss_tpu.bench import grid
+
+    jsonp = tmp_path / "cells.json"
+    metrics = tmp_path / "grid.jsonl"
+    rc = grid.main(["--suite", "gauss-internal", "--keys", "32",
+                    "--backends", "tpu-unblocked",
+                    "--json", str(jsonp), "--metrics-out", str(metrics)])
+    assert rc == 0
+    cells = json.loads(jsonp.read_text())
+    events = obs.read_events(metrics)
+    run_ids = {ev["run"] for ev in events}
+    assert cells[0]["run_id"] in run_ids
+    cell_events = [ev for ev in events if ev["type"] == "cell"]
+    assert cell_events and cell_events[0]["backend"] == "tpu-unblocked"
+    assert cell_events[0]["verified"] is True
