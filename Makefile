@@ -3,8 +3,9 @@
 # drives the native library, tests, benchmarks, and dataset regeneration).
 
 PYTHON ?= python
+OBS_SMOKE ?= /tmp/gauss_obs_check.jsonl
 
-.PHONY: all native test bench datasets clean
+.PHONY: all native test bench datasets obs-check clean
 
 all: native
 
@@ -16,6 +17,19 @@ test: native
 
 bench:
 	$(PYTHON) bench.py
+
+# The observability gate (CI-callable): the regression sentinel against the
+# committed history (the latest BENCH records must stay inside the epoch-
+# noise band), then a live --metrics-out run smoke-tested through the
+# machine-readable summarizer and the Chrome-trace exporter.
+obs-check:
+	$(PYTHON) -m gauss_tpu.obs.regress check BENCH_r04.json BENCH_r05.json \
+	  --history reports/history.jsonl
+	rm -f $(OBS_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.cli.gauss_internal -s 64 -t 2 \
+	  --backend tpu-unblocked --verify --metrics-out $(OBS_SMOKE)
+	$(PYTHON) -m gauss_tpu.obs.summarize $(OBS_SMOKE) --json > /dev/null
+	$(PYTHON) -m gauss_tpu.obs.trace $(OBS_SMOKE) -o $(OBS_SMOKE).trace.json
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -57,11 +57,11 @@ def best_prior_headline() -> float | None:
     return best
 
 
-def main(metrics_out: str | None = None) -> None:
+def main(metrics_out: str | None = None) -> dict:
     from gauss_tpu import obs
 
     with obs.run(metrics_out=metrics_out, tool="bench", n=N) as rec:
-        _bench(rec)
+        return _bench(rec)
 
 
 def _bench(rec) -> None:
@@ -122,7 +122,7 @@ def _bench(rec) -> None:
 
     obs.emit("reported_time", name="gauss_n2048_wallclock",
              seconds=per_solve)
-    print(json.dumps({
+    record = {
         # Telemetry: the slope run's identity + its phase breakdown, so a
         # headline swing (the unexplained 49% r3->r4 move) is attributable
         # from the BENCH record alone — and, with --metrics-out, from the
@@ -154,7 +154,9 @@ def _bench(rec) -> None:
         "regression_vs_best": (round(per_solve / best_prior, 3)
                                if best_prior else None),
         "best_prior_s": best_prior,
-    }))
+    }
+    print(json.dumps(record))
+    return record
 
 
 if __name__ == "__main__":
@@ -166,12 +168,33 @@ if __name__ == "__main__":
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="append the run's telemetry (phase spans, health, "
                          "run id) as JSONL to PATH")
+    ap.add_argument("--regress", action="store_true",
+                    help="after the run, gate the fresh headline against "
+                         "reports/history.jsonl (obs.regress median + "
+                         "epoch-noise band); exit 1 when out of band")
+    ap.add_argument("--regress-history", default=None, metavar="PATH",
+                    help="history file for --regress (default: the "
+                         "committed reports/history.jsonl)")
     cli = ap.parse_args()
     try:
-        main(metrics_out=cli.metrics_out)
+        record = main(metrics_out=cli.metrics_out)
     except Exception:
         # Transient tunnel/device failures have been observed; one retry
         # protects the driver's single once-per-round invocation.
         traceback.print_exc(file=sys.stderr)
         print("bench: transient failure, retrying once", file=sys.stderr)
-        main(metrics_out=cli.metrics_out)
+        record = main(metrics_out=cli.metrics_out)
+    if cli.regress:
+        from gauss_tpu.obs import regress
+
+        history = regress.load_history(
+            cli.regress_history or regress.default_history_path())
+        verdicts = [regress.evaluate(record["metric"], record["value"],
+                                     history)]
+        if record.get("refined_value"):
+            verdicts.append(regress.evaluate(
+                f"{record['metric']}:refined", record["refined_value"],
+                history))
+        print(regress.format_verdicts(verdicts), file=sys.stderr)
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            sys.exit(1)

@@ -751,6 +751,14 @@ def main(argv=None) -> int:
                    help="append the sweep's telemetry (per-cell spans and "
                         "results, solver health, compile accounting) as "
                         "JSONL to PATH")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate every verified cell against the committed "
+                        "per-cell baselines (obs.regress median + "
+                        "epoch-noise band over reports/history.jsonl); "
+                        "out-of-band cells fail the run")
+    p.add_argument("--regress-history", metavar="PATH", default=None,
+                   help="history file for --regress-check (default: the "
+                        "committed reports/history.jsonl)")
     p.add_argument("--dist-device", choices=("cpu", "default"),
                    default="cpu",
                    help="gauss-dist mesh devices: 'cpu' = the forced "
@@ -813,7 +821,26 @@ def main(argv=None) -> int:
         with open(args.json_path, "w") as f:
             json.dump(payload, f, indent=1)
         print(f"wrote {len(payload)} cells to {args.json_path}", file=sys.stderr)
-    return 0 if all(c.verified for c in all_cells) else 1
+    rc = 0 if all(c.verified for c in all_cells) else 1
+    if args.regress_check:
+        # Per-cell regression gate: each verified cell checks against its
+        # own committed baseline (metric "cell:<suite>/<key>/<backend>").
+        # Cells with no history yet report no-baseline and do not gate —
+        # run `obs.regress ingest` on this sweep's --json output to seed
+        # them.
+        from gauss_tpu.obs import regress
+
+        history = regress.load_history(
+            args.regress_history or regress.default_history_path())
+        verdicts = [
+            regress.evaluate(regress._cell_metric(
+                {"suite": c.suite, "key": c.key, "backend": c.backend,
+                 "span": c.span}), c.seconds, history)
+            for c in all_cells if c.verified]
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = rc or 1
+    return rc
 
 
 def _run_suites(p, args, suites, backends, sweep, all_cells):

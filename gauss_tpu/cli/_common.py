@@ -43,10 +43,32 @@ ahead of the timed region.
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from gauss_tpu import obs
 from gauss_tpu.utils.timing import timed_fetch
+
+
+@contextlib.contextmanager
+def metrics_run(args, tool: str):
+    """The drivers' ``obs.run`` wrapper, multihost-aware: on a multi-process
+    launch each process writes its OWN JSONL stream (``<base>.pN<ext>``) and
+    all processes stamp one shared run id, so ``obs.aggregate`` can merge
+    them back into a single run with per-process lanes (see
+    :func:`gauss_tpu.dist.multihost.resolve_metrics_stream`). Single-process
+    runs behave exactly as before. Yields ``(recorder, stream_path)`` —
+    print the PATH from the yield, not ``args.metrics_out``, so the banner
+    names the file that actually exists."""
+    from gauss_tpu.dist import multihost
+
+    path, run_id = multihost.resolve_metrics_stream(
+        getattr(args, "metrics_out", None),
+        coordinator=getattr(args, "coordinator", None),
+        process_id=getattr(args, "process_id", None))
+    with obs.run(metrics_out=path, run_id=run_id, tool=tool) as rec:
+        yield rec, path
 
 GAUSS_BACKENDS = ("tpu", "tpu-unblocked", "tpu-rowelim", "tpu-rowelim-step",
                   "tpu-dist", "tpu-dist2d", "tpu-dist-blocked",

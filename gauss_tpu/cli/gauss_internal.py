@@ -182,12 +182,10 @@ def _run(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from gauss_tpu import obs
-
-    with obs.run(metrics_out=args.metrics_out, tool="gauss_internal") as rec:
+    with _common.metrics_run(args, "gauss_internal") as (rec, stream):
         rc = _run(args)
-    if args.metrics_out:
-        print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}")
+    if stream:
+        print(f"Metrics: run {rec.run_id} appended to {stream}")
     return rc
 
 

@@ -198,8 +198,13 @@ def prepare_dist(a, b, mesh: jax.sharding.Mesh):
 
 def solve_dist_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     """Solve a system previously staged by :func:`prepare_dist`."""
+    from gauss_tpu import obs
+
     a_c, b_c, n, npad = staged
     solver = _build_solver(mesh, npad, str(a_c.dtype))
+    obs.record_collective_budget("gauss_dist", solver, a_c, b_c,
+                                 n=n, npad=npad,
+                                 shards=int(mesh.devices.size))
     return solver(a_c, b_c)[:n]
 
 

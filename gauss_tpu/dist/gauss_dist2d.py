@@ -185,8 +185,13 @@ def prepare_dist2d(a, b, mesh: jax.sharding.Mesh):
 
 def solve_dist2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     """Solve a system previously staged by :func:`prepare_dist2d`."""
+    from gauss_tpu import obs
+
     a_c, b_c, n, npad, cperm = staged
     solver = _build_solver_2d(mesh, npad, str(a_c.dtype))
+    obs.record_collective_budget("gauss_dist2d", solver, a_c, b_c,
+                                 n=n, npad=npad,
+                                 mesh_shape=list(mesh.devices.shape))
     x_cyc = solver(a_c, b_c)
     # x_cyc[k] = x[cperm[k]]; undo (gather runs on the mesh's backend).
     inv = np.empty(npad, dtype=np.int64)

@@ -332,6 +332,10 @@ def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
 
     a_c, n, npad, panel = staged
     solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
+    obs.record_collective_budget("gauss_dist_blocked", solver, a_c,
+                                 n=n, npad=npad, panel=panel,
+                                 nblocks=npad // panel,
+                                 shards=int(mesh.devices.size))
     with obs.span("dist_factor_solve", n=n, panel=panel):
         x, *_ = jax.block_until_ready(solver(a_c))
     return x[:n]

@@ -377,8 +377,14 @@ def prepare_dist_blocked2d(a, b, mesh: jax.sharding.Mesh,
 
 
 def factor_dist_blocked2d(staged, mesh: jax.sharding.Mesh) -> DistBlocked2DLU:
+    from gauss_tpu import obs
+
     a_c, _, n, npad, panel = staged
     fac_fn = _build_factor_2d(mesh, npad, panel, str(a_c.dtype))
+    obs.record_collective_budget("gauss_dist_blocked2d", fac_fn, a_c,
+                                 n=n, npad=npad, panel=panel,
+                                 nblocks=npad // panel,
+                                 mesh_shape=list(mesh.devices.shape))
     a_fac, perm, linvs, uinvs, min_piv = fac_fn(a_c)
     return DistBlocked2DLU(a_fac, perm, linvs, uinvs, min_piv, n, npad,
                            panel, mesh)

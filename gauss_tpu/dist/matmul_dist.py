@@ -86,6 +86,11 @@ def matmul_dist(a, b, mesh: jax.sharding.Mesh = None, *,
 
     a = jax.device_put(a, in_shardings[0])
     b = jax.device_put(b, in_shardings[1])
+    from gauss_tpu import obs
+
+    obs.record_collective_budget("matmul_dist", run, a, b, via="hlo",
+                                 m=m, n=n,
+                                 mesh_shape=list(mesh.devices.shape))
     out = run(a, b)
     if out.shape != (m, n):
         out = out[:m, :n]

@@ -108,6 +108,44 @@ def maybe_initialize_from_args(args) -> bool:
     return True
 
 
+def resolve_metrics_stream(metrics_out, coordinator=None, process_id=None):
+    """Per-process telemetry coordinates for a (possibly) multihost launch:
+    returns ``(stream_path, run_id)`` for ``obs.run``.
+
+    The reference's MPI engine interleaves every rank's prints on rank 0's
+    terminal; the JSONL analog must NOT share one file — two processes
+    appending concurrently interleave partial lines. Instead each process
+    writes ``<base>.p<process_id><ext>`` and all of them stamp ONE shared
+    run id, so ``python -m gauss_tpu.obs.aggregate base.p*.jsonl`` merges
+    the streams back into a single run.
+
+    The shared id comes from GAUSS_OBS_RUN_ID when the launcher exported
+    one, else it is derived deterministically from the coordination address
+    (identical on every process of a launch; ephemeral coordinator ports
+    make it unique per launch — a launcher reusing a fixed port should
+    export GAUSS_OBS_RUN_ID instead). Pure host-side string work: callable
+    before jax.distributed.initialize, never touches a backend.
+
+    Single-process runs (no coordinates anywhere) pass through unchanged:
+    ``(metrics_out, None)``.
+    """
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    run_id = os.environ.get("GAUSS_OBS_RUN_ID")
+    if coordinator is None and process_id is None:
+        return metrics_out, run_id
+    if run_id is None and coordinator is not None:
+        import hashlib
+
+        run_id = hashlib.sha1(
+            f"multihost:{coordinator}".encode()).hexdigest()[:12]
+    if metrics_out and process_id is not None:
+        root, ext = os.path.splitext(os.fspath(metrics_out))
+        metrics_out = f"{root}.p{process_id}{ext}"
+    return metrics_out, run_id
+
+
 def add_multihost_args(parser) -> None:
     """Attach the three launch coordinates to a CLI parser (mpirun parity)."""
     g = parser.add_argument_group(

@@ -23,6 +23,11 @@ unobserved runs. Nothing here imports jax at module load; device-touching
 helpers (health reductions, cost analysis) import it lazily.
 """
 
+from gauss_tpu.obs.collectives import (  # noqa: F401
+    collective_budget,
+    compiled_collective_budget,
+    record_collective_budget,
+)
 from gauss_tpu.obs.compile import (  # noqa: F401
     compile_span,
     cost_summary,

@@ -1,0 +1,178 @@
+"""Collective-traffic accounting: count + size the interconnect ops.
+
+The distributed engines' design claims are stated in collectives-per-solve
+("3 per panel, not ~4 per row" — dist/gauss_dist_blocked.py docstring) and
+tests/test_dist_blocked.py proves the count from the compiled jaxpr. This
+module makes the same derivation a permanent telemetry source: trace the
+solver once, walk its jaxpr with scan lengths as multipliers, and emit one
+``collective`` event per op kind with the per-execution count and payload
+bytes. The summarizer folds these into a comms section, so every recorded
+distributed run documents what it asked of the interconnect — the analog of
+an MPI profiler's per-op message accounting over the reference's
+Bcast/Isend/Irecv protocol (SURVEY.md §3.3), derived statically instead of
+intercepted at runtime.
+
+Bytes are the mathematical payload of each op's OUTPUT avals (shape x
+itemsize, scan-weighted): the size of the value the collective materializes
+per participating device, not a wire-protocol byte count (reduction trees,
+ICI framing, and XLA's op fusion/decomposition are not modeled). Counts and
+bytes are exact for the traced program; treat them as the budget the
+formulation pays, comparable across engines and sizes.
+
+Everything no-ops without an active recorder and never raises — accounting
+must not take down a solve. Tracing costs one host-side ``jax.make_jaxpr``
+per (label, shapes) per run; a per-recorder memo prevents re-tracing inside
+bench loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from gauss_tpu.obs import spans as _spans
+
+# Substring match against primitive names (psum_p is "psum", lax.pmin/pmax
+# ride reductions too; "all_gather"/"all_to_all"/"ppermute" are literal).
+# Order matters only for labeling: the first match names the op kind.
+COLLECTIVE_KINDS = ("all_gather", "all_to_all", "ppermute", "psum", "pmin",
+                    "pmax", "pbroadcast", "pcast")
+
+
+def _kind_of(primitive_name: str) -> Optional[str]:
+    for kind in COLLECTIVE_KINDS:
+        if kind in primitive_name:
+            return kind
+    return None
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    try:
+        return int(size) * int(dtype.itemsize)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _walk(jaxpr, budget: Dict[str, Dict[str, int]], mult: int) -> None:
+    """Accumulate collective counts/bytes over one jaxpr, weighting nested
+    scan bodies by their static lengths (fori_loop with static bounds lowers
+    to scan). Nested jaxprs are found by duck-typing (a ClosedJaxpr has
+    .jaxpr, a Jaxpr has .eqns) rather than isinstance against jax internals
+    — the same refactor-proofing as tests/test_dist_blocked.py."""
+    for eqn in jaxpr.eqns:
+        kind = _kind_of(eqn.primitive.name)
+        if kind is not None:
+            b = budget.setdefault(kind, {"count": 0, "bytes": 0})
+            b["count"] += mult
+            b["bytes"] += mult * sum(_aval_bytes(v) for v in eqn.outvars)
+        inner_mult = mult * int(eqn.params.get("length", 1) or 1)
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                _walk(v.jaxpr, budget, inner_mult)
+            elif hasattr(v, "eqns"):
+                _walk(v, budget, inner_mult)
+
+
+def collective_budget(closed_jaxpr) -> Dict[str, Dict[str, int]]:
+    """Per-execution collective budget of a traced program:
+    ``{op_kind: {"count": N, "bytes": B}}`` with scan bodies weighted by
+    their static lengths. Accepts the result of ``jax.make_jaxpr(fn)(args)``
+    (or any object with ``.jaxpr.eqns`` / ``.eqns``)."""
+    budget: Dict[str, Dict[str, int]] = {}
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    _walk(jaxpr, budget, 1)
+    return budget
+
+
+# HLO op name -> the jaxpr-level kind it implements, for the compiled-module
+# path (XLA inserts these during SPMD partitioning of sharding-annotated
+# programs like dist.matmul_dist, where the jaxpr holds no collective
+# primitives at all).
+_HLO_KINDS = {"all-reduce": "psum", "all-gather": "all_gather",
+              "collective-permute": "ppermute", "all-to-all": "all_to_all",
+              "reduce-scatter": "reduce_scatter",
+              "collective-broadcast": "pbroadcast"}
+_HLO_ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+                 "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
+                 "u64": 8, "c64": 8, "c128": 16}
+
+
+def compiled_collective_budget(jitted_fn, *args) -> Dict[str, Dict[str, int]]:
+    """Collective budget of the COMPILED module: lower + compile via the AOT
+    API and count collective ops in the HLO text, sizing each by its output
+    shape. This is the only derivation available for sharding-annotation
+    programs (pjit + with_sharding_constraint), whose collectives exist only
+    after SPMD partitioning. Unlike the jaxpr path, ops inside HLO while
+    bodies count once (no static trip counts in HLO) — use the jaxpr path
+    for loop-heavy shard_map programs."""
+    import re
+
+    text = jitted_fn.lower(*args).compile().as_text()
+    budget: Dict[str, Dict[str, int]] = {}
+    pat = re.compile(
+        r"=\s+(?:\(?)([a-z0-9]+)\[([0-9,]*)\][^=]*?\s("
+        + "|".join(_HLO_KINDS) + r")(?:-start|-done)?\(")
+    for m in pat.finditer(text):
+        dtype, dims, op = m.group(1), m.group(2), m.group(3)
+        if "-done(" in m.group(0):
+            continue  # async pair: count the -start, skip its -done
+        kind = _HLO_KINDS[op]
+        size = 1
+        for d in dims.split(","):
+            if d.strip():
+                size *= int(d)
+        b = budget.setdefault(kind, {"count": 0, "bytes": 0})
+        b["count"] += 1
+        b["bytes"] += size * _HLO_ITEMSIZE.get(dtype, 4)
+    return budget
+
+
+def record_collective_budget(label: str, fn, *args, via: str = "jaxpr",
+                             **meta) -> Optional[Dict[str, Dict[str, int]]]:
+    """Trace ``fn(*args)`` and emit one ``collective`` event per op kind
+    (fields: ``label``, ``op``, ``count``, ``bytes``, ``via`` + the meta
+    kwargs); returns the budget dict, or None when inactive/untraceable.
+
+    ``via``: "jaxpr" (default) walks the traced program's explicit
+    collective primitives — right for shard_map engines, scan-weighted;
+    "hlo" compiles and counts the partitioner-inserted collectives — the
+    only source for sharding-annotation programs (see
+    :func:`compiled_collective_budget`).
+
+    Deduplicated per recorder by (label, arg shapes): a bench loop that
+    solves the same staged system repeatedly records the budget once, and
+    the registry counters (``collective.<op>.count|bytes``) aggregate
+    across distinct programs of one run.
+    """
+    rec = _spans.active()
+    if rec is None:
+        return None
+    try:
+        import jax
+
+        key = (label, tuple((getattr(a, "shape", None),
+                             str(getattr(a, "dtype", None))) for a in args))
+        seen = getattr(rec, "_collective_seen", None)
+        if seen is None:
+            seen = rec._collective_seen = set()
+        if key in seen:
+            return None
+        seen.add(key)
+        with _spans.span(f"collective_budget:{label}"):
+            if via == "hlo":
+                budget = compiled_collective_budget(fn, *args)
+            else:
+                budget = collective_budget(jax.make_jaxpr(fn)(*args))
+        for op in sorted(budget):
+            b = budget[op]
+            rec.emit("collective", label=label, op=op, count=b["count"],
+                     bytes=b["bytes"], via=via, **meta)
+            rec.counter(f"collective.{op}.count", b["count"])
+            rec.counter(f"collective.{op}.bytes", b["bytes"])
+        return budget
+    except Exception:  # accounting must never take down a solve
+        return None

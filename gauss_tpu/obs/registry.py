@@ -36,6 +36,54 @@ def new_run_id() -> str:
     return uuid.uuid4().hex[:12]
 
 
+# run_start fields written by environment_fingerprint(); the summarizer
+# renders these on their own "environment:" line instead of the meta header.
+ENV_FINGERPRINT_KEYS = ("host", "os_pid", "python", "jax", "backend",
+                        "device_kind", "device_count", "local_device_count",
+                        "process_index", "process_count")
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """Where this run executed: hostname, interpreter, jax version, and —
+    when a jax backend is ALREADY initialized — platform, device kind/count
+    and the process coordinates. Stamped into run_start at close so
+    regressions are attributable to an environment epoch, not just a commit
+    (the r3->r4 headline swing was an epoch, docs/BENCH_STABILITY.md).
+
+    Never initializes anything: jax is read only if already imported, and
+    device info only if a backend exists (probing would boot the default
+    platform — possibly a tunneled TPU — on runs that never touched it)."""
+    import platform
+    import socket
+    import sys
+
+    fp: Dict[str, Any] = {"host": socket.gethostname(), "os_pid": os.getpid(),
+                          "python": platform.python_version()}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return fp
+    fp["jax"] = getattr(jax, "__version__", None)
+    try:  # private, so duck-typed + guarded: empty/absent -> not initialized
+        from jax._src import xla_bridge
+
+        initialized = bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        initialized = False
+    if not initialized:
+        return fp
+    try:
+        devs = jax.devices()
+        fp.update(backend=devs[0].platform,
+                  device_kind=getattr(devs[0], "device_kind", None),
+                  device_count=jax.device_count(),
+                  local_device_count=jax.local_device_count(),
+                  process_index=jax.process_index(),
+                  process_count=jax.process_count())
+    except Exception:
+        pass
+    return fp
+
+
 def _jsonable(v):
     """Coerce numpy/jax scalars and other oddballs to JSON-safe values."""
     if v is None or isinstance(v, (bool, int, str)):
@@ -126,8 +174,19 @@ class Recorder:
         return evs
 
     def close(self) -> None:
-        """Stamp the run_end event (wall-clock of the whole run)."""
+        """Stamp the run_end event (wall-clock of the whole run) and merge
+        the environment fingerprint into run_start's meta. Fingerprinting at
+        close — not construction — sees the backend the run actually used
+        (drivers open the run before the platform is pinned; by close, any
+        backend the run touched is initialized)."""
         self.emit("run_end", wall_s=time.perf_counter() - self.t0)
+        try:
+            start = self.events[0]
+            for k, v in environment_fingerprint().items():
+                if k not in start and v is not None:
+                    start[k] = _jsonable(v)
+        except Exception:  # fingerprinting must never take down a run
+            pass
 
     def flush(self, path) -> int:
         """Append every event (+ registry summaries) to ``path`` as JSONL;

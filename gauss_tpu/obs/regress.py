@@ -1,0 +1,342 @@
+"""Benchmark-regression sentinel: baselines with a noise band, as a gate.
+
+``python -m gauss_tpu.obs.regress {ingest|check|report} ...``
+
+The unexplained 49% r3->r4 headline swing took a full manual bisection to
+classify as tunnel-epoch noise (docs/BENCH_STABILITY.md): BENCH_r03's
+1.476 ms was a favorable epoch, not faster code, and the records r1/r2/r4/r5
+cluster at ~2.1-2.3 ms. This module encodes that decode key as an automated
+gate:
+
+- **History** is an append-only JSONL (``reports/history.jsonl``, seeded
+  from the committed BENCH_r01-r05 driver records): one line per
+  measurement — ``{"metric", "value", "unit", "source", "kind"}``.
+  Ingestable sources: BENCH driver records (the ``parsed`` dict), bench-grid
+  ``--json`` cell arrays, and obs JSONL streams (``cell`` events) — only
+  VERIFIED cells enter history; a FAILED cell's 0.0 s must never become a
+  baseline.
+- **Baseline** per metric: the MEDIAN across epochs (robust to one lucky or
+  unlucky epoch — exactly how r3 must not drag the baseline down) plus a
+  noise band. The slow-side threshold is
+  ``median * max(band, 1 + 3*MAD/median)``: the configured relative band
+  (default 1.2 — the slope protocol's documented round-to-round spread is
+  ~±10%) widened when the recorded scatter says the metric is noisier.
+- **Verdict** per checked value: ``ok`` (within band), ``fast`` (below
+  median — never flagged: a favorable epoch is not a regression),
+  ``out-of-band`` (exit 1), or ``no-baseline`` (fewer than --min-samples
+  epochs; informational). Out-of-band verdicts carry the epoch decode key:
+  up to the documented 1.5x epoch-drift ceiling the report says "confirm
+  with a same-epoch A/B before blaming code"; beyond it, "likely a code
+  regression".
+
+Applied to the committed history: r4 checked against r1-r3 is 1.08x the
+median — in band, classified as epoch noise at first occurrence instead of
+after a manual bisection — while an injected 30% slowdown exceeds the band
+and exits nonzero (both asserted by tests/test_obs_dist.py).
+
+CI wiring: ``make obs-check`` gates on the committed records;
+``bench.py --regress`` gates a fresh headline; ``gauss-bench-grid
+--regress-check`` gates every verified cell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_BAND = 1.2        # slow-side relative tolerance vs the median
+EPOCH_DRIFT_CEILING = 1.5  # documented epoch envelope (BENCH_STABILITY.md)
+MIN_SAMPLES = 3
+
+
+def default_history_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "reports", "history.jsonl")
+
+
+def _record(metric: str, value, source: str, kind: str,
+            unit: str = "s", **meta) -> Optional[Dict[str, Any]]:
+    if not isinstance(value, (int, float)) or not value > 0:
+        return None
+    rec = {"metric": metric, "value": float(value), "unit": unit,
+           "source": os.path.basename(os.fspath(source)), "kind": kind}
+    rec.update({k: v for k, v in meta.items() if v is not None})
+    return rec
+
+
+def _cell_metric(cell: Dict[str, Any]) -> str:
+    name = (f"cell:{cell.get('suite')}/{cell.get('key')}/"
+            f"{cell.get('backend')}")
+    if cell.get("span") == "device":
+        name += "@device"
+    return name
+
+
+def ingest_file(path) -> List[Dict[str, Any]]:
+    """Parse one artifact into history records. Detects, in order: an obs
+    JSONL stream (``cell`` events), a BENCH driver record (``parsed`` dict
+    or the bare bench.py output dict), and a bench-grid ``--json`` cell
+    array. Unverified cells are dropped — a FAILED cell's 0.0 seconds must
+    never become a baseline."""
+    text = open(os.fspath(path)).read()
+    records: List[Dict[str, Any]] = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and doc.get("type"):
+        # A one-line obs stream parses as a plain dict; the "type" stamp
+        # marks it an event, not a BENCH record — route to the JSONL path.
+        doc = None
+    if doc is None:  # JSONL: an obs event stream
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("type") == "cell" and ev.get("verified"):
+                rec = _record(_cell_metric(ev), ev.get("seconds"), path,
+                              "cell", run=ev.get("run"))
+                if rec:
+                    records.append(rec)
+        return records
+    if isinstance(doc, list):  # bench-grid --json cells
+        for cell in doc:
+            if isinstance(cell, dict) and cell.get("verified"):
+                rec = _record(_cell_metric(cell), cell.get("seconds"), path,
+                              "cell", run=cell.get("run_id"))
+                if rec:
+                    records.append(rec)
+        return records
+    if isinstance(doc, dict):  # BENCH driver record or bare bench output
+        parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+            else doc
+        metric = parsed.get("metric")
+        if metric:
+            rec = _record(metric, parsed.get("value"), path, "bench",
+                          unit=parsed.get("unit", "s"),
+                          run=parsed.get("run_id"))
+            if rec:
+                records.append(rec)
+            rec = _record(f"{metric}:refined", parsed.get("refined_value"),
+                          path, "bench", unit=parsed.get("unit", "s"),
+                          run=parsed.get("run_id"))
+            if rec:
+                records.append(rec)
+    return records
+
+
+def load_history(path) -> List[Dict[str, Any]]:
+    if not os.path.exists(os.fspath(path)):
+        return []
+    out = []
+    with open(os.fspath(path)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric"):
+                out.append(rec)
+    return out
+
+
+def append_history(records: List[Dict[str, Any]], path) -> int:
+    """Append records not already present (same metric+value+source ==
+    the same measurement re-ingested; history is append-only, dedup keeps
+    re-running ingest idempotent). Returns the number actually added."""
+    existing = {(r.get("metric"), r.get("value"), r.get("source"))
+                for r in load_history(path)}
+    fresh = [r for r in records
+             if (r["metric"], r["value"], r["source"]) not in existing]
+    if not fresh:
+        return 0
+    path = os.fspath(path)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as f:
+        for r in fresh:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+    return len(fresh)
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def baseline(values: List[float], band: float = DEFAULT_BAND,
+             ) -> Dict[str, float]:
+    """Median + slow-side threshold for one metric's history. The band
+    widens to 1 + 3*MAD/median when the recorded scatter exceeds the
+    configured relative band — a metric whose own history is noisy gets a
+    proportionally wider gate instead of false alarms."""
+    med = _median(values)
+    mad = _median([abs(v - med) for v in values])
+    rel = max(band, 1.0 + (3.0 * mad / med if med > 0 else 0.0))
+    return {"median": med, "mad": mad, "n": len(values),
+            "rel_band": round(rel, 4), "threshold": med * rel}
+
+
+def evaluate(metric: str, value: float, history: List[Dict[str, Any]],
+             band: float = DEFAULT_BAND, min_samples: int = MIN_SAMPLES,
+             ) -> Dict[str, Any]:
+    """Classify one fresh measurement against the metric's history."""
+    values = [r["value"] for r in history if r.get("metric") == metric
+              and isinstance(r.get("value"), (int, float))]
+    verdict: Dict[str, Any] = {"metric": metric, "value": value,
+                               "samples": len(values)}
+    if len(values) < min_samples:
+        verdict.update(status="no-baseline",
+                       note=f"only {len(values)} committed epoch(s) "
+                            f"(need {min_samples}); informational only")
+        return verdict
+    base = baseline(values, band)
+    ratio = value / base["median"] if base["median"] > 0 else float("inf")
+    verdict.update(baseline=round(base["median"], 9),
+                   threshold=round(base["threshold"], 9),
+                   rel_band=base["rel_band"], ratio=round(ratio, 3))
+    if value <= base["median"]:
+        verdict.update(status="fast",
+                       note="at or below the baseline median — a favorable "
+                            "epoch is not a regression")
+    elif value <= base["threshold"]:
+        verdict.update(status="ok",
+                       note=f"{ratio:.2f}x median, inside the "
+                            f"{base['rel_band']:.2f}x noise band (epoch "
+                            f"noise; docs/BENCH_STABILITY.md)")
+    elif ratio <= EPOCH_DRIFT_CEILING:
+        verdict.update(status="out-of-band",
+                       note=f"{ratio:.2f}x median exceeds the "
+                            f"{base['rel_band']:.2f}x band but sits inside "
+                            f"the {EPOCH_DRIFT_CEILING}x epoch-drift "
+                            f"ceiling — confirm with a same-epoch A/B "
+                            f"before blaming code (BENCH_STABILITY.md)")
+    else:
+        verdict.update(status="out-of-band",
+                       note=f"{ratio:.2f}x median, beyond the "
+                            f"{EPOCH_DRIFT_CEILING}x epoch-drift ceiling — "
+                            f"likely a code regression")
+    return verdict
+
+
+def check_records(records: List[Dict[str, Any]],
+                  history: List[Dict[str, Any]],
+                  band: float = DEFAULT_BAND,
+                  min_samples: int = MIN_SAMPLES) -> List[Dict[str, Any]]:
+    return [evaluate(r["metric"], r["value"], history, band, min_samples)
+            for r in records]
+
+
+def format_verdicts(verdicts: List[Dict[str, Any]]) -> str:
+    out = []
+    for v in verdicts:
+        head = f"[{v['status']:^12}] {v['metric']} = {v['value']:.6g}"
+        if "baseline" in v:
+            head += (f"  (baseline {v['baseline']:.6g} over "
+                     f"{v['samples']} epochs)")
+        out.append(head)
+        out.append(f"               {v['note']}")
+    bad = sum(1 for v in verdicts if v["status"] == "out-of-band")
+    out.append(f"{len(verdicts)} metric(s) checked, {bad} out of band")
+    return "\n".join(out)
+
+
+def format_report(history: List[Dict[str, Any]],
+                  band: float = DEFAULT_BAND) -> str:
+    metrics: Dict[str, List[float]] = {}
+    for r in history:
+        if isinstance(r.get("value"), (int, float)):
+            metrics.setdefault(r["metric"], []).append(r["value"])
+    if not metrics:
+        return "(empty history)"
+    out = ["  epochs     median      threshold   metric"]
+    for m in sorted(metrics):
+        b = baseline(metrics[m], band)
+        out.append(f"  {b['n']:6d}  {b['median']:10.6g}  "
+                   f"{b['threshold']:10.6g}   {m}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.obs.regress",
+        description="Benchmark-regression sentinel over the append-only "
+                    "history (reports/history.jsonl): median baselines "
+                    "with an epoch-noise band, usable as a CI gate.")
+    p.add_argument("command", choices=("ingest", "check", "report"),
+                   help="ingest: append artifacts to history; check: "
+                        "classify artifacts against history (exit 1 on "
+                        "out-of-band); report: print per-metric baselines")
+    p.add_argument("files", nargs="*",
+                   help="artifacts: BENCH_*.json driver records, bench-grid "
+                        "--json cell arrays, or obs JSONL streams with "
+                        "cell events")
+    p.add_argument("--history", default=None, metavar="PATH",
+                   help=f"history file (default {default_history_path()})")
+    p.add_argument("--band", type=float, default=DEFAULT_BAND,
+                   help="slow-side relative noise band vs the median "
+                        f"(default {DEFAULT_BAND})")
+    p.add_argument("--min-samples", type=int, default=MIN_SAMPLES,
+                   help="epochs required before a baseline gates "
+                        f"(default {MIN_SAMPLES})")
+    p.add_argument("--update", action="store_true",
+                   help="check only: also append the checked records to "
+                        "history when every verdict is in band (a green "
+                        "gate grows the baseline)")
+    args = p.parse_args(argv)
+    history_path = args.history or default_history_path()
+
+    if args.command == "report":
+        print(f"history: {history_path}")
+        print(format_report(load_history(history_path), args.band))
+        return 0
+
+    if not args.files:
+        p.error(f"{args.command} needs at least one artifact file")
+    records: List[Dict[str, Any]] = []
+    for f in args.files:
+        try:
+            recs = ingest_file(f)
+        except OSError as e:
+            print(f"regress: cannot read '{f}': {e}", file=sys.stderr)
+            return 2
+        if not recs:
+            print(f"regress: no ingestable measurements in '{f}'",
+                  file=sys.stderr)
+        records.extend(recs)
+    if not records:
+        print("regress: nothing to do (no measurements found)",
+              file=sys.stderr)
+        return 2
+
+    if args.command == "ingest":
+        added = append_history(records, history_path)
+        print(f"regress: {added} new record(s) appended to {history_path} "
+              f"({len(records) - added} already present)")
+        return 0
+
+    history = load_history(history_path)
+    verdicts = check_records(records, history, args.band, args.min_samples)
+    print(format_verdicts(verdicts))
+    bad = any(v["status"] == "out-of-band" for v in verdicts)
+    if args.update and not bad:
+        added = append_history(records, history_path)
+        print(f"regress: gate green; {added} record(s) appended to history")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,7 @@ rule as ``PhaseTimer.phase(block_on=...)``.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from typing import Optional
@@ -43,14 +44,20 @@ def run(metrics_out=None, run_id: Optional[str] = None, **meta):
     ``metrics_out`` (JSONL, append) on exit when given. Re-entrant use nests
     harmlessly: an inner ``run`` with no ``metrics_out`` reuses the outer
     recorder instead of shadowing it, so library code can declare a run
-    without stealing the driver's."""
+    without stealing the driver's.
+
+    ``run_id`` defaults to the GAUSS_OBS_RUN_ID environment variable when
+    set — the multihost hook: a launcher exports one id to every process so
+    their per-process streams merge as ONE run in ``obs.aggregate``."""
     global _active
     with _state_lock:
         outer = _active
         if outer is not None and metrics_out is None:
             rec = outer
         else:
-            rec = _registry.Recorder(run_id=run_id, meta=meta)
+            rec = _registry.Recorder(
+                run_id=run_id or os.environ.get("GAUSS_OBS_RUN_ID"),
+                meta=meta)
             _active = rec
     try:
         yield rec

@@ -8,7 +8,15 @@ stdout table).
 The flat profile aggregates LEAF spans (spans that are never some other
 span's parent), so nested regions are not double-counted, and reports the
 leaf total against the run's wall-clock — the coverage line is the honesty
-check that the spans actually tile the run instead of sampling it.
+check that the spans actually tile the run instead of sampling it. On a
+MERGED multihost stream (``obs.aggregate`` stamps ``proc`` on every event)
+coverage is computed PER PROCESS and reported per lane: each process has its
+own wall-clock, and a single-stream formula dividing the summed span time of
+P processes by one process's wall would read ~P00%.
+
+``--json`` emits the complete summary (profile, lanes, health, collective
+traffic, compile/cost, vmem, metrics) as machine-readable JSON keyed by run
+— the form CI and the regression sentinel consume.
 """
 
 from __future__ import annotations
@@ -40,9 +48,19 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _procs(events: List[Dict[str, Any]]) -> List[int]:
+    return sorted({int(ev.get("proc", 0)) for ev in events})
+
+
 def flat_profile(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate span events into {name: {seconds, calls}} over leaves, plus
-    totals. Returns a dict so tests and JSON output share the numbers."""
+    totals. Returns a dict so tests and JSON output share the numbers.
+
+    ``lanes`` holds the per-process split of a merged multihost stream
+    (span total, wall-clock and coverage PER process); on a single-process
+    stream it has one lane and matches the totals. The top-level ``wall_s``
+    is the max lane wall — the run's duration, not the sum of P clocks.
+    """
     spans = [ev for ev in events if ev.get("type") == "span"]
     parents = {ev.get("parent") for ev in spans if ev.get("parent")}
     leaves = [ev for ev in spans if ev["name"] not in parents]
@@ -52,11 +70,21 @@ def flat_profile(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         a["seconds"] += float(ev.get("dur_s", 0.0))
         a["calls"] += 1
     total = sum(a["seconds"] for a in agg.values())
-    wall = None
-    for ev in events:
-        if ev.get("type") == "run_end" and ev.get("wall_s") is not None:
-            wall = float(ev["wall_s"])
-    return {"phases": agg, "span_total_s": total, "wall_s": wall}
+
+    lanes: Dict[int, Dict[str, Any]] = {}
+    for proc in _procs(events):
+        lane_total = sum(float(ev.get("dur_s", 0.0)) for ev in leaves
+                         if int(ev.get("proc", 0)) == proc)
+        wall = None
+        for ev in events:
+            if (ev.get("type") == "run_end" and int(ev.get("proc", 0)) == proc
+                    and ev.get("wall_s") is not None):
+                wall = float(ev["wall_s"])
+        lanes[proc] = {"span_total_s": lane_total, "wall_s": wall,
+                       "coverage": (lane_total / wall if wall else None)}
+    walls = [l["wall_s"] for l in lanes.values() if l["wall_s"]]
+    return {"phases": agg, "span_total_s": total,
+            "wall_s": max(walls) if walls else None, "lanes": lanes}
 
 
 def _profile_lines(prof: Dict[str, Any]) -> List[str]:
@@ -67,14 +95,69 @@ def _profile_lines(prof: Dict[str, Any]) -> List[str]:
         lines.append(f"  {100.0 * a['seconds'] / denom:5.1f}  "
                      f"{a['seconds']:9.6f}  {a['calls']:6d}  {name}")
     lines.append(f"  span total {total:.6f} s")
-    if prof["wall_s"]:
+    lanes = prof.get("lanes") or {}
+    if len(lanes) > 1:
+        # Merged multihost stream: one coverage line PER process lane —
+        # each process has its own wall-clock (the single-stream formula
+        # against one wall would report ~P00% and nonsense skew).
+        for proc in sorted(lanes):
+            lane = lanes[proc]
+            if lane["wall_s"]:
+                lines.append(
+                    f"  process {proc}: wall-clock {lane['wall_s']:.6f} s "
+                    f"({100.0 * lane['coverage']:.1f}% covered by its leaf "
+                    f"spans)")
+            else:
+                lines.append(f"  process {proc}: (no run_end recorded)")
+    elif prof["wall_s"]:
         cov = 100.0 * total / prof["wall_s"]
         lines.append(f"  run wall-clock {prof['wall_s']:.6f} s "
                      f"({cov:.1f}% covered by leaf spans)")
     return lines
 
 
-_SKIP_FIELDS = {"type", "run", "seq", "t"}
+def comms_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold ``collective`` events into per-engine traffic totals:
+    ``{label: {"ops": {op: {count, bytes}}, "count", "bytes"}}``."""
+    out: Dict[str, Any] = {}
+    for ev in events:
+        if ev.get("type") != "collective":
+            continue
+        label = str(ev.get("label", "?"))
+        eng = out.setdefault(label, {"ops": {}, "count": 0, "bytes": 0})
+        op = eng["ops"].setdefault(str(ev.get("op", "?")),
+                                   {"count": 0, "bytes": 0})
+        c = int(ev.get("count", 0) or 0)
+        b = int(ev.get("bytes", 0) or 0)
+        op["count"] += c
+        op["bytes"] += b
+        eng["count"] += c
+        eng["bytes"] += b
+    return out
+
+
+def _human_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"
+
+
+def _comms_lines(comms: Dict[str, Any]) -> List[str]:
+    lines = []
+    for label in sorted(comms):
+        eng = comms[label]
+        ops = ", ".join(
+            f"{op} x{d['count']} ({_human_bytes(d['bytes'])})"
+            for op, d in sorted(eng["ops"].items()))
+        lines.append(f"  {label}: {ops}")
+        lines.append(f"    total {eng['count']} collectives, "
+                     f"{_human_bytes(eng['bytes'])} payload")
+    return lines
+
+
+_SKIP_FIELDS = {"type", "run", "seq", "t", "t_aligned", "proc"}
 
 
 def _event_kv(ev: Dict[str, Any], skip=()) -> str:
@@ -83,12 +166,55 @@ def _event_kv(ev: Dict[str, Any], skip=()) -> str:
                     and v is not None)
 
 
+def _strip(ev: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in ev.items()
+            if k not in ("run", "seq") and v is not None}
+
+
+def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
+    """The complete machine-readable summary of one run — the ``--json``
+    payload, and the single source the text renderer draws from."""
+    evs = [ev for ev in events if ev.get("run") == run_id]
+    start = next((ev for ev in evs if ev.get("type") == "run_start"), {})
+    env = {k: start[k] for k in registry.ENV_FINGERPRINT_KEYS if k in start}
+    meta = {k: v for k, v in start.items()
+            if k not in _SKIP_FIELDS and k not in env
+            and k not in ("time_unix", "schema")}
+    return {
+        "run": run_id,
+        "meta": meta,
+        "environment": env,
+        "processes": _procs(evs),
+        "reported": [_strip(ev) for ev in evs
+                     if ev.get("type") == "reported_time"],
+        "profile": flat_profile(evs),
+        "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
+        "comms": comms_summary(evs),
+        "compile": [_strip(ev) for ev in evs
+                    if ev.get("type") in ("compile", "cost")],
+        "vmem": [_strip(ev) for ev in evs
+                 if ev.get("type") == "vmem_estimate"],
+        "cells": [_strip(ev) for ev in evs if ev.get("type") == "cell"],
+        "metrics": [_strip(ev) for ev in evs if ev.get("type") == "metric"
+                    and not str(ev.get("name", "")).startswith("span.")],
+    }
+
+
 def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
     evs = [ev for ev in events if ev.get("run") == run_id]
     out = []
     start = next((ev for ev in evs if ev.get("type") == "run_start"), {})
-    meta = _event_kv(start, skip=("time_unix", "schema"))
+    env_skip = tuple(registry.ENV_FINGERPRINT_KEYS)
+    meta = _event_kv(start, skip=("time_unix", "schema") + env_skip)
     out.append(f"run {run_id}" + (f"  [{meta}]" if meta else ""))
+    env = {k: start[k] for k in registry.ENV_FINGERPRINT_KEYS if k in start}
+    if env:
+        out.append("  environment: "
+                   + " ".join(f"{k}={_fmt(v)}" for k, v in env.items()))
+    procs = _procs(evs)
+    if len(procs) > 1:
+        out.append(f"  merged multihost stream: {len(procs)} processes "
+                   f"{procs}")
 
     reported = [ev for ev in evs if ev.get("type") == "reported_time"]
     for ev in reported:
@@ -107,6 +233,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("numerical health:")
         for ev in health:
             out.append("  " + _event_kv(ev))
+
+    comms = comms_summary(evs)
+    if comms:
+        out.append("")
+        out.append("collective traffic (per-execution budget):")
+        out.extend(_comms_lines(comms))
 
     compiles = [ev for ev in evs if ev.get("type") in ("compile", "cost")]
     if compiles:
@@ -144,11 +276,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m gauss_tpu.obs.summarize",
         description="Render a metrics JSONL file (gprof-style flat profile, "
-                    "numerical health, compile/memory accounting).")
+                    "numerical health, collective traffic, compile/memory "
+                    "accounting).")
     p.add_argument("path", help="JSONL events file (--metrics-out output)")
     p.add_argument("--run", default=None, help="summarize only this run ID")
     p.add_argument("--json", action="store_true",
-                   help="emit the flat profile(s) as JSON instead of text")
+                   help="emit the full summary (profile, per-process lanes, "
+                        "health, comms, compile, metrics) as JSON keyed by "
+                        "run — the machine-readable form CI and obs.regress "
+                        "consume")
     args = p.parse_args(argv)
     try:
         events = registry.read_events(args.path)
@@ -161,8 +297,7 @@ def main(argv=None) -> int:
         return 1
     if args.json:
         run_ids = [args.run] if args.run else _runs(events)
-        payload = {rid: flat_profile(
-            [ev for ev in events if ev.get("run") == rid]) for rid in run_ids}
+        payload = {rid: run_summary(events, rid) for rid in run_ids}
         print(json.dumps(payload, indent=1, sort_keys=True))
         return 0
     print(summarize_events(events, args.run))

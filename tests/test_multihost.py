@@ -37,32 +37,45 @@ assert multihost.is_multihost()
 print(multihost.process_banner(), flush=True)
 
 import numpy as np
+from gauss_tpu import obs
 from gauss_tpu.dist import gauss_dist, make_mesh
 from gauss_tpu.io import synthetic
 from gauss_tpu.verify import checks
 
-n = 64
-a = synthetic.internal_matrix(n, dtype=np.float32)
-b = synthetic.internal_rhs(n, dtype=np.float32)
-mesh = make_mesh(8)
-x = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh), np.float64)
-assert checks.internal_pattern_ok(x, atol=1e-3), x[:4]
+# The multihost telemetry protocol under test: each process writes its OWN
+# stream stamped with ONE shared run id (derived from the coordination
+# address), exactly as cli._common.metrics_run does for real drivers.
+stream, run_id = multihost.resolve_metrics_stream(
+    {metrics!r}, coordinator={coord!r}, process_id={pid})
 
-# The round-3 scaling engines over the SAME cross-process pool: the 1-D
-# panel-blocked factorization and the 2-D tournament-pivoted one — real
-# cross-process collectives through their per-panel psum/all_gather
-# protocol, not just the single-process simulation.
-from gauss_tpu.dist import gauss_dist_blocked, gauss_dist_blocked2d
-from gauss_tpu.dist.mesh import make_mesh_2d
+with obs.run(metrics_out=stream, run_id=run_id, tool="mh_worker"):
+    n = 64
+    with obs.span("initMatrix"):
+        a = synthetic.internal_matrix(n, dtype=np.float32)
+        b = synthetic.internal_rhs(n, dtype=np.float32)
+    mesh = make_mesh(8)
+    with obs.span("solve_dist"):
+        x = np.asarray(gauss_dist.gauss_solve_dist(a, b, mesh=mesh),
+                       np.float64)
+    assert checks.internal_pattern_ok(x, atol=1e-3), x[:4]
 
-xb = np.asarray(gauss_dist_blocked.gauss_solve_dist_blocked(
-    a, b, mesh=mesh, panel=4), np.float64)
-assert checks.internal_pattern_ok(xb, atol=1e-3), xb[:4]
+    # The round-3 scaling engines over the SAME cross-process pool: the 1-D
+    # panel-blocked factorization and the 2-D tournament-pivoted one — real
+    # cross-process collectives through their per-panel psum/all_gather
+    # protocol, not just the single-process simulation.
+    from gauss_tpu.dist import gauss_dist_blocked, gauss_dist_blocked2d
+    from gauss_tpu.dist.mesh import make_mesh_2d
 
-mesh2 = make_mesh_2d(4, 2)
-x2 = np.asarray(gauss_dist_blocked2d.gauss_solve_dist_blocked2d(
-    a, b, mesh=mesh2, panel=4), np.float64)
-assert checks.internal_pattern_ok(x2, atol=1e-3), x2[:4]
+    with obs.span("solve_dist_blocked"):
+        xb = np.asarray(gauss_dist_blocked.gauss_solve_dist_blocked(
+            a, b, mesh=mesh, panel=4), np.float64)
+    assert checks.internal_pattern_ok(xb, atol=1e-3), xb[:4]
+
+    mesh2 = make_mesh_2d(4, 2)
+    with obs.span("solve_dist_blocked2d"):
+        x2 = np.asarray(gauss_dist_blocked2d.gauss_solve_dist_blocked2d(
+            a, b, mesh=mesh2, panel=4), np.float64)
+    assert checks.internal_pattern_ok(x2, atol=1e-3), x2[:4]
 print("RESULT_OK process {pid}", flush=True)
 """
 
@@ -73,14 +86,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_solve():
+def test_two_process_distributed_solve(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
+    metrics = str(tmp_path / "mh.jsonl")
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [
         subprocess.Popen(
             [sys.executable, "-c",
-             _WORKER.format(repo=REPO, coord=coord, pid=pid)],
+             _WORKER.format(repo=REPO, coord=coord, pid=pid,
+                            metrics=metrics)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO)
         for pid in (0, 1)
@@ -104,6 +119,47 @@ def test_two_process_distributed_solve():
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"RESULT_OK process {pid}" in out
         assert "local / 8 global devices" in out
+    _check_multihost_telemetry(tmp_path)
+
+
+def _check_multihost_telemetry(tmp_path):
+    """The distributed-observability acceptance path, on REAL cross-process
+    streams: two per-process JSONL files -> one merged run with per-process
+    straggler stats -> a loadable Chrome trace with one lane per process."""
+    import json
+
+    from gauss_tpu.obs import aggregate, summarize, trace
+
+    p0, p1 = str(tmp_path / "mh.p0.jsonl"), str(tmp_path / "mh.p1.jsonl")
+    assert os.path.exists(p0) and os.path.exists(p1), \
+        "each process must write its own stream"
+    rid, merged = aggregate.merge_streams([p0, p1])
+    procs = {ev["proc"] for ev in merged}
+    assert procs == {0, 1}, procs
+    # Both processes stamped the SAME derived run id.
+    assert {ev["run"] for ev in merged} == {rid}
+    stats = aggregate.straggler_stats(merged)
+    assert stats["processes"] == [0, 1]
+    solve = stats["phases"]["dist_factor_solve"]
+    assert solve["max_s"] >= solve["min_s"] >= 0.0
+    assert 0.0 <= solve["skew"] <= 1.0
+    # Cross-process collective accounting made it into both streams.
+    colls = [ev for ev in merged if ev["type"] == "collective"]
+    assert {ev["proc"] for ev in colls} == {0, 1}
+    assert any(ev["label"] == "gauss_dist_blocked" for ev in colls)
+    # Per-lane coverage: two lanes, each with its own wall-clock.
+    prof = summarize.flat_profile(merged)
+    assert set(prof["lanes"]) == {0, 1}
+    for lane in prof["lanes"].values():
+        assert lane["wall_s"] and 0.0 < lane["coverage"] <= 1.05
+    # Chrome-trace export: loadable JSON, one lane (pid) per process.
+    out = tmp_path / "mh.trace.json"
+    aggregate.write_merged(merged, tmp_path / "mh.merged.jsonl")
+    assert trace.main([str(tmp_path / "mh.merged.jsonl"),
+                       "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    pids = {ev["pid"] for ev in doc["traceEvents"] if ev["ph"] == "X"}
+    assert pids == {0, 1}
 
 
 def test_initialize_rejects_double_init_different_topology(monkeypatch):

@@ -1,0 +1,458 @@
+"""Distributed-telemetry tests: multi-stream merge determinism, straggler
+statistics, Chrome-trace export schema, collective-traffic accounting from
+the real dist engines, and the benchmark-regression sentinel (on both
+synthetic histories and the committed BENCH_r01-r05 records).
+
+The REAL two-process path is exercised by tests/test_multihost.py (when the
+jaxlib CPU backend supports cross-process collectives); these tests build
+the same per-process stream shapes in one process so the merge/trace/regress
+logic is covered everywhere."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import aggregate, regress, summarize, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# synthetic per-process streams (the shape cli._common.metrics_run produces)
+
+def _mh_stream(path, proc, t_unix, spans, wall):
+    """One process's JSONL stream: run_start (with process fingerprint and
+    wall-clock anchor), spans, run_end."""
+    events = [{"type": "run_start", "run": "mhrun0001", "seq": 0, "t": 0.0,
+               "time_unix": t_unix, "schema": 1, "tool": "mh",
+               "process_index": proc, "process_count": 2,
+               "host": f"host{proc}"}]
+    seq = 1
+    for name, end_t, dur, parent in spans:
+        events.append({"type": "span", "run": "mhrun0001", "seq": seq,
+                       "t": end_t, "name": name, "dur_s": dur,
+                       "parent": parent, "depth": 1 if parent else 0})
+        seq += 1
+    events.append({"type": "run_end", "run": "mhrun0001", "seq": seq,
+                   "t": wall, "wall_s": wall})
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return events
+
+
+@pytest.fixture
+def mh_streams(tmp_path):
+    p0 = tmp_path / "run.p0.jsonl"
+    p1 = tmp_path / "run.p1.jsonl"
+    # Process 1 starts 0.25 s after process 0 (clock alignment must use
+    # run_start.time_unix, not per-stream t).
+    _mh_stream(p0, 0, 1000.0,
+               [("solve", 0.5, 0.4, "root"), ("root", 1.0, 0.9, None)], 1.0)
+    _mh_stream(p1, 1, 1000.25,
+               [("solve", 0.7, 0.6, "root"), ("root", 1.1, 1.0, None)], 1.2)
+    return str(p0), str(p1)
+
+
+def test_merge_is_deterministic_in_file_order(mh_streams):
+    p0, p1 = mh_streams
+    rid_a, merged_a = aggregate.merge_streams([p0, p1])
+    rid_b, merged_b = aggregate.merge_streams([p1, p0])
+    assert rid_a == rid_b == "mhrun0001"
+    assert merged_a == merged_b
+    # Re-reading the same stream twice must not duplicate events.
+    _, merged_c = aggregate.merge_streams([p0, p1, p0])
+    assert merged_c == merged_a
+
+
+def test_merge_aligns_clocks_and_stamps_procs(mh_streams):
+    _, merged = aggregate.merge_streams(list(mh_streams))
+    assert {ev["proc"] for ev in merged} == {0, 1}
+    ends = {(ev["proc"], ev["type"]): ev for ev in merged}
+    # Process 1's events shift by its 0.25 s later start.
+    assert ends[(1, "run_start")]["t_aligned"] == pytest.approx(0.25)
+    assert ends[(0, "run_start")]["t_aligned"] == pytest.approx(0.0)
+    assert ends[(1, "run_end")]["t_aligned"] == pytest.approx(1.45)
+    # Sorted by aligned time.
+    times = [ev["t_aligned"] for ev in merged]
+    assert times == sorted(times)
+
+
+def test_straggler_stats(mh_streams):
+    _, merged = aggregate.merge_streams(list(mh_streams))
+    stats = aggregate.straggler_stats(merged)
+    assert stats["processes"] == [0, 1]
+    assert stats["wall_s"] == {0: 1.0, 1: 1.2}
+    solve = stats["phases"]["solve"]
+    assert solve["per_proc_s"] == {0: 0.4, 1: 0.6}
+    assert solve["imbalance_s"] == pytest.approx(0.2)
+    assert solve["skew"] == pytest.approx((0.6 - 0.4) / 0.6, abs=1e-3)
+    report = aggregate.aggregate_report("mhrun0001", merged, stats)
+    assert "process 0" in report and "process 1" in report
+    assert "solve" in report
+
+
+def test_aggregate_cli_writes_merged_stream(mh_streams, tmp_path, capsys):
+    out = tmp_path / "merged.jsonl"
+    rc = aggregate.main([*mh_streams, "-o", str(out), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["run"] == "mhrun0001" and doc["processes"] == [0, 1]
+    merged = obs.read_events(out)
+    assert {ev["proc"] for ev in merged} == {0, 1}
+
+
+def test_per_lane_coverage_on_merged_stream(mh_streams):
+    """Satellite: coverage per process lane, never summed spans over one
+    wall-clock (which would read >100% here: leaf totals 0.4+0.6 s against
+    either single wall)."""
+    _, merged = aggregate.merge_streams(list(mh_streams))
+    prof = summarize.flat_profile(merged)
+    lanes = prof["lanes"]
+    assert lanes[0]["coverage"] == pytest.approx(0.4 / 1.0)
+    assert lanes[1]["coverage"] == pytest.approx(0.6 / 1.2)
+    # The run's duration is the max lane wall, not the sum.
+    assert prof["wall_s"] == 1.2
+    text = summarize.summarize_run(merged, "mhrun0001")
+    assert "process 0: wall-clock" in text
+    assert "process 1: wall-clock" in text
+    assert "merged multihost stream: 2 processes" in text
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+
+def test_trace_export_schema_lanes_and_nesting(mh_streams, tmp_path):
+    _, merged = aggregate.merge_streams(list(mh_streams))
+    aggregate.write_merged(merged, tmp_path / "merged.jsonl")
+    out = tmp_path / "trace.json"
+    assert trace.main([str(tmp_path / "merged.jsonl"),
+                       "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())  # loadable Chrome trace JSON
+    assert isinstance(doc["traceEvents"], list)
+    xs = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    # One lane (pid) per process.
+    assert {ev["pid"] for ev in xs} == {0, 1}
+    names = {ev["name"] for ev in xs}
+    assert names == {"solve", "root"}
+    # Nesting preserved: each lane's child interval sits inside its parent's.
+    for pid in (0, 1):
+        lane = {ev["name"]: ev for ev in xs if ev["pid"] == pid}
+        child, parent = lane["solve"], lane["root"]
+        assert parent["ts"] <= child["ts"]
+        assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] \
+            + 1e-3
+    # Lane metadata names the processes.
+    metas = [ev for ev in doc["traceEvents"]
+             if ev["ph"] == "M" and ev["name"] == "process_name"]
+    assert {m["pid"] for m in metas} == {0, 1}
+
+
+def test_trace_single_process_stream(tmp_path):
+    out = tmp_path / "single.jsonl"
+    with obs.run(metrics_out=str(out)) as rec:
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+    tr = trace.to_chrome_trace(obs.read_events(out), rec.run_id)
+    xs = [ev for ev in tr["traceEvents"] if ev["ph"] == "X"]
+    assert {ev["name"] for ev in xs} == {"outer", "inner"}
+    assert all(ev["pid"] == 0 for ev in xs)
+
+
+def test_trace_unknown_run_errors(tmp_path, capsys):
+    f = tmp_path / "e.jsonl"
+    f.write_text(json.dumps({"type": "run_start", "run": "abc", "seq": 0,
+                             "t": 0.0}) + "\n")
+    assert trace.main([str(f), "--run", "nope"]) == 1
+    assert "not found" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# collective-traffic accounting (real engines, 8-virtual-device CPU mesh)
+
+def test_collective_events_from_blocked_engine(tmp_path):
+    from gauss_tpu.dist import gauss_dist_blocked as gdb
+    from gauss_tpu.dist.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    n, panel = 64, 8
+    rng = np.random.default_rng(1)
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    out = tmp_path / "coll.jsonl"
+    with obs.run(metrics_out=str(out)):
+        np.asarray(gdb.gauss_solve_dist_blocked(a, b, mesh=mesh,
+                                                panel=panel))
+        # Second identical solve: the budget dedupes per (label, shapes).
+        np.asarray(gdb.gauss_solve_dist_blocked(a, b, mesh=mesh,
+                                                panel=panel))
+    colls = [ev for ev in obs.read_events(out)
+             if ev["type"] == "collective"
+             and ev["label"] == "gauss_dist_blocked"]
+    by_op = {ev["op"]: ev for ev in colls}
+    nblocks = n // panel
+    # The design claim, now telemetry: ONE all_gather per panel.
+    assert by_op["all_gather"]["count"] == nblocks
+    # Routing psum + back-sub psum per panel (16 for 8 panels).
+    assert by_op["psum"]["count"] == 2 * nblocks
+    assert all(ev["bytes"] > 0 for ev in colls)
+    assert all(ev["via"] == "jaxpr" for ev in colls)
+    # Dedup held: one event per op despite two identical solves.
+    assert len(colls) == len(by_op)
+    # And the summarizer folds them into the comms section.
+    comms = summarize.comms_summary(obs.read_events(out))
+    assert comms["gauss_dist_blocked"]["count"] == 3 * nblocks + \
+        comms["gauss_dist_blocked"]["ops"].get("pmin", {}).get("count", 0)
+    text = summarize.summarize_run(obs.read_events(out),
+                                   colls[0]["run"])
+    assert "collective traffic" in text and "all_gather" in text
+
+
+def test_collective_budget_matches_direct_jaxpr_count(tmp_path):
+    """The emitted counts must equal an independent jaxpr walk (the same
+    derivation tests/test_dist_blocked.py proves the design claim from)."""
+    import jax
+
+    from gauss_tpu.dist import gauss_dist
+    from gauss_tpu.dist.mesh import make_mesh
+    from gauss_tpu.obs import collectives
+
+    mesh = make_mesh(8)
+    n = 32
+    a = np.eye(n, dtype=np.float32)
+    b = np.zeros(n, dtype=np.float32)
+    staged = gauss_dist.prepare_dist(a, b, mesh)
+    solver = gauss_dist._build_solver(mesh, staged[3], str(staged[0].dtype))
+    budget = collectives.collective_budget(
+        jax.make_jaxpr(solver)(staged[0], staged[1]))
+    out = tmp_path / "b.jsonl"
+    with obs.run(metrics_out=str(out)):
+        np.asarray(gauss_dist.solve_dist_staged(staged, mesh))
+    emitted = {ev["op"]: ev for ev in obs.read_events(out)
+               if ev["type"] == "collective"}
+    assert set(emitted) == set(budget)
+    for op, d in budget.items():
+        assert emitted[op]["count"] == d["count"]
+        assert emitted[op]["bytes"] == d["bytes"]
+    # Per-step protocol: >= 2 collectives per pivot step.
+    total = sum(d["count"] for d in budget.values())
+    assert total >= 2 * staged[3]
+
+
+def test_collective_hlo_path_matmul_dist(tmp_path):
+    """matmul_dist's collectives exist only after SPMD partitioning; the
+    HLO path must still find the output all-gather."""
+    from gauss_tpu.dist.matmul_dist import matmul_dist
+    from gauss_tpu.dist.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    a = np.ones((16, 16), np.float32)
+    out = tmp_path / "mm.jsonl"
+    with obs.run(metrics_out=str(out)):
+        np.asarray(matmul_dist(a, a, mesh=mesh))
+    colls = [ev for ev in obs.read_events(out)
+             if ev["type"] == "collective" and ev["label"] == "matmul_dist"]
+    assert colls, "HLO-derived collective budget missing"
+    assert all(ev["via"] == "hlo" for ev in colls)
+    assert any(ev["op"] == "all_gather" and ev["bytes"] > 0 for ev in colls)
+
+
+def test_record_collective_budget_noop_inactive():
+    assert obs.record_collective_budget("x", lambda: 0) is None
+
+
+# ---------------------------------------------------------------------------
+# environment fingerprint + multihost stream plumbing
+
+def test_run_start_carries_environment_fingerprint(tmp_path):
+    import jax
+
+    out = tmp_path / "fp.jsonl"
+    with obs.run(metrics_out=str(out), tool="fp"):
+        pass
+    start = [ev for ev in obs.read_events(out)
+             if ev["type"] == "run_start"][0]
+    assert start["tool"] == "fp"  # explicit meta untouched
+    assert start["host"] and start["python"]
+    assert start["jax"] == jax.__version__
+    # The test session has an initialized 8-device CPU backend.
+    assert start["backend"] == "cpu"
+    assert start["device_count"] == 8
+    assert start["process_index"] == 0
+
+
+def test_resolve_metrics_stream():
+    from gauss_tpu.dist import multihost
+
+    # Single-process: passthrough.
+    assert multihost.resolve_metrics_stream("m.jsonl") == ("m.jsonl", None)
+    # Multihost coordinates: per-process path + shared deterministic id.
+    p0, r0 = multihost.resolve_metrics_stream(
+        "m.jsonl", coordinator="h:123", process_id=0)
+    p1, r1 = multihost.resolve_metrics_stream(
+        "m.jsonl", coordinator="h:123", process_id=1)
+    assert (p0, p1) == ("m.p0.jsonl", "m.p1.jsonl")
+    assert r0 == r1 and len(r0) == 12
+    # A different launch (different coordinator) gets a different run id.
+    _, r2 = multihost.resolve_metrics_stream("m.jsonl", coordinator="h:999",
+                                             process_id=0)
+    assert r2 != r0
+
+
+def test_resolve_metrics_stream_env_override(monkeypatch):
+    from gauss_tpu.dist import multihost
+
+    monkeypatch.setenv("GAUSS_OBS_RUN_ID", "deadbeef0123")
+    path, rid = multihost.resolve_metrics_stream(
+        "m.jsonl", coordinator="h:123", process_id=1)
+    assert rid == "deadbeef0123" and path == "m.p1.jsonl"
+
+
+def test_obs_run_honors_env_run_id(monkeypatch, tmp_path):
+    monkeypatch.setenv("GAUSS_OBS_RUN_ID", "feedface0000")
+    out = tmp_path / "env.jsonl"
+    with obs.run(metrics_out=str(out)) as rec:
+        pass
+    assert rec.run_id == "feedface0000"
+
+
+# ---------------------------------------------------------------------------
+# summarize --json (machine-readable summary)
+
+def test_summarize_json_payload(tmp_path, capsys):
+    out = tmp_path / "j.jsonl"
+    with obs.run(metrics_out=str(out), tool="jtest") as rec:
+        with obs.span("phase_a"):
+            pass
+        obs.emit("reported_time", name="t", seconds=1.0)
+    assert summarize.main([str(out), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    summary = doc[rec.run_id]
+    assert summary["meta"]["tool"] == "jtest"
+    assert summary["environment"]["backend"] == "cpu"
+    assert "phase_a" in summary["profile"]["phases"]
+    assert summary["reported"][0]["seconds"] == 1.0
+    assert summary["processes"] == [0]
+    assert isinstance(summary["comms"], dict)
+
+
+# ---------------------------------------------------------------------------
+# the regression sentinel
+
+def _write_history(path, values, metric="m"):
+    with open(path, "w") as f:
+        for i, v in enumerate(values):
+            f.write(json.dumps({"metric": metric, "value": v, "unit": "s",
+                                "source": f"e{i}", "kind": "bench"}) + "\n")
+
+
+def test_regress_flags_30pct_slowdown_passes_epoch_noise(tmp_path):
+    """The acceptance pair on a synthetic history: a 30% slowdown is out of
+    band; a value inside the documented ~±10% epoch-noise spread is green."""
+    hist_path = tmp_path / "h.jsonl"
+    _write_history(hist_path, [0.0020, 0.0021, 0.0022, 0.0019, 0.0021])
+    history = regress.load_history(hist_path)
+    base = 0.0021  # the median
+    bad = regress.evaluate("m", base * 1.30, history)
+    assert bad["status"] == "out-of-band"
+    assert "same-epoch A/B" in bad["note"]  # within the 1.5x epoch ceiling
+    worse = regress.evaluate("m", base * 2.0, history)
+    assert worse["status"] == "out-of-band"
+    assert "code regression" in worse["note"]  # beyond the epoch ceiling
+    good = regress.evaluate("m", base * 1.08, history)
+    assert good["status"] == "ok"
+    fast = regress.evaluate("m", base * 0.7, history)
+    assert fast["status"] == "fast"  # a lucky epoch is never a regression
+
+
+def test_regress_committed_history_classifies_r3_r4_swing():
+    """The historical incident, replayed: r4's 2.204 ms against the r1-r3
+    records (median 2.042 ms — including the lucky r3 epoch) is IN band;
+    the manual bisection of docs/BENCH_STABILITY.md becomes a first-
+    occurrence classification. A 30% regression against the full committed
+    history is flagged."""
+    hist = regress.load_history(os.path.join(REPO, "reports",
+                                             "history.jsonl"))
+    assert len(hist) >= 5, "committed history must be seeded from r1-r5"
+    r1_r3 = [r for r in hist
+             if r["source"] in ("BENCH_r01.json", "BENCH_r02.json",
+                                "BENCH_r03.json")]
+    v = regress.evaluate("gauss_n2048_wallclock", 0.002204, r1_r3)
+    assert v["status"] == "ok", v
+    # Every committed record is in band against the full history.
+    for rec in hist:
+        if rec["metric"] != "gauss_n2048_wallclock":
+            continue
+        v = regress.evaluate(rec["metric"], rec["value"], hist)
+        assert v["status"] in ("ok", "fast"), (rec, v)
+    # An injected 30% slowdown over the median is out of band.
+    med = regress.baseline(
+        [r["value"] for r in hist
+         if r["metric"] == "gauss_n2048_wallclock"])["median"]
+    v = regress.evaluate("gauss_n2048_wallclock", med * 1.30, hist)
+    assert v["status"] == "out-of-band", v
+
+
+def test_regress_ingest_bench_record(tmp_path):
+    rec_path = tmp_path / "BENCH.json"
+    rec_path.write_text(json.dumps({
+        "parsed": {"metric": "gauss_n2048_wallclock", "value": 0.002,
+                   "unit": "s", "refined_value": 0.003}}))
+    records = regress.ingest_file(rec_path)
+    assert {r["metric"]: r["value"] for r in records} == {
+        "gauss_n2048_wallclock": 0.002,
+        "gauss_n2048_wallclock:refined": 0.003}
+
+
+def test_regress_ingest_cells_and_obs_stream(tmp_path):
+    cells = tmp_path / "cells.json"
+    cells.write_text(json.dumps([
+        {"suite": "gauss-internal", "key": "64", "backend": "tpu",
+         "seconds": 0.5, "verified": True, "span": "reference"},
+        {"suite": "gauss-internal", "key": "64", "backend": "seq",
+         "seconds": 0.0, "verified": False, "span": "reference"}]))
+    records = regress.ingest_file(cells)
+    # FAILED cells never become baselines.
+    assert [r["metric"] for r in records] == [
+        "cell:gauss-internal/64/tpu"]
+    stream = tmp_path / "s.jsonl"
+    stream.write_text(json.dumps(
+        {"type": "cell", "run": "r", "seq": 1, "t": 0.1,
+         "suite": "matmul", "key": "1024", "backend": "tpu",
+         "seconds": 0.25, "verified": True, "span": "device"}) + "\n")
+    records = regress.ingest_file(stream)
+    assert records[0]["metric"] == "cell:matmul/1024/tpu@device"
+
+
+def test_regress_history_append_is_idempotent(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    recs = [{"metric": "m", "value": 1.0, "unit": "s", "source": "a",
+             "kind": "bench"}]
+    assert regress.append_history(recs, hist) == 1
+    assert regress.append_history(recs, hist) == 0
+    assert len(regress.load_history(hist)) == 1
+
+
+def test_regress_cli_check_gate(tmp_path, capsys):
+    hist = tmp_path / "h.jsonl"
+    _write_history(hist, [1.0, 1.0, 1.0], metric="gauss_n2048_wallclock")
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"parsed": {
+        "metric": "gauss_n2048_wallclock", "value": 1.05, "unit": "s"}}))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"parsed": {
+        "metric": "gauss_n2048_wallclock", "value": 1.35, "unit": "s"}}))
+    assert regress.main(["check", str(ok), "--history", str(hist)]) == 0
+    assert regress.main(["check", str(bad), "--history", str(hist)]) == 1
+    assert "out of band" in capsys.readouterr().out
+
+
+def test_regress_min_samples_informational(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    _write_history(hist, [1.0])
+    v = regress.evaluate("m", 99.0, regress.load_history(hist))
+    assert v["status"] == "no-baseline"
