@@ -4,8 +4,9 @@
 
 PYTHON ?= python
 OBS_SMOKE ?= /tmp/gauss_obs_check.jsonl
+SERVE_SMOKE ?= /tmp/gauss_serve_check
 
-.PHONY: all native test bench datasets obs-check clean
+.PHONY: all native test bench datasets obs-check serve-check clean
 
 all: native
 
@@ -30,6 +31,24 @@ obs-check:
 	  --backend tpu-unblocked --verify --metrics-out $(OBS_SMOKE)
 	$(PYTHON) -m gauss_tpu.obs.summarize $(OBS_SMOKE) --json > /dev/null
 	$(PYTHON) -m gauss_tpu.obs.trace $(OBS_SMOKE) -o $(OBS_SMOKE).trace.json
+
+# The serving gate (CI-callable): a CPU smoke load through the batched
+# serving layer — 50 mixed-size requests over small buckets, every solution
+# verified at the 1e-4 gate (exit 2 on any incorrect), the run gated
+# against the regression history (exit 1 out-of-band) — then the recorded
+# stream is asserted to carry a non-empty serving summary.
+serve-check:
+	rm -rf $(SERVE_SMOKE) && mkdir -p $(SERVE_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.serve.cli --requests 50 \
+	  --warmup 8 --ladder 32,64,128 --seed 258458 \
+	  --mix "random:24*2,random:60,random:100,internal:48" \
+	  --metrics-out $(SERVE_SMOKE)/serve.jsonl \
+	  --summary-json $(SERVE_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(SERVE_SMOKE)/serve.jsonl --json \
+	  | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	sv=[r['serving'] for r in runs.values() if r.get('serving')]; \
+	assert sv and sv[0]['requests'].get('ok', 0) >= 50, sv; \
+	print('serve-check: serving summary ok:', sv[0]['requests'])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

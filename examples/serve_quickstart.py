@@ -1,0 +1,67 @@
+"""Serving quickstart: a long-lived batched solver service in ~30 lines.
+
+Run on any backend (CPU works):
+
+    JAX_PLATFORMS=cpu python examples/serve_quickstart.py
+
+Submits a burst of mixed-size systems (plus one multi-RHS block) to a
+SolverServer, prints per-request lanes/latencies, then a cache + loadgen
+report. See docs/SERVING.md for the architecture and `gauss-serve --help`
+for the full load-test harness.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.serve import ServeConfig, SolverServer
+from gauss_tpu.serve.loadgen import LoadgenConfig, format_summary, run_load
+
+
+def system(rng, n, k=None):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)  # well-conditioned
+    b = rng.standard_normal(n) if k is None else rng.standard_normal((n, k))
+    return a, b
+
+
+def main():
+    rng = np.random.default_rng(258458)
+    cfg = ServeConfig(ladder=(64, 128, 256), max_batch=8,
+                      refine_steps=1, verify_gate=1e-4)
+    with obs.run(tool="serve_quickstart"):
+        with SolverServer(cfg) as srv:
+            # A burst of async submissions: same-bucket requests batch into
+            # single vmapped device steps; repeated shapes hit the
+            # executable cache.
+            handles = [srv.submit(*system(rng, n))
+                       for n in (50, 60, 120, 64, 200, 120, 50)]
+            # Multi-RHS: one factorization, a block of right-hand sides.
+            handles.append(srv.submit(*system(rng, 100, k=4)))
+            for h in handles:
+                res = h.result(timeout=300)
+                shape = res.x.shape if res.ok else None
+                print(f"  request n={res.x.shape[0] if res.ok else '?'} "
+                      f"-> {res.status:8s} lane={res.lane:8s} "
+                      f"bucket={res.bucket_n} x{shape} "
+                      f"latency={res.latency_s:.4f}s")
+            print("cache:", srv.cache.stats())
+
+            # The same server under a small closed-loop load test.
+            summary = run_load(srv, LoadgenConfig(
+                mix="random:50*2,random:120,internal:64",
+                requests=24, warmup=4, concurrency=4, serve=cfg))
+    print(format_summary(summary))
+    assert summary["incorrect"] == 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1192,8 +1192,16 @@ def solve_handoff(a, b, budget: int | None = None, mesh=None,
     engine's aggregate memory; with no multi-device mesh available that is
     an explicit error, not an OOM.
     """
+    from gauss_tpu import obs
+
     n = np.shape(a)[0]
+    eff_budget = budget if budget is not None else device_memory_budget()
+    est_bytes = 3 * n * n * 4
     if fits_single_chip(n, budget=budget):
+        # The routing decision as data (serve-lane traces show WHY a request
+        # took a lane): estimated working set vs the budget that admitted it.
+        obs.emit("route", tool="solve_handoff", n=n, lane="single_chip",
+                 est_bytes=est_bytes, budget=eff_budget)
         return solve_refined(a, b, panel=panel, iters=iters, tol=tol,
                              **single_chip_kwargs)[0]
     from gauss_tpu.dist.gauss_dist_blocked import \
@@ -1207,11 +1215,13 @@ def solve_handoff(a, b, budget: int | None = None, mesh=None,
     if mesh is None:
         mesh = make_mesh()
     if mesh.devices.size < 2:
-        eff = budget if budget is not None else device_memory_budget()
         raise ValueError(
-            f"n={n} exceeds the single-chip budget (needs ~{3 * n * n * 4} "
-            f"bytes, budget {eff}) and only {mesh.devices.size} device is "
-            f"visible; provide a multi-device mesh (the sharded blocked "
-            f"engine splits the working set across chips)")
+            f"n={n} exceeds the single-chip budget (needs ~{est_bytes} "
+            f"bytes, budget {eff_budget}) and only {mesh.devices.size} "
+            f"device is visible; provide a multi-device mesh (the sharded "
+            f"blocked engine splits the working set across chips)")
+    obs.emit("route", tool="solve_handoff", n=n, lane="dist",
+             est_bytes=est_bytes, budget=eff_budget,
+             devices=int(mesh.devices.size))
     return gauss_solve_dist_blocked_refined(a, b, mesh=mesh, panel=panel,
                                             iters=iters, tol=tol)

@@ -107,6 +107,19 @@ def ingest_file(path) -> List[Dict[str, Any]]:
                 if rec:
                     records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "serve_loadgen":
+        # A gauss-serve --summary-json report: the serving layer's
+        # throughput/latency enter the same history the solve benchmarks
+        # gate on. Metric derivation lives with the loadgen (single
+        # source); imported lazily so reading BENCH records never pulls
+        # the serving stack (or jax) into this module.
+        from gauss_tpu.serve.loadgen import history_records
+
+        for metric, value in history_records(doc):
+            rec = _record(metric, value, path, "serve")
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, list):  # bench-grid --json cells
         for cell in doc:
             if isinstance(cell, dict) and cell.get("verified"):

@@ -136,6 +136,97 @@ def comms_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def serving_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the serving layer's events (``serve_request`` / ``serve_batch``
+    / ``serve_cache`` / ``serve_retry`` / ``serve_fallback`` / handoff
+    ``route``) into one report: request counts by status and lane, latency
+    percentiles, batch occupancy, and cache hit/miss — the summarizer-side
+    mirror of the loadgen report, but computed from ANY recorded stream
+    (a production server's run, not just a load test). Empty dict when the
+    run did no serving."""
+    reqs = [ev for ev in events if ev.get("type") == "serve_request"]
+    batches = [ev for ev in events if ev.get("type") == "serve_batch"]
+    caches = [ev for ev in events if ev.get("type") == "serve_cache"]
+    retries = [ev for ev in events if ev.get("type") == "serve_retry"]
+    fallbacks = [ev for ev in events if ev.get("type") == "serve_fallback"]
+    routes = [ev for ev in events if ev.get("type") == "route"
+              and ev.get("tool") == "solve_handoff"]
+    if not (reqs or batches or caches):
+        return {}
+    by_status: Dict[str, int] = {}
+    by_lane: Dict[str, int] = {}
+    lat: List[float] = []
+    for ev in reqs:
+        st = str(ev.get("status", "?"))
+        by_status[st] = by_status.get(st, 0) + 1
+        lane = ev.get("lane")
+        if lane:
+            by_lane[str(lane)] = by_lane.get(str(lane), 0) + 1
+        if st == "ok" and isinstance(ev.get("latency_s"), (int, float)):
+            lat.append(float(ev["latency_s"]))
+    lat.sort()
+
+    def _pct(q: float):
+        return lat[min(len(lat) - 1, int(q * len(lat)))] if lat else None
+
+    occ = [float(ev["occupancy"]) for ev in batches
+           if isinstance(ev.get("occupancy"), (int, float))]
+    cache_counts = {"hit": 0, "miss": 0, "evict": 0}
+    for ev in caches:
+        k = str(ev.get("event", "?"))
+        cache_counts[k] = cache_counts.get(k, 0) + 1
+    lookups = cache_counts["hit"] + cache_counts["miss"]
+    route_lanes: Dict[str, int] = {}
+    for ev in routes:
+        lane = str(ev.get("lane", "?"))
+        route_lanes[lane] = route_lanes.get(lane, 0) + 1
+    return {
+        "requests": by_status,
+        "lanes": by_lane,
+        "retries": len(retries),
+        "fallbacks": len(fallbacks),
+        "latency_s": {"count": len(lat),
+                      "mean": sum(lat) / len(lat) if lat else None,
+                      "p50": _pct(0.50), "p95": _pct(0.95),
+                      "p99": _pct(0.99)},
+        "batches": {"count": len(batches),
+                    "occupancy_mean": sum(occ) / len(occ) if occ else None},
+        "cache": {**cache_counts,
+                  "hit_rate": (cache_counts["hit"] / lookups
+                               if lookups else None)},
+        "handoff_routes": route_lanes,
+    }
+
+
+def _serving_lines(sv: Dict[str, Any]) -> List[str]:
+    def _f(v):
+        return "-" if v is None else _fmt(round(v, 6) if isinstance(v, float)
+                                          else v)
+
+    lines = []
+    req = ", ".join(f"{k}={v}" for k, v in sorted(sv["requests"].items()))
+    lane = ", ".join(f"{k}={v}" for k, v in sorted(sv["lanes"].items()))
+    lines.append(f"  requests: {req or '-'}" + (f"  lanes: {lane}" if lane
+                                                else ""))
+    lat = sv["latency_s"]
+    lines.append(f"  latency s: p50 {_f(lat['p50'])}  p95 {_f(lat['p95'])}  "
+                 f"p99 {_f(lat['p99'])}  (n={lat['count']})")
+    b = sv["batches"]
+    c = sv["cache"]
+    lines.append(f"  batches: {b['count']}, mean occupancy "
+                 f"{_f(b['occupancy_mean'])}; cache: {c['hit']} hits / "
+                 f"{c['miss']} misses (hit-rate {_f(c['hit_rate'])}), "
+                 f"{c['evict']} evictions")
+    if sv["retries"] or sv["fallbacks"]:
+        lines.append(f"  degradation: {sv['retries']} retried batch "
+                     f"attempt(s), {sv['fallbacks']} fallback-lane trip(s)")
+    if sv["handoff_routes"]:
+        routes = ", ".join(f"{k} x{v}"
+                           for k, v in sorted(sv["handoff_routes"].items()))
+        lines.append(f"  solve_handoff routing: {routes}")
+    return lines
+
+
 def _human_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -189,6 +280,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
                      if ev.get("type") == "reported_time"],
         "profile": flat_profile(evs),
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
+        "serving": serving_summary(evs),
         "comms": comms_summary(evs),
         "compile": [_strip(ev) for ev in evs
                     if ev.get("type") in ("compile", "cost")],
@@ -233,6 +325,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("numerical health:")
         for ev in health:
             out.append("  " + _event_kv(ev))
+
+    serving = serving_summary(evs)
+    if serving:
+        out.append("")
+        out.append("serving:")
+        out.extend(_serving_lines(serving))
 
     comms = comms_summary(evs)
     if comms:

@@ -1,0 +1,48 @@
+"""gauss_tpu.serve — batched solver serving on top of the solver tiers.
+
+The reference is twelve one-shot binaries; the ROADMAP north star is a
+service. This package is that layer: a long-lived in-process server that
+pads arbitrary-``n`` requests onto a small shape-bucket ladder, drains a
+bounded queue into ``vmap``-batched blocked-LU solves through an LRU cache
+of jitted executables, routes oversized systems through ``solve_handoff``,
+and degrades to a host NumPy lane when the device lane is persistently
+unhealthy — with admission control (queue bounds + deadlines) in front and
+an open/closed-loop load generator beside it. Everything emits obs events,
+so ``summarize``/``trace``/``regress`` cover serving the same way they
+cover solves.
+
+Quick tour::
+
+    from gauss_tpu.serve import ServeConfig, SolverServer
+
+    with SolverServer(ServeConfig(verify_gate=1e-4)) as srv:
+        res = srv.solve(a, b)            # pads, batches, caches, verifies
+        assert res.ok
+        x = res.x
+
+    # Load-test it:  gauss-serve --requests 200 --mix random:100*3,random:300
+"""
+
+from gauss_tpu.serve.admission import (  # noqa: F401
+    STATUS_EXPIRED,
+    STATUS_FAILED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    LaneHealth,
+    ServeConfig,
+    ServeRequest,
+    ServeResult,
+)
+from gauss_tpu.serve.buckets import (  # noqa: F401
+    DEFAULT_LADDER,
+    bucket_for,
+    pad_system,
+    pow2_bucket,
+    unpad_solution,
+)
+from gauss_tpu.serve.cache import (  # noqa: F401
+    BatchedExecutable,
+    CacheKey,
+    ExecutableCache,
+)
+from gauss_tpu.serve.server import SolverServer  # noqa: F401

@@ -1,0 +1,189 @@
+"""Admission control: request/response types, deadlines, retry, lane health.
+
+The serving layer degrades gracefully instead of falling over (ROADMAP north
+star: heavy traffic). Three mechanisms, in the order a request meets them:
+
+- **Queue-full rejection with retry-after.** The request queue is bounded;
+  a submit against a full queue is rejected immediately with a retry-after
+  hint derived from the recent drain rate, so clients back off instead of
+  building an unbounded memory balloon inside the server.
+- **Deadlines.** A request may carry a relative deadline; the worker drops
+  expired requests at drain time — BEFORE padding, H2D, or compute — so a
+  latency spike sheds exactly the work whose answer nobody is waiting for.
+- **Retry + fallback lane.** A batch that fails with a transient device
+  error is retried with exponential backoff; requests that exhaust retries
+  fail individually. When the device lane fails persistently
+  (``unhealthy_after`` consecutive batch failures) the server trips into a
+  NumPy fallback lane (host LAPACK ``solve`` — slow but always available)
+  and probes the device lane again after a cooldown, the classic
+  circuit-breaker shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+# Request terminal states.
+STATUS_OK = "ok"
+STATUS_REJECTED = "rejected"      # queue full — never entered the queue
+STATUS_EXPIRED = "expired"        # deadline passed before compute
+STATUS_FAILED = "failed"          # lane error after retries
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Tuning knobs for :class:`gauss_tpu.serve.server.SolverServer`."""
+
+    ladder: tuple = ()              # () -> buckets.DEFAULT_LADDER
+    max_batch: int = 8              # dynamic-batching ceiling per dispatch
+    max_queue: int = 256            # admission bound (queue-full rejection)
+    batch_linger_s: float = 0.0     # wait this long for same-bucket company
+    cache_capacity: int = 32        # LRU executable-cache entries
+    refine_steps: int = 1           # host-f64 refinement rounds per batch
+    panel: Optional[int] = None     # blocked-solver panel (None -> auto)
+    engine: str = "blocked"         # batched lane engine label (cache key)
+    max_retries: int = 2            # transient-failure retries per batch
+    retry_backoff_s: float = 0.05   # base backoff (doubles per attempt)
+    unhealthy_after: int = 3        # consecutive failures that trip fallback
+    device_probe_cooldown_s: float = 5.0  # how long fallback lane holds
+    deadline_default_s: Optional[float] = None  # applied when request has none
+    verify_gate: Optional[float] = None  # rel-residual bar; None = no check
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a completed (or refused) request resolves to."""
+
+    status: str
+    x: Optional[np.ndarray] = None
+    lane: Optional[str] = None       # "batched" | "handoff" | "numpy"
+    bucket_n: Optional[int] = None
+    latency_s: Optional[float] = None
+    queue_s: Optional[float] = None
+    retry_after_s: Optional[float] = None
+    error: Optional[str] = None
+    rel_residual: Optional[float] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+class ServeRequest:
+    """One queued solve: operands, deadline, and a completion latch."""
+
+    _ids = iter(range(1, 1 << 62))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, a: np.ndarray, b: np.ndarray,
+                 deadline_s: Optional[float] = None):
+        with ServeRequest._ids_lock:
+            self.id = next(ServeRequest._ids)
+        self.a = np.asarray(a)
+        self.b = np.asarray(b)
+        self.n = self.a.shape[0]
+        if self.a.shape != (self.n, self.n):
+            raise ValueError(f"expected square matrix, got {self.a.shape}")
+        if self.b.shape[:1] != (self.n,) or self.b.ndim > 2:
+            raise ValueError(
+                f"b must be (n,) or (n, k) with n={self.n}, got {self.b.shape}")
+        self.was_vector = self.b.ndim == 1
+        self.k = 1 if self.was_vector else self.b.shape[1]
+        self.t_submit = time.perf_counter()
+        self.deadline = (self.t_submit + deadline_s
+                         if deadline_s is not None else None)
+        self._done = threading.Event()
+        self._result: Optional[ServeResult] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
+
+    def resolve(self, result: ServeResult) -> None:
+        result.latency_s = time.perf_counter() - self.t_submit
+        self._result = result
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        """Block until the request resolves (the client-side wait)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still pending")
+        return self._result  # type: ignore[return-value]
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class LaneHealth:
+    """Circuit breaker for the device lane (thread-safe).
+
+    Healthy until ``unhealthy_after`` CONSECUTIVE batch failures; then the
+    device lane is held open (fallback serves) for ``cooldown_s``, after
+    which ONE probe batch is allowed through — success closes the circuit,
+    failure re-opens it for another cooldown.
+    """
+
+    def __init__(self, unhealthy_after: int, cooldown_s: float):
+        self.unhealthy_after = max(1, int(unhealthy_after))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._open_until: Optional[float] = None
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._open_until = None
+
+    def record_failure(self) -> bool:
+        """Count one batch failure; returns True when this trips the lane."""
+        with self._lock:
+            self._consecutive += 1
+            tripped = (self._consecutive >= self.unhealthy_after
+                       and self._open_until is None)
+            if self._consecutive >= self.unhealthy_after:
+                self._open_until = time.perf_counter() + self.cooldown_s
+            return tripped
+
+    def device_allowed(self) -> bool:
+        """May the next batch try the device lane? (True once per cooldown
+        expiry — the probe; steady-state True when healthy.)"""
+        with self._lock:
+            if self._open_until is None:
+                return True
+            if time.perf_counter() >= self._open_until:
+                # Let one probe through; a failure re-opens via record_failure.
+                self._open_until = None
+                self._consecutive = self.unhealthy_after - 1
+                return True
+            return False
+
+    @property
+    def open(self) -> bool:
+        with self._lock:
+            return (self._open_until is not None
+                    and time.perf_counter() < self._open_until)
+
+
+def retry_backoff(base_s: float, attempt: int) -> float:
+    """Exponential backoff delay for retry ``attempt`` (0-based)."""
+    return base_s * (2 ** attempt)
+
+
+def is_transient_device_error(e: BaseException) -> bool:
+    """Heuristic for retryable device failures vs programming errors.
+
+    Shape/value errors are deterministic — retrying replays the bug — while
+    runtime/device errors (XlaRuntimeError, RESOURCE_EXHAUSTED, tunnel
+    hiccups) are worth a bounded retry and count against lane health.
+    """
+    if isinstance(e, (ValueError, TypeError)):
+        return False
+    return True

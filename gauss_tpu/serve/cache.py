@@ -1,0 +1,165 @@
+"""Shape-bucketed LRU cache of jitted batched-solve executables.
+
+The whole point of bucketing (serve.buckets) is that the set of shapes the
+service ever compiles is bounded; this module is the bound. A cache entry is
+one :class:`BatchedExecutable` — a ``vmap``-batched blocked LU factor+solve
+pair, jitted and warmed at its exact ``(batch, bucket_n, nrhs)`` shape — and
+the cache holds at most ``capacity`` of them in LRU order, keyed
+
+    (bucket_n, nrhs_bucket, batch_bucket, dtype, engine, refine_steps, mesh)
+
+which is everything that changes the compiled program. ``mesh`` is None for
+the single-chip batched lane (oversized requests route through
+``solve_handoff`` and are never cached here); it sits in the key so a future
+sharded batched lane slots in without a schema change.
+
+Every hit/miss/evict is an obs event (``serve_cache``) plus counters, so the
+loadgen's cache hit-rate is computed from the same stream the summarizer
+renders.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from gauss_tpu import obs
+
+
+class CacheKey(NamedTuple):
+    bucket_n: int
+    nrhs: int
+    batch: int
+    dtype: str
+    engine: str
+    refine_steps: int
+    mesh: Optional[str] = None
+
+
+class BatchedExecutable:
+    """One compiled lane: vmapped blocked factor + solve at a fixed shape.
+
+    ``factor`` and ``solve`` are jit-compiled over the BATCH axis — one
+    device step factors all B systems and one more back-solves all B right-
+    hand sides (the MAGMA-batched execution shape). Refinement reuses the
+    batched factors: each step is one host-f64 batched residual (O(B n^2)
+    matvec work) plus one more batched device solve — no refactorization.
+    """
+
+    def __init__(self, key: CacheKey, panel: Optional[int] = None):
+        import jax
+
+        from gauss_tpu.core import blocked
+
+        self.key = key
+        self.panel = panel
+        dtype = np.dtype(key.dtype)
+
+        def factor_one(a):
+            return blocked.lu_factor_blocked(a, panel=panel)
+
+        def solve_one(fac, b):
+            return blocked.lu_solve(fac, b)
+
+        self._factor = jax.jit(jax.vmap(factor_one))
+        self._solve = jax.jit(jax.vmap(solve_one))
+        # Compile at the exact serving shape now (identity systems), so the
+        # one-time cost lands on the miss that created the entry — never
+        # inside a later request's compute window.
+        with obs.compile_span("serve_executable", bucket_n=key.bucket_n,
+                              nrhs=key.nrhs, batch=key.batch,
+                              dtype=key.dtype, engine=key.engine):
+            eye = np.broadcast_to(np.eye(key.bucket_n, dtype=dtype),
+                                  (key.batch, key.bucket_n, key.bucket_n))
+            zer = np.zeros((key.batch, key.bucket_n, key.nrhs), dtype=dtype)
+            fac = self._factor(np.ascontiguousarray(eye))
+            jax.block_until_ready(self._solve(fac, zer))
+
+    def solve(self, a_pad: np.ndarray, b_pad: np.ndarray) -> np.ndarray:
+        """Solve the padded batch; returns float64 (B, bucket_n, nrhs).
+
+        ``a_pad``/``b_pad`` are host float64 stacks at the cached shape.
+        The device factors/solves in the key dtype; ``refine_steps`` rounds
+        of host-f64 iterative refinement through the SAME batched factors
+        recover the f64-residual accuracy the one-shot solvers get from
+        ``solve_refined`` (each round: one batched residual + one batched
+        device solve).
+        """
+        dtype = np.dtype(self.key.dtype)
+        fac = self._factor(a_pad.astype(dtype))
+        x = np.asarray(self._solve(fac, b_pad.astype(dtype)),
+                       dtype=np.float64)
+        for _ in range(self.key.refine_steps):
+            r = b_pad - np.einsum("bij,bjk->bik", a_pad, x)
+            d = np.asarray(self._solve(fac, r.astype(dtype)),
+                           dtype=np.float64)
+            x = x + d
+        return x
+
+
+class ExecutableCache:
+    """Bounded LRU over :class:`BatchedExecutable` entries (thread-safe)."""
+
+    def __init__(self, capacity: int = 32):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._entries: "OrderedDict[CacheKey, BatchedExecutable]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: CacheKey,
+            builder: Optional[Callable[[CacheKey], BatchedExecutable]] = None,
+            panel: Optional[int] = None) -> BatchedExecutable:
+        """The cached executable for ``key``, building (and possibly
+        evicting the least-recently-used entry) on a miss."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                obs.counter("serve.cache.hits")
+                obs.emit("serve_cache", event="hit", **key._asdict())
+                return entry
+            self.misses += 1
+        # Build OUTSIDE the lock: compiles take seconds and a hit on a
+        # different key must not wait behind them.
+        obs.counter("serve.cache.misses")
+        obs.emit("serve_cache", event="miss", **key._asdict())
+        entry = (builder or (lambda k: BatchedExecutable(k, panel=panel)))(key)
+        with self._lock:
+            # A racing miss may have inserted the same key; last write wins
+            # and both callers hold a valid executable.
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                evicted, _ = self._entries.popitem(last=False)
+                self.evictions += 1
+                obs.counter("serve.cache.evictions")
+                obs.emit("serve_cache", event="evict", **evicted._asdict())
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries.keys())
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "entries": len(self),
+                "capacity": self.capacity,
+                "hit_rate": round(self.hit_rate, 4)}

@@ -1,0 +1,156 @@
+"""``gauss-serve`` — drive the batched solver service under load.
+
+Runs the open/closed-loop load generator (gauss_tpu.serve.loadgen) against
+an in-process :class:`SolverServer`, prints the serving report, and
+optionally: writes the machine-readable summary JSON, records the run in
+the benchmark-regression history (``reports/history.jsonl``), and gates it
+against that history (``--regress-check``) — the serving analog of
+``bench.py --regress``.
+
+Examples::
+
+    # CPU smoke load (what `make serve-check` runs):
+    JAX_PLATFORMS=cpu gauss-serve --requests 50 \
+        --mix random:96*2,random:200,internal:160 --metrics-out serve.jsonl
+
+    # Open-loop at 80 req/s with deadlines, summary + history:
+    gauss-serve --mode open --rate 80 --requests 500 --deadline 0.5 \
+        --summary-json serve_summary.json --history --regress-check
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gauss-serve",
+        description="Batched solver serving load test: request queue, "
+                    "shape-bucketed executable cache, admission control.")
+    p.add_argument("--mix", default="random:100*2,random:200,internal:160",
+                   help="weighted workload tokens kind:arg[*weight] "
+                        "(kinds: random:<n>, internal:<n>, dat:<path>, "
+                        "dataset:<name>)")
+    p.add_argument("--requests", type=int, default=50,
+                   help="measured request count (default 50)")
+    p.add_argument("--warmup", type=int, default=8,
+                   help="warmup requests excluded from the report "
+                        "(default 8)")
+    p.add_argument("--mode", choices=("closed", "open"), default="closed",
+                   help="closed: N clients submit+wait; open: Poisson "
+                        "arrivals at --rate regardless of completions")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop client threads (default 4)")
+    p.add_argument("--rate", type=float, default=50.0,
+                   help="open-loop arrival rate, requests/s (default 50)")
+    p.add_argument("--nrhs", type=int, default=1,
+                   help="right-hand-side columns per request (default 1)")
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="per-request deadline in seconds (expired requests "
+                        "are shed before compute)")
+    p.add_argument("--seed", type=int, default=258458)
+    # -- server knobs -----------------------------------------------------
+    p.add_argument("--ladder", default=None,
+                   help="comma-separated bucket sizes (default: 128,256,"
+                        "...,4096 — panel-aligned powers of two)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--cache-capacity", type=int, default=32)
+    p.add_argument("--refine-steps", type=int, default=1,
+                   help="host-f64 refinement rounds per batch (default 1)")
+    p.add_argument("--linger", type=float, default=0.0, metavar="S",
+                   help="batching linger: wait this long for same-bucket "
+                        "company before dispatching (default 0)")
+    p.add_argument("--panel", type=int, default=None,
+                   help="blocked-solver panel width (default: auto)")
+    # -- outputs ----------------------------------------------------------
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="append the run's obs JSONL event stream here "
+                        "(summarize/trace/aggregate-compatible)")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the serving report as JSON (regress-"
+                        "ingestable: kind=serve_loadgen)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append this run's throughput/latency records to "
+                        "the regression history (default "
+                        "reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate this run against the history baselines "
+                        "(exit 1 when out of band)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    honor_jax_platforms()
+
+    from gauss_tpu import obs
+    from gauss_tpu.obs import regress
+    from gauss_tpu.serve import buckets
+    from gauss_tpu.serve.admission import ServeConfig
+    from gauss_tpu.serve.loadgen import (
+        LoadgenConfig,
+        format_summary,
+        history_records,
+        run_load,
+        write_summary,
+    )
+    from gauss_tpu.serve.server import SolverServer
+
+    ladder = ()
+    if args.ladder:
+        ladder = buckets.validate_ladder(
+            int(r) for r in args.ladder.split(","))
+    serve_cfg = ServeConfig(
+        ladder=ladder, max_batch=args.max_batch, max_queue=args.max_queue,
+        batch_linger_s=args.linger, cache_capacity=args.cache_capacity,
+        refine_steps=args.refine_steps, panel=args.panel)
+    cfg = LoadgenConfig(
+        mix=args.mix, requests=args.requests, warmup=args.warmup,
+        mode=args.mode, concurrency=args.concurrency, rate=args.rate,
+        nrhs=args.nrhs, seed=args.seed, deadline_s=args.deadline,
+        serve=serve_cfg)
+
+    with obs.run(metrics_out=args.metrics_out, tool="gauss_serve",
+                 mode=args.mode, mix=args.mix, requests=args.requests):
+        with SolverServer(serve_cfg) as server:
+            summary = run_load(server, cfg)
+    print(format_summary(summary))
+    if args.metrics_out:
+        print(f"metrics: {args.metrics_out}")
+
+    if args.summary_json:
+        write_summary(summary, args.summary_json)
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    records = [{"metric": m, "value": v, "unit": "s",
+                "source": "gauss-serve", "kind": "serve"}
+               for m, v in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(records,
+                                         regress.load_history(history_path))
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = 1
+    if args.history is not None and records and rc == 0:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+
+    if summary["incorrect"]:
+        print(f"gauss-serve: {summary['incorrect']} INCORRECT solution(s) "
+              f"(relative residual above {cfg.verify_gate:g})",
+              file=sys.stderr)
+        rc = max(rc, 2)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,384 @@
+"""SolverServer: the long-lived batched solving service.
+
+Turns the one-shot solvers into a service loop (ROADMAP north star): clients
+``submit`` systems and block on per-request results; a single worker thread
+drains the bounded queue in SAME-BUCKET batches and dispatches each batch as
+one ``vmap``-batched blocked LU solve through the shape-bucketed executable
+cache. Three lanes:
+
+- **batched** — requests whose padded size fits the bucket ladder; the hot
+  lane (amortized compile via serve.cache, one device step per batch).
+- **handoff** — oversized systems (past the ladder top); routed one at a
+  time through :func:`core.blocked.solve_handoff`, which itself picks
+  single-chip vs distributed and now emits its routing decision as an obs
+  ``route`` event, so serve traces show WHY a request took the slow lane.
+- **numpy** — the degraded lane: host LAPACK ``solve`` when the device lane
+  is persistently unhealthy (admission.LaneHealth circuit breaker), so the
+  service returns correct-but-slow answers instead of errors while the
+  device recovers.
+
+Everything observable lands on the active obs recorder: per-request
+``serve_request`` events (status, lane, latencies), per-batch ``serve_batch``
+events (occupancy), cache/retry/fallback events, and the latency histogram —
+the summarizer's "serving" section and the loadgen report both read this one
+stream.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.serve import buckets
+from gauss_tpu.serve.admission import (
+    STATUS_EXPIRED,
+    STATUS_FAILED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    LaneHealth,
+    ServeConfig,
+    ServeRequest,
+    ServeResult,
+    is_transient_device_error,
+    retry_backoff,
+)
+from gauss_tpu.serve.cache import CacheKey, ExecutableCache
+
+
+class SolverServer:
+    """In-process batched solver service (start() ... submit() ... stop()).
+
+    The service boundary is a thread-safe Python API rather than a network
+    socket: the interesting serving problems at this layer — batching,
+    executable caching, admission, degradation — are transport-independent,
+    and an RPC front end would wrap ``submit`` without changing any of them.
+    """
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self.ladder = buckets.validate_ladder(
+            self.config.ladder or buckets.DEFAULT_LADDER)
+        self.cache = ExecutableCache(self.config.cache_capacity)
+        self.health = LaneHealth(self.config.unhealthy_after,
+                                 self.config.device_probe_cooldown_s)
+        self._queue: "_queue.Queue[ServeRequest]" = _queue.Queue()
+        self._depth = 0                   # admission-visible queue depth
+        self._depth_lock = threading.Lock()
+        self._drain_rate = 0.0            # EWMA requests/s, for retry-after
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.batches = 0
+        self.requests_served = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "SolverServer":
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._run, name="gauss-serve",
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the worker; with ``drain`` (default) pending requests are
+        served first, otherwise they resolve as rejected."""
+        if self._worker is not None:
+            if drain:
+                deadline = time.monotonic() + timeout
+                while self._depth_snapshot() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+            self._stop.set()
+            self._queue.put(None)  # type: ignore[arg-type] # wake the worker
+            self._worker.join(timeout=timeout)
+            self._worker = None
+        # Anything still queued after a non-drain stop is refused, not lost.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if req is not None and not req.done:
+                self._depth_add(-1)
+                req.resolve(ServeResult(status=STATUS_REJECTED,
+                                        error="server stopped"))
+
+    def __enter__(self) -> "SolverServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission --------------------------------------------------------
+
+    def _depth_add(self, d: int) -> int:
+        with self._depth_lock:
+            self._depth += d
+            return self._depth
+
+    def _depth_snapshot(self) -> int:
+        with self._depth_lock:
+            return self._depth
+
+    def retry_after_hint(self) -> float:
+        """Seconds until a full queue has likely drained one batch's worth
+        (from the EWMA drain rate; a floor keeps the hint meaningful before
+        any batch has completed)."""
+        rate = max(self._drain_rate, 1e-3)
+        return round(min(60.0, max(0.01, self.config.max_batch / rate)), 4)
+
+    def submit(self, a, b, deadline_s: Optional[float] = None,
+               ) -> ServeRequest:
+        """Enqueue one system. Returns the request handle immediately; a
+        queue-full rejection resolves the handle synchronously with
+        ``retry_after_s`` set (the client never blocks to learn it was
+        refused)."""
+        if deadline_s is None:
+            deadline_s = self.config.deadline_default_s
+        req = ServeRequest(a, b, deadline_s=deadline_s)
+        if self._depth_snapshot() >= self.config.max_queue:
+            hint = self.retry_after_hint()
+            obs.counter("serve.rejected")
+            obs.emit("serve_request", id=req.id, n=req.n, status=STATUS_REJECTED,
+                     reason="queue_full", retry_after_s=hint,
+                     queue_depth=self._depth_snapshot())
+            req.resolve(ServeResult(status=STATUS_REJECTED,
+                                    retry_after_s=hint, error="queue full"))
+            return req
+        self._depth_add(1)
+        obs.counter("serve.submitted")
+        self._queue.put(req)
+        return req
+
+    def solve(self, a, b, deadline_s: Optional[float] = None,
+              timeout: Optional[float] = 300.0) -> ServeResult:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(a, b, deadline_s=deadline_s).result(timeout)
+
+    # -- worker loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req = self._queue.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if req is None:
+                continue
+            batch = [req]
+            if req.n <= self.ladder[-1]:
+                batch.extend(self._drain_same_bucket(req))
+            self._depth_add(-len(batch))
+            t0 = time.perf_counter()
+            served = self._dispatch(batch)
+            dt = time.perf_counter() - t0
+            if dt > 0 and served:
+                inst = served / dt
+                self._drain_rate = (0.7 * self._drain_rate + 0.3 * inst
+                                    if self._drain_rate else inst)
+
+    def _drain_same_bucket(self, first: ServeRequest):
+        """Collect queued requests that share ``first``'s size bucket, up to
+        max_batch, optionally lingering for late same-bucket arrivals.
+        Different-bucket requests go straight back on the queue (order among
+        survivors is preserved by the FIFO)."""
+        want = buckets.bucket_for(first.n, self.ladder)
+        got, requeue = [], []
+        deadline = time.monotonic() + self.config.batch_linger_s
+        while len(got) + 1 < self.config.max_batch:
+            try:
+                nxt = self._queue.get_nowait()
+            except _queue.Empty:
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.001)
+                continue
+            if nxt is None:
+                continue
+            if (nxt.n <= self.ladder[-1]
+                    and buckets.bucket_for(nxt.n, self.ladder) == want):
+                got.append(nxt)
+            else:
+                requeue.append(nxt)
+        for r in requeue:
+            self._queue.put(r)
+        return got
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _dispatch(self, batch) -> int:
+        """Serve one same-bucket batch (or one oversized request); returns
+        the number of requests resolved."""
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.expired(now):
+                obs.counter("serve.expired")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_EXPIRED)
+                req.resolve(ServeResult(status=STATUS_EXPIRED,
+                                        error="deadline expired before "
+                                              "compute"))
+            else:
+                live.append(req)
+        if not live:
+            return len(batch)
+        if live[0].n > self.ladder[-1]:
+            for req in live:
+                self._serve_handoff(req)
+            return len(batch)
+        self._serve_batched(live)
+        return len(batch)
+
+    def _serve_batched(self, reqs) -> None:
+        cfg = self.config
+        bucket_n = buckets.bucket_for(reqs[0].n, self.ladder)
+        nrhs = buckets.pow2_bucket(max(r.k for r in reqs))
+        bb = buckets.pow2_bucket(len(reqs), cap=cfg.max_batch)
+        key = CacheKey(bucket_n=bucket_n, nrhs=nrhs, batch=bb,
+                       dtype="float32", engine=cfg.engine,
+                       refine_steps=cfg.refine_steps, mesh=None)
+
+        if not self.health.device_allowed():
+            obs.counter("serve.fallback_batches")
+            for req in reqs:
+                self._serve_numpy(req)
+            return
+
+        with obs.span("serve_batch_pad", bucket_n=bucket_n, batch=len(reqs)):
+            a_pad = np.empty((bb, bucket_n, bucket_n), dtype=np.float64)
+            b_pad = np.zeros((bb, bucket_n, nrhs), dtype=np.float64)
+            for i, req in enumerate(reqs):
+                a_pad[i], b_pad[i] = buckets.pad_system(
+                    req.a.astype(np.float64), req.b.astype(np.float64),
+                    bucket_n, nrhs)
+            for i in range(len(reqs), bb):  # batch padding: identity systems
+                a_pad[i] = np.eye(bucket_n)
+
+        t0 = time.perf_counter()
+        x = None
+        err: Optional[BaseException] = None
+        for attempt in range(cfg.max_retries + 1):
+            try:
+                exe = self.cache.get(key, panel=cfg.panel)
+                with obs.span("serve_batch_solve", bucket_n=bucket_n,
+                              batch=len(reqs)):
+                    x = exe.solve(a_pad, b_pad)
+                err = None
+                break
+            except Exception as e:  # noqa: BLE001 — lane boundary
+                err = e
+                if not is_transient_device_error(e):
+                    break
+                obs.counter("serve.retries")
+                obs.emit("serve_retry", attempt=attempt, bucket_n=bucket_n,
+                         error=f"{type(e).__name__}: {e}"[:200])
+                if attempt < cfg.max_retries:
+                    time.sleep(retry_backoff(cfg.retry_backoff_s, attempt))
+        batch_s = time.perf_counter() - t0
+
+        if x is None:
+            transient = err is not None and is_transient_device_error(err)
+            if transient and self.health.record_failure():
+                obs.emit("serve_fallback", lane="numpy",
+                         reason="device lane unhealthy",
+                         cooldown_s=cfg.device_probe_cooldown_s)
+            if transient:
+                # Degrade THIS batch to the host lane rather than failing
+                # user requests over a device-side hiccup.
+                for req in reqs:
+                    self._serve_numpy(req)
+                return
+            for req in reqs:
+                obs.counter("serve.failed")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_FAILED, lane="batched",
+                         error=f"{type(err).__name__}: {err}"[:200])
+                req.resolve(ServeResult(
+                    status=STATUS_FAILED, lane="batched", bucket_n=bucket_n,
+                    error=f"{type(err).__name__}: {err}"))
+            return
+
+        self.health.record_success()
+        self.batches += 1
+        occupancy = len(reqs) / bb
+        obs.counter("serve.batches")
+        obs.histogram("serve.batch_occupancy", occupancy)
+        obs.emit("serve_batch", bucket_n=bucket_n, nrhs=nrhs,
+                 batch=len(reqs), batch_bucket=bb, occupancy=occupancy,
+                 seconds=round(batch_s, 6))
+        for i, req in enumerate(reqs):
+            xi = buckets.unpad_solution(x[i], req.n, req.k, req.was_vector)
+            self._finish(req, xi, lane="batched", bucket_n=bucket_n)
+
+    def _serve_handoff(self, req: ServeRequest) -> None:
+        """Oversized lane: one solve_handoff call per request (the routing
+        decision itself is emitted by solve_handoff as a ``route`` event)."""
+        from gauss_tpu.core import blocked
+
+        try:
+            with obs.span("serve_handoff", n=req.n):
+                x = blocked.solve_handoff(req.a.astype(np.float64),
+                                          req.b.astype(np.float64),
+                                          panel=self.config.panel,
+                                          iters=max(2, self.config.refine_steps))
+        except Exception as e:  # noqa: BLE001 — lane boundary
+            obs.counter("serve.failed")
+            obs.emit("serve_request", id=req.id, n=req.n,
+                     status=STATUS_FAILED, lane="handoff",
+                     error=f"{type(e).__name__}: {e}"[:200])
+            req.resolve(ServeResult(status=STATUS_FAILED, lane="handoff",
+                                    error=f"{type(e).__name__}: {e}"))
+            return
+        self._finish(req, np.asarray(x), lane="handoff", bucket_n=None)
+
+    def _serve_numpy(self, req: ServeRequest) -> None:
+        """Degraded host lane: plain LAPACK solve, verified like the rest."""
+        try:
+            with obs.span("serve_numpy", n=req.n):
+                x = np.linalg.solve(req.a.astype(np.float64),
+                                    req.b.astype(np.float64))
+        except Exception as e:  # noqa: BLE001 — lane boundary
+            obs.counter("serve.failed")
+            obs.emit("serve_request", id=req.id, n=req.n,
+                     status=STATUS_FAILED, lane="numpy",
+                     error=f"{type(e).__name__}: {e}"[:200])
+            req.resolve(ServeResult(status=STATUS_FAILED, lane="numpy",
+                                    error=f"{type(e).__name__}: {e}"))
+            return
+        self._finish(req, x, lane="numpy", bucket_n=None)
+
+    def _finish(self, req: ServeRequest, x: np.ndarray, lane: str,
+                bucket_n: Optional[int]) -> None:
+        rel = None
+        if self.config.verify_gate is not None:
+            from gauss_tpu.verify import checks
+
+            rel = checks.residual_norm(req.a, x, req.b, relative=True)
+            if not rel <= self.config.verify_gate:
+                obs.counter("serve.failed")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_FAILED, lane=lane,
+                         rel_residual=rel, error="verify gate")
+                req.resolve(ServeResult(
+                    status=STATUS_FAILED, lane=lane, bucket_n=bucket_n,
+                    rel_residual=rel,
+                    error=f"relative residual {rel:.3e} exceeds the "
+                          f"{self.config.verify_gate:.0e} verify gate"))
+                return
+        self.requests_served += 1
+        queue_s = time.perf_counter() - req.t_submit
+        obs.counter("serve.served")
+        obs.histogram("serve.latency_s", queue_s)
+        obs.emit("serve_request", id=req.id, n=req.n, k=req.k,
+                 status=STATUS_OK, lane=lane, bucket_n=bucket_n,
+                 latency_s=round(queue_s, 6), rel_residual=rel)
+        req.resolve(ServeResult(status=STATUS_OK, x=x, lane=lane,
+                                bucket_n=bucket_n, queue_s=queue_s,
+                                rel_residual=rel))

@@ -528,3 +528,61 @@ def test_resolve_factor_policy(monkeypatch):
         assert f.keywords["chunk"] == 32
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
+
+
+def test_gauss_solve_blocked_multi_rhs_shapes(rng):
+    """Serving stacks RHS columns: the one-jit factor+solve path must take
+    (n,) and (n, k) with shape-preserving returns (the multi-RHS hardening
+    behind gauss_tpu.serve's batched lane)."""
+    n, k = 48, 3
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    bs = rng.standard_normal((n, k)).astype(np.float32)
+    ref = np.linalg.solve(a.astype(np.float64), bs.astype(np.float64))
+    x = np.asarray(gauss_solve_blocked(a, bs, panel=16))
+    assert x.shape == (n, k)
+    np.testing.assert_allclose(x, ref, rtol=5e-3, atol=5e-3)
+    xv = np.asarray(gauss_solve_blocked(a, bs[:, 0], panel=16))
+    assert xv.shape == (n,)
+    np.testing.assert_allclose(xv, ref[:, 0], rtol=5e-3, atol=5e-3)
+
+
+def test_solve_refined_multi_rhs(rng):
+    """Refinement's host-f64 residual loop carries the k axis: the f64
+    result must hit the same residual bar per column as the vector path."""
+    n, k = 64, 4
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    bs = rng.standard_normal((n, k))
+    x, fac = solve_refined(a, bs, panel=16, iters=2)
+    assert x.shape == (n, k) and x.dtype == np.float64
+    assert fac.linv is not None
+    ref = np.linalg.solve(a, bs)
+    np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-9)
+    # tol early-exit applies to the whole block (Frobenius residual).
+    x2, _ = solve_refined(a, bs, panel=16, iters=8, tol=1e-10)
+    np.testing.assert_allclose(x2, ref, rtol=1e-9, atol=1e-9)
+    # Vector path unchanged: (n,) in -> (n,) out.
+    xv, _ = solve_refined(a, bs[:, 0], panel=16, iters=2)
+    assert xv.shape == (n,)
+
+
+def test_solve_handoff_multi_rhs_and_route_event(rng):
+    """The handoff honors (n, k) on the single-chip route and emits its
+    routing decision as an obs ``route`` event (the serve-lane trace hook)."""
+    from gauss_tpu import obs
+
+    n, k = 48, 2
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    bs = rng.standard_normal((n, k))
+    from gauss_tpu.core import blocked
+
+    with obs.run() as rec:
+        x = blocked.solve_handoff(a, bs, budget=2**40)
+    assert x.shape == (n, k)
+    np.testing.assert_allclose(x, np.linalg.solve(a, bs),
+                               rtol=1e-8, atol=1e-8)
+    routes = [e for e in rec.events if e["type"] == "route"]
+    assert len(routes) == 1
+    assert routes[0]["tool"] == "solve_handoff"
+    assert routes[0]["lane"] == "single_chip"
+    assert routes[0]["est_bytes"] == 3 * n * n * 4
+    assert routes[0]["budget"] == 2**40

@@ -23,3 +23,10 @@ def test_distributed_example_runs():
     r = _run(["examples/distributed_solve.py", "64", "4"])
     assert r.returncode == 0, r.stdout + r.stderr
     assert "pattern ok = True" in r.stdout
+
+
+def test_serve_quickstart_runs():
+    r = _run(["examples/serve_quickstart.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 INCORRECT" in r.stdout
+    assert "lane=batched" in r.stdout

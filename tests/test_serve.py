@@ -1,0 +1,441 @@
+"""Serving-layer tests: bucket ladder + identity padding, the LRU
+executable cache, admission control (queue bounds, deadlines), lane
+degradation (retry -> NumPy fallback), multi-RHS end-to-end, the loadgen,
+the summarizer's serving section, and the regress serve-ingest path.
+
+All CPU (conftest pins the platform); the module-scoped server keeps the
+jitted-executable compiles to one small set shared across tests.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.core import blocked
+from gauss_tpu.obs import regress, summarize
+from gauss_tpu.serve import (
+    STATUS_EXPIRED,
+    STATUS_FAILED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    CacheKey,
+    ExecutableCache,
+    ServeConfig,
+    ServeRequest,
+    SolverServer,
+    buckets,
+)
+from gauss_tpu.serve import loadgen
+from gauss_tpu.verify import checks
+
+LADDER = (16, 32)
+
+
+def _system(rng, n, k=None):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)  # diagonally dominant
+    b = rng.standard_normal(n) if k is None else rng.standard_normal((n, k))
+    return a, b
+
+
+def _config(**over):
+    kw = dict(ladder=LADDER, max_batch=4, panel=16, refine_steps=1,
+              verify_gate=1e-4)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def server():
+    with SolverServer(_config()) as srv:
+        yield srv
+
+
+# -- buckets ---------------------------------------------------------------
+
+def test_bucket_ladder_and_pow2():
+    assert buckets.bucket_for(1, LADDER) == 16
+    assert buckets.bucket_for(16, LADDER) == 16
+    assert buckets.bucket_for(17, LADDER) == 32
+    assert buckets.bucket_for(33, LADDER) is None  # -> handoff lane
+    with pytest.raises(ValueError):
+        buckets.bucket_for(0, LADDER)
+    assert [buckets.pow2_bucket(k) for k in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    assert buckets.pow2_bucket(9, cap=8) == 8
+    # Default ladder rungs are panel multiples (no double padding).
+    assert all(r % blocked.DEFAULT_PANEL == 0 for r in buckets.DEFAULT_LADDER)
+    assert buckets.validate_ladder([64, 16, 64]) == (16, 64)
+    with pytest.raises(ValueError):
+        buckets.validate_ladder([])
+
+
+def test_pad_system_identity_extension(rng):
+    a, b = _system(rng, 5)
+    ap, bp = buckets.pad_system(a, b, 8)
+    assert ap.shape == (8, 8) and bp.shape == (8, 1)
+    np.testing.assert_array_equal(ap[:5, :5], a)
+    np.testing.assert_array_equal(ap[5:, 5:], np.eye(3))
+    assert not ap[:5, 5:].any() and not ap[5:, :5].any()
+    assert not bp[5:].any()
+    # Multi-RHS with an RHS bucket wider than k.
+    a, b = _system(rng, 5, k=2)
+    _, bp = buckets.pad_system(a, b, 8, nrhs_bucket=4)
+    assert bp.shape == (8, 4)
+    np.testing.assert_array_equal(bp[:5, :2], b)
+    assert not bp[:, 2:].any()
+    with pytest.raises(ValueError):
+        buckets.pad_system(a, b, 4)  # n exceeds bucket
+    with pytest.raises(ValueError):
+        buckets.pad_system(a, b[:4], 8)  # rhs rows mismatch
+
+
+def test_padded_bucket_solve_bitmatches_unpadded(rng):
+    """The acceptance-critical property: identity-extension padding changes
+    NOTHING about the original system's solution — padded rows never win a
+    pivot contest and every extra GEMM term multiplies zero, so the f32
+    result at the original n is bit-identical, and the pad tail is exactly
+    zero."""
+    n = 20
+    a, b = _system(rng, n)
+    x = np.asarray(blocked.gauss_solve_blocked(
+        a.astype(np.float32), b.astype(np.float32)))
+    ap, bp = buckets.pad_system(a, b, 256)
+    xp = np.asarray(blocked.gauss_solve_blocked(
+        ap.astype(np.float32), bp.astype(np.float32)))
+    np.testing.assert_array_equal(x[:n], xp[:n, 0])
+    np.testing.assert_array_equal(xp[n:], np.zeros((256 - n, 1),
+                                                   dtype=np.float32))
+
+
+# -- executable cache ------------------------------------------------------
+
+def _key(**over):
+    kw = dict(bucket_n=16, nrhs=1, batch=1, dtype="float32",
+              engine="blocked", refine_steps=1, mesh=None)
+    kw.update(over)
+    return CacheKey(**kw)
+
+
+def test_lru_eviction_evicts_oldest():
+    cache = ExecutableCache(capacity=2)
+    built = []
+
+    def builder(key):
+        built.append(key)
+        return object()
+
+    k1, k2, k3 = _key(bucket_n=16), _key(bucket_n=32), _key(bucket_n=64)
+    e1 = cache.get(k1, builder)
+    cache.get(k2, builder)
+    assert cache.get(k1, builder) is e1          # hit refreshes recency
+    cache.get(k3, builder)                       # evicts k2 (oldest), not k1
+    assert set(cache.keys()) == {k1, k3}
+    assert cache.get(k1, builder) is e1          # k1 survived
+    cache.get(k2, builder)                       # k2 must rebuild
+    assert built == [k1, k2, k3, k2]
+    s = cache.stats()
+    assert s["evictions"] == 2 and s["hits"] == 2 and s["misses"] == 4
+    with pytest.raises(ValueError):
+        ExecutableCache(capacity=0)
+
+
+# -- server: happy path ----------------------------------------------------
+
+def test_server_batched_lane_correct_and_cached(server, rng):
+    hits0 = server.cache.hits
+    for n in (6, 12, 16, 24, 12, 6):
+        a, b = _system(rng, n)
+        res = server.solve(a, b)
+        assert res.status == STATUS_OK and res.lane == "batched"
+        assert res.bucket_n == buckets.bucket_for(n, LADDER)
+        assert res.x.shape == (n,)
+        x_ref = np.linalg.solve(a, b)
+        assert checks.elementwise_match(res.x, x_ref, 1e-4)
+        assert res.rel_residual <= 1e-4
+    assert server.cache.hits > hits0  # repeated shapes reuse executables
+
+
+def test_server_multirhs_shapes(server, rng):
+    a, b = _system(rng, 12, k=3)
+    res = server.solve(a, b)
+    assert res.status == STATUS_OK
+    assert res.x.shape == (12, 3)
+    assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+    # Vector in -> vector out, matrix in -> matrix out (shape-preserving).
+    a1, b1 = _system(rng, 12)
+    assert server.solve(a1, b1).x.shape == (12,)
+
+
+def test_server_batches_queued_same_bucket(rng):
+    """Requests queued while the worker is not yet running drain as ONE
+    vmap batch (the dynamic-batching core), visible as a serve_batch event
+    with occupancy > single."""
+    srv = SolverServer(_config())
+    handles = []
+    with obs.run() as rec:
+        for _ in range(3):
+            a, b = _system(rng, 10)
+            handles.append(srv.submit(a, b))
+        srv.start()
+        results = [h.result(120) for h in handles]
+        srv.stop()
+    assert all(r.status == STATUS_OK for r in results)
+    batch_evs = [e for e in rec.events if e["type"] == "serve_batch"]
+    assert any(e["batch"] == 3 and e["batch_bucket"] == 4 for e in batch_evs)
+    occ = [e["occupancy"] for e in batch_evs if e["batch"] == 3]
+    assert occ and occ[0] == pytest.approx(0.75)
+
+
+def test_oversized_routes_through_handoff(server, rng):
+    n = LADDER[-1] + 8
+    a, b = _system(rng, n)
+    with obs.run() as rec:
+        res = server.solve(a, b)
+    assert res.status == STATUS_OK and res.lane == "handoff"
+    assert res.x.shape == (n,)
+    assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+    routes = [e for e in rec.events if e["type"] == "route"
+              and e.get("tool") == "solve_handoff"]
+    assert routes and routes[0]["lane"] == "single_chip"
+    assert routes[0]["n"] == n and routes[0]["budget"] > 0
+
+
+# -- admission control -----------------------------------------------------
+
+def test_queue_full_rejection_with_retry_after(rng):
+    srv = SolverServer(_config(max_queue=2))  # worker NOT started
+    a, b = _system(rng, 8)
+    h1, h2 = srv.submit(a, b), srv.submit(a, b)
+    h3 = srv.submit(a, b)  # over the bound: rejected synchronously
+    assert h3.done
+    res3 = h3.result(0)
+    assert res3.status == STATUS_REJECTED
+    assert res3.retry_after_s and res3.retry_after_s > 0
+    srv.stop(drain=False)  # refuses the queued two rather than losing them
+    assert h1.result(5).status == STATUS_REJECTED
+    assert h2.result(5).status == STATUS_REJECTED
+
+
+def test_deadline_expired_rejected_before_compute(rng):
+    srv = SolverServer(_config())
+    a, b = _system(rng, 8)
+    with obs.run() as rec:
+        h = srv.submit(a, b, deadline_s=0.001)
+        time.sleep(0.05)  # expire while queued (worker not started yet)
+        live = srv.submit(a, b)  # no deadline — must still be served
+        srv.start()
+        res = h.result(120)
+        assert live.result(120).status == STATUS_OK
+        srv.stop()
+    assert res.status == STATUS_EXPIRED and res.x is None
+    evs = [e for e in rec.events if e["type"] == "serve_request"
+           and e.get("status") == STATUS_EXPIRED]
+    assert evs  # shed before compute, and visible in the stream
+    # No batch was dispatched for the expired request alone.
+    assert all(e.get("id") != h.id or e.get("status") == STATUS_EXPIRED
+               for e in rec.events if e["type"] == "serve_request")
+
+
+def test_default_deadline_applies(rng):
+    srv = SolverServer(_config(deadline_default_s=0.001))
+    a, b = _system(rng, 8)
+    h = srv.submit(a, b)
+    time.sleep(0.05)
+    srv.start()
+    assert h.result(120).status == STATUS_EXPIRED
+    srv.stop()
+
+
+def test_bad_request_shapes_raise(rng):
+    a, b = _system(rng, 8)
+    with pytest.raises(ValueError):
+        ServeRequest(a[:, :4], b)
+    with pytest.raises(ValueError):
+        ServeRequest(a, b[:4])
+    with pytest.raises(ValueError):
+        ServeRequest(a, np.zeros((8, 2, 2)))
+
+
+# -- degradation -----------------------------------------------------------
+
+def test_numpy_fallback_lane_on_persistent_device_failure(rng):
+    srv = SolverServer(_config(unhealthy_after=1, max_retries=1,
+                               retry_backoff_s=0.0,
+                               device_probe_cooldown_s=60.0))
+
+    def broken_get(key, builder=None, panel=None):
+        raise RuntimeError("injected transient device failure")
+
+    srv.cache.get = broken_get
+    a, b = _system(rng, 8)
+    with obs.run() as rec:
+        with srv:
+            res = srv.solve(a, b)
+            # Lane tripped: the next request goes straight to the host lane
+            # (device_allowed() False) without touching the cache again.
+            res2 = srv.solve(a, b)
+    assert res.status == STATUS_OK and res.lane == "numpy"
+    assert res2.status == STATUS_OK and res2.lane == "numpy"
+    assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+    assert srv.health.open
+    retries = [e for e in rec.events if e["type"] == "serve_retry"]
+    assert retries  # bounded retry ran before the lane tripped
+    trips = [e for e in rec.events if e["type"] == "serve_fallback"]
+    assert trips and trips[0]["lane"] == "numpy"
+
+
+def test_nontransient_error_fails_without_retry(rng):
+    srv = SolverServer(_config())
+
+    def broken_get(key, builder=None, panel=None):
+        raise ValueError("deterministic bug — retrying replays it")
+
+    srv.cache.get = broken_get
+    a, b = _system(rng, 8)
+    with obs.run() as rec:
+        with srv:
+            res = srv.solve(a, b)
+    assert res.status == STATUS_FAILED
+    assert "deterministic" in res.error
+    assert not [e for e in rec.events if e["type"] == "serve_retry"]
+
+
+def test_lane_health_circuit_breaker():
+    from gauss_tpu.serve.admission import LaneHealth
+
+    h = LaneHealth(unhealthy_after=2, cooldown_s=30.0)
+    assert h.device_allowed()
+    assert not h.record_failure()     # 1 of 2: not yet tripped
+    assert h.device_allowed()
+    assert h.record_failure()         # trips
+    assert not h.device_allowed() and h.open
+    h2 = LaneHealth(unhealthy_after=1, cooldown_s=0.0)
+    h2.record_failure()
+    assert h2.device_allowed()        # cooldown elapsed: one probe allowed
+    h2.record_success()
+    assert not h2.open and h2.device_allowed()
+
+
+# -- loadgen ---------------------------------------------------------------
+
+def test_parse_mix_and_history_records():
+    mix = loadgen.parse_mix("random:24*2, internal:16, dataset:jpwh_991")
+    kinds = [(s.kind, s.arg) for s, _ in mix]
+    assert kinds == [("random", "24"), ("internal", "16"),
+                     ("dataset", "jpwh_991")]
+    assert [w for _, w in mix] == [2.0, 1.0, 1.0]
+    for bad in ("", "foo:12", "random", "random:0"):
+        with pytest.raises(ValueError):
+            loadgen.parse_mix(bad)
+    recs = loadgen.history_records(
+        {"mode": "closed", "throughput_rps": 20.0,
+         "latency_s": {"p50": 0.01, "p95": 0.05, "p99": None}})
+    assert ("serve:closed/s_per_request", 0.05) in recs
+    assert ("serve:closed/p95_s", 0.05) in recs
+    assert not any(m.endswith("p99_s") for m, _ in recs)
+
+
+def test_loadgen_closed_loop_end_to_end(server, tmp_path):
+    cfg = loadgen.LoadgenConfig(
+        mix="random:10*2,random:20,internal:12", requests=8, warmup=2,
+        concurrency=2, seed=7, serve=_config())
+    with obs.run(metrics_out=str(tmp_path / "serve.jsonl")) as rec:
+        summary = loadgen.run_load(server, cfg)
+    assert summary["counts"]["ok"] == 8 and summary["incorrect"] == 0
+    assert summary["throughput_rps"] > 0
+    assert summary["latency_s"]["p50"] > 0
+    assert summary["latency_s"]["p95"] >= summary["latency_s"]["p50"]
+    assert summary["cache"]["hits"] + summary["cache"]["misses"] > 0
+    assert "serve loadgen" in loadgen.format_summary(summary)
+    # The summary is regress-ingestable end to end.
+    out = tmp_path / "summary.json"
+    loadgen.write_summary(summary, out)
+    recs = regress.ingest_file(out)
+    assert recs and all(r["kind"] == "serve" for r in recs)
+    assert any(r["metric"] == "serve:closed/s_per_request" for r in recs)
+    # And the loadgen's own events landed in the stream.
+    assert [e for e in rec.events if e["type"] == "serve_loadgen"]
+
+
+def test_loadgen_open_loop_poisson(server):
+    cfg = loadgen.LoadgenConfig(mix="random:10", requests=4, warmup=0,
+                                mode="open", rate=200.0, seed=3,
+                                serve=_config())
+    with obs.run():
+        summary = loadgen.run_load(server, cfg)
+    assert summary["counts"]["ok"] == 4 and summary["incorrect"] == 0
+    with pytest.raises(ValueError):
+        loadgen.run_load(server, loadgen.LoadgenConfig(
+            mix="random:4", requests=1, warmup=0, mode="bogus"))
+
+
+# -- summarizer serving section -------------------------------------------
+
+def test_serving_summary_section_and_json(tmp_path):
+    with obs.run(metrics_out=str(tmp_path / "sv.jsonl")) as rec:
+        for i, lat in enumerate((0.01, 0.02, 0.03)):
+            obs.emit("serve_request", id=i, n=16, status="ok",
+                     lane="batched", latency_s=lat)
+        obs.emit("serve_request", id=9, n=16, status="rejected",
+                 reason="queue_full")
+        obs.emit("serve_batch", bucket_n=16, batch=3, batch_bucket=4,
+                 occupancy=0.75, seconds=0.01)
+        obs.emit("serve_cache", event="miss", bucket_n=16)
+        obs.emit("serve_cache", event="hit", bucket_n=16)
+        obs.emit("serve_cache", event="hit", bucket_n=16)
+        obs.emit("serve_retry", attempt=0, error="boom")
+        obs.emit("route", tool="solve_handoff", n=40, lane="single_chip",
+                 est_bytes=1, budget=2)
+    events = obs.read_events(tmp_path / "sv.jsonl")
+    sv = summarize.serving_summary(events)
+    assert sv["requests"] == {"ok": 3, "rejected": 1}
+    assert sv["lanes"] == {"batched": 3}
+    assert sv["latency_s"]["p50"] == pytest.approx(0.02)
+    assert sv["batches"] == {"count": 1, "occupancy_mean": 0.75}
+    assert sv["cache"]["hit"] == 2 and sv["cache"]["miss"] == 1
+    assert sv["cache"]["hit_rate"] == pytest.approx(2 / 3)
+    assert sv["retries"] == 1
+    assert sv["handoff_routes"] == {"single_chip": 1}
+    text = summarize.summarize_events(events, rec.run_id)
+    assert "serving:" in text and "hit-rate" in text
+    payload = summarize.run_summary(events, rec.run_id)
+    json.dumps(payload)  # --json path stays serializable
+    assert payload["serving"]["requests"]["ok"] == 3
+    # Runs with no serving events carry an empty section, not noise.
+    with obs.run(metrics_out=str(tmp_path / "plain.jsonl")) as r2:
+        obs.emit("custom")
+    plain = obs.read_events(tmp_path / "plain.jsonl")
+    assert summarize.serving_summary(plain) == {}
+    assert "serving:" not in summarize.summarize_events(plain, r2.run_id)
+
+
+# -- regress serve history -------------------------------------------------
+
+def test_regress_serve_history_roundtrip(tmp_path):
+    summary = {"kind": "serve_loadgen", "mode": "closed",
+               "throughput_rps": 25.0,
+               "latency_s": {"p50": 0.008, "p95": 0.02}}
+    art = tmp_path / "serve_summary.json"
+    art.write_text(json.dumps(summary))
+    recs = regress.ingest_file(art)
+    assert {r["metric"] for r in recs} == {
+        "serve:closed/s_per_request", "serve:closed/p50_s",
+        "serve:closed/p95_s"}
+    hist = tmp_path / "history.jsonl"
+    assert regress.append_history(recs, hist) == 3
+    assert regress.append_history(recs, hist) == 0  # idempotent re-ingest
+    # Below min-samples the verdict is informational, never a gate failure.
+    verdicts = regress.check_records(recs, regress.load_history(hist))
+    assert all(v["status"] == "no-baseline" for v in verdicts)
+    # With three epochs the baseline gates: a 2x p95 is out of band.
+    for v in (0.019, 0.021):
+        regress.append_history([dict(recs[2], value=v, source=f"e{v}")], hist)
+    bad = regress.evaluate("serve:closed/p95_s", 0.06,
+                           regress.load_history(hist))
+    assert bad["status"] == "out-of-band"
+    ok = regress.evaluate("serve:closed/p95_s", 0.021,
+                          regress.load_history(hist))
+    assert ok["status"] in ("ok", "fast")
