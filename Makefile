@@ -5,8 +5,9 @@
 PYTHON ?= python
 OBS_SMOKE ?= /tmp/gauss_obs_check.jsonl
 SERVE_SMOKE ?= /tmp/gauss_serve_check
+FAULTS_SMOKE ?= /tmp/gauss_faults_check
 
-.PHONY: all native test bench datasets obs-check serve-check clean
+.PHONY: all native test bench datasets obs-check serve-check faults-check clean
 
 all: native
 
@@ -49,6 +50,25 @@ serve-check:
 	sv=[r['serving'] for r in runs.values() if r.get('serving')]; \
 	assert sv and sv[0]['requests'].get('ok', 0) >= 50, sv; \
 	print('serve-check: serving summary ok:', sv[0]['requests'])"
+
+# The resilience gate (CI-callable): a CPU chaos smoke campaign — 200
+# seeded fault cases across both engines plus serve and checkpoint phases
+# (<60 s; small n, fault paths not FLOPs) asserting the chaos invariant
+# (every injected fault recovered-and-verified or a typed error; exit 2 on
+# a silent wrong answer), gated against the regression history (exit 1
+# when recovery depth / typed-error rate / per-case cost leave the band),
+# then the recorded stream is asserted to carry a resilience summary.
+faults-check:
+	rm -rf $(FAULTS_SMOKE) && mkdir -p $(FAULTS_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.resilience.chaos --cases 200 \
+	  --serve-requests 30 --seed 258458 --tmpdir $(FAULTS_SMOKE) \
+	  --metrics-out $(FAULTS_SMOKE)/chaos.jsonl \
+	  --summary-json $(FAULTS_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(FAULTS_SMOKE)/chaos.jsonl --json \
+	  | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	rs=[r['resilience'] for r in runs.values() if r.get('resilience')]; \
+	assert rs and rs[0]['injections']['total'] >= 200, rs; \
+	print('faults-check: resilience summary ok:', rs[0]['injections']['total'], 'injections')"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

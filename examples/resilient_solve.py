@@ -1,0 +1,92 @@
+"""Resilience quickstart: inject a fault, watch the ladder recover.
+
+Run on any backend (CPU works):
+
+    JAX_PLATFORMS=cpu python examples/resilient_solve.py
+
+Solves the same system three ways — clean, under a one-shot injected NaN
+panel corruption (recovered by the pivot-safe re-factor rung), and with
+corrupted INPUT (a typed UnrecoverableSolveError: no rung can repair a
+system that was never well-posed) — printing the obs `fault`/`recovery`
+events each case produced. Then a checkpointed factorization is killed
+mid-run and resumed bit-identically. See docs/RESILIENCE.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import checkpoint, inject, recover
+
+
+def events_of(rec, *types):
+    return [e for e in rec.events if e["type"] in types]
+
+
+def main():
+    rng = np.random.default_rng(258458)
+    n = 64
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    b = rng.standard_normal(n)
+
+    # 1. Healthy solve: rung 0, no recovery noise.
+    res = recover.solve_resilient(a, b)
+    print(f"clean:     rung={res.rung} attempts={res.attempts} "
+          f"rel_residual={res.rel_residual:.2e}")
+
+    # 2. One-shot NaN corruption of the factor operand: rung 0 fails the
+    #    finite gate, the pivot-safe re-factor rung recovers.
+    plan = inject.FaultPlan.parse("core.blocked.factor=nan:max=1")
+    with obs.run(tool="resilient_solve") as rec:
+        with inject.plan(plan) as active:
+            res = recover.solve_resilient(a, b)
+    print(f"nan fault: rung={res.rung} attempts={res.attempts} "
+          f"rel_residual={res.rel_residual:.2e} "
+          f"(injected: {active.stats()['triggered']})")
+    for ev in events_of(rec, "fault", "recovery"):
+        kv = {k: v for k, v in ev.items()
+              if k in ("site", "kind", "trigger", "rung", "outcome")}
+        print(f"  obs {ev['type']}: {kv}")
+
+    # 3. Corrupted input: typed error, never a silent wrong answer.
+    bad = a.copy()
+    bad[3, 7] = np.nan
+    try:
+        recover.solve_resilient(bad, b)
+    except recover.UnrecoverableSolveError as e:
+        print(f"bad input: typed {type(e).__name__} (trigger={e.trigger})")
+
+    # 4. Checkpointed factorization killed between groups, then resumed.
+    path = "/tmp/resilient_solve_ck.npz"
+    kill = inject.FaultPlan([inject.FaultSpec(
+        site="checkpoint.group", kind="raise", max_triggers=1, skip=1)])
+    a32 = a.astype(np.float32)
+    try:
+        with inject.plan(kill):
+            checkpoint.lu_factor_blocked_chunked_checkpointed(
+                a32, path, panel=16, chunk=2)
+    except inject.SimulatedFaultError:
+        print(f"checkpoint: killed mid-factorization, carry saved at {path}")
+    fac = checkpoint.lu_factor_blocked_chunked_checkpointed(
+        a32, path, panel=16, chunk=2)
+    clean = checkpoint.lu_factor_blocked_chunked_checkpointed(
+        a32, path + ".clean", panel=16, chunk=2)
+    identical = all(
+        np.array_equal(np.asarray(getattr(fac, f)),
+                       np.asarray(getattr(clean, f)))
+        for f in ("m", "perm", "min_abs_pivot", "linv", "uinv"))
+    print(f"checkpoint: resumed, bit-identical to uninterrupted: "
+          f"{identical}")
+
+
+if __name__ == "__main__":
+    main()

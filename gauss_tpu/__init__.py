@@ -21,6 +21,11 @@ de-duplicates that into one algorithmic core with pluggable execution backends:
                           numerical-health monitors, compile/memory accounting
                           (the persistent equivalent of the reference's
                           gettimeofday spans + gprof profiles)
+- ``gauss_tpu.resilience`` — fault injection behind named hook points,
+                          health-gated recovery ladders (solve_resilient),
+                          checkpoint/resume for long factorizations, and the
+                          chaos campaign runner (the reference aborts on a
+                          bad pivot; this layer recovers or fails TYPED)
 """
 
 __version__ = "0.1.0"

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from gauss_tpu.resilience import inject as _inject
+
 DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
 CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16)
 GROUP_UPDATE_STRIP = 2048  # rows per deferred-trailing-GEMM strip: bounds
@@ -315,6 +317,14 @@ def _reraise_scoped_vmem(fn):
     requests pay the except path."""
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        if _inject.enabled() and args:
+            # Fault-injection hook point "core.blocked.factor": corrupt the
+            # operand of a host-level factor/solve entry (tracer operands —
+            # calls inside an enclosing jit trace — pass through untouched).
+            # One attribute check when a plan is installed, one `is None`
+            # read otherwise; see gauss_tpu.resilience.inject.
+            args = (_inject.corrupt_operand("core.blocked.factor", args[0]),
+                    ) + args[1:]
         try:
             return fn(*args, **kwargs)
         except ValueError:
@@ -365,17 +375,23 @@ def _resolve_panel_impl(panel_impl, n: int | None = None,
     return panel_impl
 
 
-def _factor_panel(sub, kb, h: int, panel: int, panel_impl: str):
+def _factor_panel(sub, kb, h: int, panel: int, panel_impl: str,
+                  zero_pivot_safe: bool = False):
     """Slice and factor the (h, panel) column block of ``sub`` whose diagonal
     sits at row offset ``kb``. Returns (p, ipiv, perm_local_or_None, mp).
-    Single source for every blocked-factorization loop."""
+    Single source for every blocked-factorization loop.
+
+    ``zero_pivot_safe`` guards the multiplier division (see
+    :func:`_panel_factor_jax`) — the recovery ladder's re-factor rung; only
+    the stock-JAX panel implements it, so callers must resolve
+    ``panel_impl='jax'`` when requesting it."""
     p = lax.dynamic_slice(sub, (0, kb), (h, panel))
     if panel_impl == "pallas":
         from gauss_tpu.kernels.panel_pallas import panel_factor_pallas
 
         p, ipiv, perm_local, mp = panel_factor_pallas(p, kb)
         return p, ipiv, perm_local, mp
-    p, ipiv, mp = _panel_factor_jax(p, kb)
+    p, ipiv, mp = _panel_factor_jax(p, kb, zero_pivot_safe=zero_pivot_safe)
     return p, ipiv, None, mp
 
 
@@ -432,11 +448,12 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype,
 
 @_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision",
-                                   "swap_impl"))
+                                   "swap_impl", "zero_pivot_safe"))
 def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
                       panel_impl: str = "auto",
                       gemm_precision: str = "highest",
-                      swap_impl: str = "gather") -> BlockedLU:
+                      swap_impl: str = "gather",
+                      zero_pivot_safe: bool = False) -> BlockedLU:
     """Blocked LU with partial pivoting; one fori_loop over column panels.
 
     panel_impl: "jax" (stock fori_loop rank-1 updates), "pallas" (the
@@ -451,6 +468,13 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     permutation directly (its ipiv is the pivot-choice sequence, not swap
     partners), so with panel_impl "pallas" — the "auto" resolution on TPU —
     swaps always go through the gather path and "loop" has no effect.
+    zero_pivot_safe: guard the panel multiplier division so an exactly-zero
+    pivot eliminates nothing instead of NaN-poisoning the trailing rows
+    (``min_abs_pivot`` still records 0). The recovery ladder's re-factor
+    rung (gauss_tpu.resilience.recover): a near-singular or corrupted
+    system factors to a FINITE factor the residual gate can judge, instead
+    of a NaN factor nothing downstream can use. Only the stock-JAX panel
+    implements the guard, so the panel impl is pinned to "jax".
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
@@ -463,7 +487,10 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
         raise ValueError(f"expected square matrix, got {a.shape}")
     itemsize = jnp.dtype(a.dtype).itemsize
     panel = _resolve_panel(n, panel, itemsize)
-    panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
+    if zero_pivot_safe:
+        panel_impl = "jax"
+    else:
+        panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
@@ -473,7 +500,8 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
         m, perm, min_piv, linvs, uinvs = carry
         kb = k * panel
         p, ipiv, perm_local, mp = _factor_panel(m, kb, npad, panel,
-                                                panel_impl)
+                                                panel_impl,
+                                                zero_pivot_safe=zero_pivot_safe)
         min_piv = jnp.minimum(min_piv, mp)
 
         # Apply the panel's pivot permutation to the rest of the matrix. Two
@@ -778,157 +806,183 @@ def lu_factor_blocked_chunked(a: jax.Array,
     linvs_all, uinvs_all = [], []
 
     for g0 in range(0, nb, chunk):
-        gs = g0 * panel              # group start row/col (static)
-        gh = npad - gs               # static trailing size
-        gpanels = min(chunk, nb - g0)
-        w = gpanels * panel          # group block width (static)
-        rt = gh - w                  # right-of-group trailing width (static)
-        grp = m[gs:, gs:gs + w]      # (gh, w) group column block
-        # Panel-impl resolution is PER GROUP on the group height; explicit
-        # "jax"/"pallas" requests stay global. Narrow panel-64 groups
-        # additionally drop to the stock-JAX panel in auto mode: slicing
-        # the panel from a group block under PANEL64_MIN_SLICE_W columns
-        # fuses into the aliased kernel call and double-counts its block
-        # in scoped VMEM (the round-5 compile probes) — resolve_factor
-        # never produces such a config, but explicit chunk/panel
-        # combinations can.
-        impl_g = _resolve_panel_impl(panel_impl, gh, panel, itemsize)
-        # Two group-width contexts degrade the kernel's aliasing into a
-        # full block double-count (round-5 compile probes): panel-64
-        # slices from groups NARROWER than PANEL64_MIN_SLICE_W, and
-        # panel-128 slices from groups EXACTLY 2048 columns wide (W=1024
-        # and W=4096 alias fine at 128; the fusion decision is
-        # whole-program-context dependent — the same (128, 14336) shape
-        # compiled inside n=24576 and double-counted inside n=32768, so
-        # this guard is necessarily approximate and explicit
-        # outside-the-auto-envelope configs can still hit raw Mosaic
-        # scoped-VMEM errors). Auto mode drops guarded groups to the
-        # stock-JAX panel; explicit pallas requests get the clear sizing
-        # error (same contract as _resolve_panel_impl, ADVICE r3).
-        narrow64 = panel <= 64 and w < PANEL64_MIN_SLICE_W
-        wide128 = (panel == 128 and w == 2048
-                   and gh * (2 * panel * itemsize + 128) > PANEL_VMEM_BUDGET)
-        if impl_g == "pallas" and (narrow64 or wide128):
-            if panel_impl == "auto":
-                impl_g = "jax"
-            elif jax.default_backend() == "tpu":
-                raise ValueError(
-                    f"panel_impl='pallas': the (h={gh}, panel={panel}) "
-                    f"kernel block does not fit scoped VMEM in a "
-                    f"{w}-column group context; adjust chunk, or use "
-                    f"panel_impl='auto' (stock-JAX panel for these groups)")
-
-        def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
-            grp, gperm, min_piv, linvs, uinvs = carry
-            kb = j * panel           # panel offset WITHIN the group
-            p, ipiv, perm_local, mp = _factor_panel(grp, kb, gh, panel,
-                                                    panel_impl)
-            if perm_local is None:
-                perm_local = _fold_transpositions(ipiv, kb, gh, panel)
-            min_piv = jnp.minimum(min_piv, mp)
-            grp = grp[perm_local]
-            gperm = gperm[perm_local]
-
-            grp, linv_k, uinv_k = _install_and_update(grp, kb, gh, panel, p,
-                                                      gemm_prec, dtype, w=w)
-            linvs = lax.dynamic_update_slice(linvs, linv_k[None], (j, 0, 0))
-            uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (j, 0, 0))
-            return grp, gperm, min_piv, linvs, uinvs
-
-        gperm0 = jnp.arange(gh)
-        linvs0 = jnp.zeros((gpanels, panel, panel), dtype)
-        uinvs0 = jnp.zeros((gpanels, panel, panel), dtype)
-        grp, gperm, min_piv, linvs, uinvs = lax.fori_loop(
-            0, gpanels, body, (grp, gperm0, min_piv, linvs0, uinvs0))
-
-        unstripped = (4 * npad * npad * itemsize
-                      <= GROUP_UPDATE_UNSTRIPPED_MAX_BYTES)
-        # One fix-up per group: realign the L-multiplier columns written by
-        # earlier groups (left of gs) with this group's composed
-        # permutation. In the strip form (HBM-ceiling band) the SAME gather
-        # realigns the right columns too: full rows, one gather, so the
-        # strip updates below can run in place on row-aligned data — peak
-        # HBM stays ~2 matrix copies. (Round 4 realigned left-only and
-        # gathered permuted rows per strip into a full (gh-w, rt) `fresh`
-        # accumulator; at n=34048 that schedule needed 19.7 GB and failed
-        # to compile — a claim the round-4 report never actually backed.)
-        if not unstripped:
-            # Offset indices, not slice-then-gather: m[gs:][gperm] makes the
-            # compiler materialize the (gh, npad) slice AND the gather
-            # output (2 x 3.75 GB at n=32768, 70 MB over budget).
-            m = m.at[gs:].set(m[gs + gperm])
-        elif gs:
-            left = m[gs:, :gs][gperm]
-            m = m.at[gs:, :gs].set(left)
-        m = m.at[gs:, gs:gs + w].set(grp)
-        perm = perm.at[gs:].set(perm[gs:][gperm])
+        m, perm, min_piv, linvs, uinvs = _factor_group(
+            m, perm, min_piv, g0, panel, chunk, panel_impl, gemm_prec)
         linvs_all.append(linvs)
         uinvs_all.append(uinvs)
-
-        if rt:
-            # Deferred right-of-group update: the group's block rows of the
-            # right columns (already row-permuted in the strip form; via a
-            # composed-permutation gather otherwise), then
-            # U12 = L_group^-1 A12 as a blockwise scan over the group's
-            # chunk block rows (same zero-meets-U argument as
-            # _blockwise_substitution_scan), then the whole group's
-            # trailing contribution as one logical (gh-w, w) x (w, rt) MXU
-            # GEMM — one pass in the unstripped form, bounded in-place ROW
-            # STRIPS in the HBM-ceiling band.
-            if unstripped:
-                top = m[gs + gperm[:w]][:, gs + w:]  # (w, rt) block rows
-            else:
-                top = lax.dynamic_slice(m, (gs, gs + w), (w, rt))
-
-            def usolve(x, i, grp=grp):
-                rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
-                r = lax.dynamic_slice(top, (i * panel, 0), (panel, rt))
-                r = r - jnp.dot(rows, x, precision=gemm_prec)
-                xi = jnp.dot(linvs[i], r, precision=gemm_prec)
-                return lax.dynamic_update_slice(x, xi, (i * panel, 0)), i
-
-            u12, _ = lax.scan(usolve, jnp.zeros((w, rt), dtype),
-                              jnp.arange(gpanels))
-
-            if unstripped:
-                # One gather + one GEMM; transients peak ~3 trailing-block
-                # copies, fine while the byte gate holds.
-                def a22_full(rows_idx, l21_full):
-                    old = m[gs + rows_idx][:, gs + w:]
-                    return old - jnp.dot(l21_full, u12, precision=gemm_prec)
-
-                fresh = a22_full(gperm[w:], grp[w:])
-                # Writes come LAST: gperm[w:] can name original rows < w,
-                # so the gather must read the right region's OLD data — the
-                # u12 block-row write would clobber exactly those rows.
-                m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
-                m = lax.dynamic_update_slice(m, fresh, (gs + w, gs + w))
-            else:
-                # Rows are already permutation-aligned: each strip reads
-                # and writes only its own rows of m — in place, no
-                # accumulator, no read-after-write hazard.
-                m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
-                sw = min(GROUP_UPDATE_STRIP, gh - w)
-                nfull = (gh - w) // sw
-
-                def strip_body(s, m):
-                    r0 = w + s * sw
-                    old = lax.dynamic_slice(m, (gs + r0, gs + w), (sw, rt))
-                    l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
-                    new = old - jnp.dot(l21, u12, precision=gemm_prec)
-                    return lax.dynamic_update_slice(m, new, (gs + r0, gs + w))
-
-                m = lax.fori_loop(0, nfull, strip_body, m)
-                tail = (gh - w) - nfull * sw
-                if tail:
-                    old = m[gs + w + nfull * sw:gs + gh, gs + w:]
-                    new = old - jnp.dot(grp[w + nfull * sw:], u12,
-                                        precision=gemm_prec)
-                    m = m.at[gs + w + nfull * sw:gs + gh, gs + w:].set(new)
 
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=jnp.concatenate(linvs_all),
                      uinv=jnp.concatenate(uinvs_all))
+
+
+def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
+                  panel_impl: str, gemm_prec):
+    """One group of the chunked factorization: factor (up to) ``chunk``
+    panels starting at panel index ``g0``, apply the group's composed
+    permutation, and run the deferred right-of-group update. Returns
+    ``(m, perm, min_piv, linvs, uinvs)`` with the group's (gpanels, panel,
+    panel) diagonal-block inverses.
+
+    Single source for :func:`lu_factor_blocked_chunked` (which unrolls every
+    group into one traced program) and
+    :mod:`gauss_tpu.resilience.checkpoint` (which jits and runs groups one
+    at a time at host level, serializing this function's carry between
+    groups — the checkpoint IS this signature). All group-shape arguments
+    are trace-time statics; ``gemm_prec`` is an already-resolved
+    ``lax.Precision``.
+    """
+    npad = m.shape[0]
+    nb = npad // panel
+    dtype = m.dtype
+    itemsize = jnp.dtype(dtype).itemsize
+    gs = g0 * panel              # group start row/col (static)
+    gh = npad - gs               # static trailing size
+    gpanels = min(chunk, nb - g0)
+    w = gpanels * panel          # group block width (static)
+    rt = gh - w                  # right-of-group trailing width (static)
+    grp = m[gs:, gs:gs + w]      # (gh, w) group column block
+    # Panel-impl resolution is PER GROUP on the group height; explicit
+    # "jax"/"pallas" requests stay global. Narrow panel-64 groups
+    # additionally drop to the stock-JAX panel in auto mode: slicing
+    # the panel from a group block under PANEL64_MIN_SLICE_W columns
+    # fuses into the aliased kernel call and double-counts its block
+    # in scoped VMEM (the round-5 compile probes) — resolve_factor
+    # never produces such a config, but explicit chunk/panel
+    # combinations can.
+    impl_g = _resolve_panel_impl(panel_impl, gh, panel, itemsize)
+    # Two group-width contexts degrade the kernel's aliasing into a
+    # full block double-count (round-5 compile probes): panel-64
+    # slices from groups NARROWER than PANEL64_MIN_SLICE_W, and
+    # panel-128 slices from groups EXACTLY 2048 columns wide (W=1024
+    # and W=4096 alias fine at 128; the fusion decision is
+    # whole-program-context dependent — the same (128, 14336) shape
+    # compiled inside n=24576 and double-counted inside n=32768, so
+    # this guard is necessarily approximate and explicit
+    # outside-the-auto-envelope configs can still hit raw Mosaic
+    # scoped-VMEM errors). Auto mode drops guarded groups to the
+    # stock-JAX panel; explicit pallas requests get the clear sizing
+    # error (same contract as _resolve_panel_impl, ADVICE r3).
+    narrow64 = panel <= 64 and w < PANEL64_MIN_SLICE_W
+    wide128 = (panel == 128 and w == 2048
+               and gh * (2 * panel * itemsize + 128) > PANEL_VMEM_BUDGET)
+    if impl_g == "pallas" and (narrow64 or wide128):
+        if panel_impl == "auto":
+            impl_g = "jax"
+        elif jax.default_backend() == "tpu":
+            raise ValueError(
+                f"panel_impl='pallas': the (h={gh}, panel={panel}) "
+                f"kernel block does not fit scoped VMEM in a "
+                f"{w}-column group context; adjust chunk, or use "
+                f"panel_impl='auto' (stock-JAX panel for these groups)")
+
+    def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
+        grp, gperm, min_piv, linvs, uinvs = carry
+        kb = j * panel           # panel offset WITHIN the group
+        p, ipiv, perm_local, mp = _factor_panel(grp, kb, gh, panel,
+                                                panel_impl)
+        if perm_local is None:
+            perm_local = _fold_transpositions(ipiv, kb, gh, panel)
+        min_piv = jnp.minimum(min_piv, mp)
+        grp = grp[perm_local]
+        gperm = gperm[perm_local]
+
+        grp, linv_k, uinv_k = _install_and_update(grp, kb, gh, panel, p,
+                                                  gemm_prec, dtype, w=w)
+        linvs = lax.dynamic_update_slice(linvs, linv_k[None], (j, 0, 0))
+        uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (j, 0, 0))
+        return grp, gperm, min_piv, linvs, uinvs
+
+    gperm0 = jnp.arange(gh)
+    linvs0 = jnp.zeros((gpanels, panel, panel), dtype)
+    uinvs0 = jnp.zeros((gpanels, panel, panel), dtype)
+    grp, gperm, min_piv, linvs, uinvs = lax.fori_loop(
+        0, gpanels, body, (grp, gperm0, min_piv, linvs0, uinvs0))
+
+    unstripped = (4 * npad * npad * itemsize
+                  <= GROUP_UPDATE_UNSTRIPPED_MAX_BYTES)
+    # One fix-up per group: realign the L-multiplier columns written by
+    # earlier groups (left of gs) with this group's composed
+    # permutation. In the strip form (HBM-ceiling band) the SAME gather
+    # realigns the right columns too: full rows, one gather, so the
+    # strip updates below can run in place on row-aligned data — peak
+    # HBM stays ~2 matrix copies. (Round 4 realigned left-only and
+    # gathered permuted rows per strip into a full (gh-w, rt) `fresh`
+    # accumulator; at n=34048 that schedule needed 19.7 GB and failed
+    # to compile — a claim the round-4 report never actually backed.)
+    if not unstripped:
+        # Offset indices, not slice-then-gather: m[gs:][gperm] makes the
+        # compiler materialize the (gh, npad) slice AND the gather
+        # output (2 x 3.75 GB at n=32768, 70 MB over budget).
+        m = m.at[gs:].set(m[gs + gperm])
+    elif gs:
+        left = m[gs:, :gs][gperm]
+        m = m.at[gs:, :gs].set(left)
+    m = m.at[gs:, gs:gs + w].set(grp)
+    perm = perm.at[gs:].set(perm[gs:][gperm])
+
+    if rt:
+        # Deferred right-of-group update: the group's block rows of the
+        # right columns (already row-permuted in the strip form; via a
+        # composed-permutation gather otherwise), then
+        # U12 = L_group^-1 A12 as a blockwise scan over the group's
+        # chunk block rows (same zero-meets-U argument as
+        # _blockwise_substitution_scan), then the whole group's
+        # trailing contribution as one logical (gh-w, w) x (w, rt) MXU
+        # GEMM — one pass in the unstripped form, bounded in-place ROW
+        # STRIPS in the HBM-ceiling band.
+        if unstripped:
+            top = m[gs + gperm[:w]][:, gs + w:]  # (w, rt) block rows
+        else:
+            top = lax.dynamic_slice(m, (gs, gs + w), (w, rt))
+
+        def usolve(x, i, grp=grp):
+            rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
+            r = lax.dynamic_slice(top, (i * panel, 0), (panel, rt))
+            r = r - jnp.dot(rows, x, precision=gemm_prec)
+            xi = jnp.dot(linvs[i], r, precision=gemm_prec)
+            return lax.dynamic_update_slice(x, xi, (i * panel, 0)), i
+
+        u12, _ = lax.scan(usolve, jnp.zeros((w, rt), dtype),
+                          jnp.arange(gpanels))
+
+        if unstripped:
+            # One gather + one GEMM; transients peak ~3 trailing-block
+            # copies, fine while the byte gate holds.
+            def a22_full(rows_idx, l21_full):
+                old = m[gs + rows_idx][:, gs + w:]
+                return old - jnp.dot(l21_full, u12, precision=gemm_prec)
+
+            fresh = a22_full(gperm[w:], grp[w:])
+            # Writes come LAST: gperm[w:] can name original rows < w,
+            # so the gather must read the right region's OLD data — the
+            # u12 block-row write would clobber exactly those rows.
+            m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
+            m = lax.dynamic_update_slice(m, fresh, (gs + w, gs + w))
+        else:
+            # Rows are already permutation-aligned: each strip reads
+            # and writes only its own rows of m — in place, no
+            # accumulator, no read-after-write hazard.
+            m = lax.dynamic_update_slice(m, u12, (gs, gs + w))
+            sw = min(GROUP_UPDATE_STRIP, gh - w)
+            nfull = (gh - w) // sw
+
+            def strip_body(s, m):
+                r0 = w + s * sw
+                old = lax.dynamic_slice(m, (gs + r0, gs + w), (sw, rt))
+                l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
+                new = old - jnp.dot(l21, u12, precision=gemm_prec)
+                return lax.dynamic_update_slice(m, new, (gs + r0, gs + w))
+
+            m = lax.fori_loop(0, nfull, strip_body, m)
+            tail = (gh - w) - nfull * sw
+            if tail:
+                old = m[gs + w + nfull * sw:gs + gh, gs + w:]
+                new = old - jnp.dot(grp[w + nfull * sw:], u12,
+                                    precision=gemm_prec)
+                m = m.at[gs + w + nfull * sw:gs + gh, gs + w:].set(new)
+
+    return m, perm, min_piv, linvs, uinvs
 
 
 def lu_factor_blocked_phased(a: jax.Array, panel: int | None = None,

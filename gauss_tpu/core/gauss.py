@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from gauss_tpu.resilience import inject as _inject
+
 PIVOT_POLICIES = ("partial", "first_nonzero", "none")
 
 
@@ -158,12 +160,24 @@ def back_substitute(u: jax.Array, y: jax.Array) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("pivoting",))
+def _gauss_solve_jit(a: jax.Array, b: jax.Array,
+                     pivoting: str = "partial") -> jax.Array:
+    res = eliminate(a, b, pivoting=pivoting)
+    return back_substitute(res.u, res.y)
+
+
 def gauss_solve(a: jax.Array, b: jax.Array, pivoting: str = "partial") -> jax.Array:
     """Dense solve via forward elimination + back-substitution (oracle path).
 
     Equivalent end-to-end behavior to the reference's
     ``computeGauss`` + ``solveGauss`` pipeline (gauss_external_input.c:204-278).
     For the fast blocked/MXU path see :mod:`gauss_tpu.core.blocked`.
+
+    The host shim around the jitted pipeline is the "core.gauss.solve"
+    fault-injection hook point (gauss_tpu.resilience.inject) — one global
+    check when no plan is installed; calls inside an enclosing jit trace
+    pass through untouched, same contract as the blocked engine's hook.
     """
-    res = eliminate(a, b, pivoting=pivoting)
-    return back_substitute(res.u, res.y)
+    if _inject.enabled():
+        a = _inject.corrupt_operand("core.gauss.solve", a)
+    return _gauss_solve_jit(a, b, pivoting=pivoting)

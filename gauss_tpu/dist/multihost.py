@@ -34,6 +34,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from gauss_tpu.resilience import inject as _inject
+
 # None until initialize() succeeds, then the (coordinator, num_processes,
 # process_id) topology it was called with (for the idempotence check).
 _INITIALIZED = None
@@ -67,10 +69,23 @@ def initialize(coordinator: Optional[str] = None,
         raise RuntimeError(
             f"multihost.initialize() already called with topology "
             f"{_INITIALIZED}; cannot re-initialize as {requested}")
+    if _inject.enabled():
+        # Hook point "dist.multihost.straggler": a worker that shows up
+        # late to the rendezvous (the plan's ``param`` is the delay in
+        # seconds) — the gRPC coordination service, like mpirun, must
+        # either absorb the skew or fail the launch loudly.
+        _inject.maybe_delay("dist.multihost.straggler")
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
     _INITIALIZED = requested
+    if _inject.enabled():
+        # Hook point "dist.multihost.worker": kill THIS worker right after
+        # it joined (kind="kill" is a real os._exit — the preempted-VM
+        # stand-in). Surviving ranks must surface a collective failure,
+        # never a silent wrong answer. Workers inherit the plan through
+        # the GAUSS_FAULTS environment variable.
+        _inject.maybe_kill("dist.multihost.worker")
 
 
 def is_multihost() -> bool:

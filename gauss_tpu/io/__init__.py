@@ -1,6 +1,11 @@
 """Matrix I/O: .dat coordinate-format files and synthetic initializers."""
 
-from gauss_tpu.io.datfile import read_dat, read_dat_dense, write_dat  # noqa: F401
+from gauss_tpu.io.datfile import (  # noqa: F401
+    DatFormatError,
+    read_dat,
+    read_dat_dense,
+    write_dat,
+)
 from gauss_tpu.io.synthetic import (  # noqa: F401
     internal_matrix,
     internal_rhs,

@@ -7,24 +7,43 @@ parser in gauss_external_input.c:34-86):
     body:   ``row col value``     one entry per line, **1-indexed**
     end:    ``0 0 0``             terminator row (optional in some files)
 
-Entries may appear in any order; duplicate coordinates take the last value
-(matching the reference's densifying loop, which overwrites). Matrices are
-densified to row-major n x n on load exactly as ``initMatrix`` does in the
-external-input programs.
+Entries may appear in any order. By default (``strict=True``) the parser
+REJECTS, with a typed :class:`DatFormatError` carrying the offending line
+number, three classes of file the reference's fscanf loop silently accepts
+into a bad matrix: non-finite values (a NaN/Inf entry poisons every solve
+downstream), duplicate ``(row, col)`` coordinates (the reference's
+densifying loop overwrites — two generators disagreeing about one entry is
+a corrupt file, not a preference), and a missing ``0 0 0`` terminator (the
+classic truncated-upload signature). ``strict=False`` restores the exact
+reference semantics — last duplicate wins, EOF terminates — for bug-parity
+experiments.
 
 A faster C++ parser for large files is provided by :mod:`gauss_tpu.native`
-(``read_dat_dense(..., engine="native")`` uses it when built).
+(``read_dat_dense(..., engine="native")`` uses it when built). The native
+parser does not run the strict per-line checks; ``read_dat_dense`` applies
+a whole-matrix finite check to its output instead.
 """
 
 from __future__ import annotations
 
 import io as _io
 import os
-from typing import TextIO, Tuple, Union
+from typing import Optional, TextIO, Tuple, Union
 
 import numpy as np
 
 PathOrFile = Union[str, os.PathLike, TextIO]
+
+
+class DatFormatError(ValueError):
+    """A malformed .dat file, with the 1-indexed line of the offense when
+    known (``.line``; the header is line 1). Subclasses ValueError so
+    pre-existing ``except ValueError`` call sites keep working."""
+
+    def __init__(self, message: str, line: Optional[int] = None):
+        super().__init__(f"line {line}: {message}" if line is not None
+                         else message)
+        self.line = line
 
 
 def _open_maybe(path_or_file: PathOrFile, mode: str):
@@ -33,45 +52,105 @@ def _open_maybe(path_or_file: PathOrFile, mode: str):
     return open(path_or_file, mode), True
 
 
-def read_dat(path_or_file: PathOrFile) -> Tuple[int, np.ndarray, np.ndarray, np.ndarray]:
-    """Parse a .dat file -> (n, rows, cols, vals) with 0-indexed coordinates."""
+def read_dat(path_or_file: PathOrFile, strict: bool = True,
+             ) -> Tuple[int, np.ndarray, np.ndarray, np.ndarray]:
+    """Parse a .dat file -> (n, rows, cols, vals) with 0-indexed coordinates.
+
+    ``strict`` additionally rejects non-finite values, duplicate (row, col)
+    coordinates, and a missing ``0 0 0`` terminator — each as a
+    :class:`DatFormatError` with the offending line number — instead of
+    silently building a bad matrix (reference fscanf behavior, available
+    via ``strict=False``)."""
     f, close = _open_maybe(path_or_file, "r")
     try:
         header = f.readline().split()
         if len(header) < 3:
-            raise ValueError("malformed .dat header; expected 'n n nnz'")
-        n = int(header[0])
-        n2 = int(header[1])
-        nnz = int(header[2])
+            raise DatFormatError("malformed .dat header; expected 'n n nnz'",
+                                 line=1)
+        try:
+            n = int(header[0])
+            n2 = int(header[1])
+            nnz = int(header[2])
+        except ValueError as e:
+            raise DatFormatError(
+                f"malformed .dat header: {' '.join(header[:3])!r}",
+                line=1) from e
         if n != n2:
-            raise ValueError(f"non-square matrix in .dat header: {n} x {n2}")
+            raise DatFormatError(
+                f"non-square matrix in .dat header: {n} x {n2}", line=1)
+        if n < 0 or nnz < 0:
+            raise DatFormatError(
+                f"negative dimension in .dat header: n={n} nnz={nnz}", line=1)
         rows = np.empty(nnz, dtype=np.int64)
         cols = np.empty(nnz, dtype=np.int64)
         vals = np.empty(nnz, dtype=np.float64)
+        lines = np.empty(nnz, dtype=np.int64)  # per-entry source line
         count = 0
+        terminated = False
+        lineno = 1
         for line in f:
+            lineno += 1
             parts = line.split()
             if not parts:
                 continue
             if len(parts) < 2 or (len(parts) < 3 and not (parts[0] == "0" and parts[1] == "0")):
-                raise ValueError(f"malformed .dat body line: {line.rstrip()!r}")
+                raise DatFormatError(
+                    f"malformed .dat body line: {line.rstrip()!r}",
+                    line=lineno)
             try:
                 r, c = int(parts[0]), int(parts[1])
             except ValueError as e:
-                raise ValueError(f"malformed .dat body line: {line.rstrip()!r}") from e
+                raise DatFormatError(
+                    f"malformed .dat body line: {line.rstrip()!r}",
+                    line=lineno) from e
             if r == 0 and c == 0:  # `0 0 0` terminator
+                terminated = True
                 break
             if count >= nnz:
-                raise ValueError(".dat body has more entries than header nnz")
+                raise DatFormatError(
+                    ".dat body has more entries than header nnz",
+                    line=lineno)
             if not (1 <= r <= n and 1 <= c <= n):
-                raise ValueError(
-                    f".dat entry ({r}, {c}) out of bounds for 1-indexed {n} x {n} matrix")
+                raise DatFormatError(
+                    f".dat entry ({r}, {c}) out of bounds for 1-indexed "
+                    f"{n} x {n} matrix", line=lineno)
+            try:
+                v = float(parts[2])
+            except ValueError as e:
+                raise DatFormatError(
+                    f"malformed .dat body line: {line.rstrip()!r}",
+                    line=lineno) from e
+            if strict and not np.isfinite(v):
+                raise DatFormatError(
+                    f"non-finite value {parts[2]!r} at entry ({r}, {c}); a "
+                    f"NaN/Inf entry poisons every downstream solve",
+                    line=lineno)
             rows[count] = r - 1
             cols[count] = c - 1
-            vals[count] = float(parts[2])
+            vals[count] = v
+            lines[count] = lineno
             count += 1
         if count != nnz:
-            raise ValueError(f".dat body has {count} entries, header promised {nnz}")
+            raise DatFormatError(
+                f".dat body has {count} entries, header promised {nnz}",
+                line=lineno)
+        if strict and not terminated:
+            raise DatFormatError(
+                "missing '0 0 0' terminator (truncated file?); pass "
+                "strict=False to accept EOF-terminated files", line=lineno)
+        if strict and nnz:
+            # Vectorized duplicate scan (a per-line set would cost O(nnz)
+            # python-object memory on generator-format files).
+            codes = rows * np.int64(n) + cols
+            order = np.argsort(codes, kind="stable")
+            dup = np.nonzero(np.diff(codes[order]) == 0)[0]
+            if dup.size:
+                i1, i2 = order[dup[0]], order[dup[0] + 1]
+                raise DatFormatError(
+                    f"duplicate .dat entry ({rows[i2] + 1}, {cols[i2] + 1}) "
+                    f"(first at line {lines[i1]}); the reference's "
+                    f"last-wins overwrite is available via strict=False",
+                    line=int(lines[i2]))
         return n, rows, cols, vals
     finally:
         if close:
@@ -87,27 +166,40 @@ def densify(n: int, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
 
 
 def read_dat_dense(path_or_file: PathOrFile, dtype=np.float64,
-                   engine: str = "auto") -> np.ndarray:
+                   engine: str = "auto", strict: bool = True) -> np.ndarray:
     """Parse + densify in one step (the external-input programs' initMatrix).
 
-    engine: "python", "native" (C++ parser via ctypes), or "auto" (native when
-    available and the input is a real file path, else python).
+    engine: "python", "native" (C++ parser via ctypes), or "auto" — native
+    when available, the input is a real file path, AND ``strict`` is off;
+    python otherwise. The native parser has no per-line validation (its
+    output gets only a whole-matrix finite check — no line numbers, no
+    duplicate/terminator detection), so the strict default routes "auto"
+    through the fully-checked python parser: safety by default, the
+    unchecked fast path by explicit request (``engine="native"`` or
+    ``strict=False``).
     """
     is_path = not (hasattr(path_or_file, "read"))
     if engine not in ("auto", "python", "native"):
         raise ValueError(f"unknown engine {engine!r}")
     if engine == "native" and not is_path:
         raise ValueError("engine='native' requires a file path, not a file object")
-    if engine in ("auto", "native") and is_path:
+    if (engine == "native" or (engine == "auto" and not strict)) and is_path:
         try:
             from gauss_tpu import native
 
             if native.available() or engine == "native":
-                return native.read_dat_dense(os.fspath(path_or_file)).astype(dtype, copy=False)
+                dense = native.read_dat_dense(
+                    os.fspath(path_or_file)).astype(dtype, copy=False)
+                if strict and not np.isfinite(dense).all():
+                    raise DatFormatError(
+                        f"non-finite value(s) in {os.fspath(path_or_file)!r} "
+                        f"(native parser; re-read with engine='python' for "
+                        f"the offending line)")
+                return dense
         except Exception:
             if engine == "native":
                 raise
-    n, rows, cols, vals = read_dat(path_or_file)
+    n, rows, cols, vals = read_dat(path_or_file, strict=strict)
     return densify(n, rows, cols, vals, dtype=dtype)
 
 

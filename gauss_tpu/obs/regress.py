@@ -120,6 +120,21 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "chaos_campaign":
+        # A chaos-campaign summary (python -m gauss_tpu.resilience.chaos
+        # --summary-json): recovery-depth and per-case cost enter history so
+        # a RECOVERY-RATE regression (the ladder escalating deeper, or
+        # failing where it used to recover) gates exactly like a perf
+        # regression. Metric derivation lives with the campaign runner
+        # (single source); lazy import so reading BENCH records never pulls
+        # the solver stack into this module.
+        from gauss_tpu.resilience.chaos import history_records as chaos_hist
+
+        for metric, value, unit in chaos_hist(doc):
+            rec = _record(metric, value, path, "chaos", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, list):  # bench-grid --json cells
         for cell in doc:
             if isinstance(cell, dict) and cell.get("verified"):

@@ -227,6 +227,75 @@ def _serving_lines(sv: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def resilience_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the resilience layer's events (``fault`` injections from
+    gauss_tpu.resilience.inject, ``recovery`` ladder steps from
+    recover.solve_resilient, ``checkpoint`` save/resume from the
+    checkpointed factorization) into one report: injections by site and
+    kind, recoveries by rung, escalation/unrecoverable counts, checkpoint
+    activity. Empty dict when the run saw none of it — healthy runs carry
+    no resilience noise."""
+    faults = [ev for ev in events if ev.get("type") == "fault"]
+    recov = [ev for ev in events if ev.get("type") == "recovery"]
+    ckpts = [ev for ev in events if ev.get("type") == "checkpoint"]
+    if not (faults or recov or ckpts):
+        return {}
+    by_site: Dict[str, int] = {}
+    by_kind: Dict[str, int] = {}
+    for ev in faults:
+        site = str(ev.get("site", "?"))
+        kind = str(ev.get("kind", "?"))
+        by_site[site] = by_site.get(site, 0) + 1
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    recovered_by_rung: Dict[str, int] = {}
+    escalations = 0
+    unrecoverable = 0
+    for ev in recov:
+        outcome = ev.get("outcome")
+        if outcome == "recovered":
+            rung = str(ev.get("rung", "?"))
+            recovered_by_rung[rung] = recovered_by_rung.get(rung, 0) + 1
+        elif outcome == "escalate":
+            escalations += 1
+        elif outcome == "unrecoverable":
+            unrecoverable += 1
+    ckpt_counts: Dict[str, int] = {}
+    for ev in ckpts:
+        k = str(ev.get("event", "?"))
+        ckpt_counts[k] = ckpt_counts.get(k, 0) + 1
+    return {
+        "injections": {"total": len(faults), "by_site": by_site,
+                       "by_kind": by_kind},
+        "recoveries": {"total": sum(recovered_by_rung.values()),
+                       "by_rung": recovered_by_rung},
+        "escalations": escalations,
+        "unrecoverable": unrecoverable,
+        "checkpoints": ckpt_counts,
+    }
+
+
+def _resilience_lines(rs: Dict[str, Any]) -> List[str]:
+    inj = rs["injections"]
+    rec = rs["recoveries"]
+    lines = []
+    sites = ", ".join(f"{k} x{v}" for k, v in sorted(inj["by_site"].items()))
+    kinds = ", ".join(f"{k} x{v}" for k, v in sorted(inj["by_kind"].items()))
+    lines.append(f"  injected faults: {inj['total']}"
+                 + (f"  ({sites})" if sites else ""))
+    if kinds:
+        lines.append(f"  by kind: {kinds}")
+    rungs = ", ".join(f"{k} x{v}" for k, v in sorted(rec["by_rung"].items()))
+    lines.append(f"  recoveries: {rec['total']}"
+                 + (f"  (by rung: {rungs})" if rungs else "")
+                 + f"; {rs['escalations']} escalation step(s), "
+                 f"{rs['unrecoverable']} unrecoverable")
+    if rs["checkpoints"]:
+        ck = ", ".join(f"{k} x{v}"
+                       for k, v in sorted(rs["checkpoints"].items()))
+        lines.append(f"  checkpoints: {ck}")
+    return lines
+
+
 def _human_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -281,6 +350,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "profile": flat_profile(evs),
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
         "serving": serving_summary(evs),
+        "resilience": resilience_summary(evs),
         "comms": comms_summary(evs),
         "compile": [_strip(ev) for ev in evs
                     if ev.get("type") in ("compile", "cost")],
@@ -331,6 +401,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("serving:")
         out.extend(_serving_lines(serving))
+
+    resilience = resilience_summary(evs)
+    if resilience:
+        out.append("")
+        out.append("resilience:")
+        out.extend(_resilience_lines(resilience))
 
     comms = comms_summary(evs)
     if comms:

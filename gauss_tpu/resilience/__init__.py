@@ -1,0 +1,56 @@
+"""gauss_tpu.resilience — fault injection, recovery ladders, checkpointed solves.
+
+The reference programs simply abort on a bad pivot or malformed input, and
+the obs layer so far only *observes* trouble (health records min-pivot /
+growth / residual but nothing acts on it). This package closes the loop, the
+chaos-engineering way production serving stacks do:
+
+- :mod:`gauss_tpu.resilience.inject` — a seeded, deterministic
+  fault-injection framework. Named hook points threaded through core, serve,
+  and dist (see docs/RESILIENCE.md for the catalog) poll an installed
+  :class:`FaultPlan`; off by default with zero hot-path cost.
+- :mod:`gauss_tpu.resilience.recover` — ``solve_resilient(a, b)``: every
+  result gated on the health monitors (finite / min-pivot / 1e-4 relative
+  residual), failures escalated along an explicit ladder (pivot-safe
+  refactor -> double-single refinement -> alternate engine -> host NumPy
+  f64), each step an obs ``recovery`` event, a typed
+  :class:`UnrecoverableSolveError` only when the ladder is exhausted.
+- :mod:`gauss_tpu.resilience.checkpoint` — panel-granular checkpoint/resume
+  for the chunked blocked factorization: a killed long solve resumes from
+  the last checkpoint, bit-identical to an uninterrupted run.
+- :mod:`gauss_tpu.resilience.chaos` — the campaign runner
+  (``python -m gauss_tpu.resilience.chaos``): seeded randomized fault plans
+  swept across engines and hook points, asserting the one invariant that
+  matters — every injected fault is either recovered (verified solution) or
+  surfaced as a typed error; never a silent wrong answer.
+
+``inject`` is imported eagerly (it is stdlib+numpy only and the hook points
+in core/serve/dist reference it at module load); the other submodules import
+the solver stack and load lazily via ``__getattr__`` to keep
+``core -> inject`` dependency-cycle-free.
+"""
+
+from gauss_tpu.resilience.inject import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    SimulatedCompileError,
+    SimulatedFaultError,
+)
+
+_LAZY = ("recover", "checkpoint", "chaos", "inject")
+
+
+def __getattr__(name):
+    if name == "UnrecoverableSolveError":
+        from gauss_tpu.resilience.recover import UnrecoverableSolveError
+
+        return UnrecoverableSolveError
+    if name == "solve_resilient":
+        from gauss_tpu.resilience.recover import solve_resilient
+
+        return solve_resilient
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(f"gauss_tpu.resilience.{name}")
+    raise AttributeError(f"module 'gauss_tpu.resilience' has no attribute {name!r}")

@@ -1,0 +1,415 @@
+"""Chaos campaign runner: ``python -m gauss_tpu.resilience.chaos``.
+
+Sweeps seeded randomized fault plans across engines and hook points and
+asserts the one invariant a solver service must never break:
+
+    **every injected fault is either recovered — a solution the RUNNER
+    independently verifies at the relative-residual gate — or surfaced as a
+    typed error. Never a silent wrong answer.**
+
+Three phases:
+
+- **solver** (``--cases``): each case draws an engine (blocked / rank-1), a
+  size, and a fault scenario from a seeded catalog — transient or
+  persistent operand corruption (NaN / Inf / bit-flip / forced near-zero
+  pivot) at the engine's hook point, corruption of BOTH engines (forces the
+  ladder to the host-NumPy rung), or input corruption (expected outcome: a
+  typed ``UnrecoverableSolveError``) — installs the plan, and runs
+  :func:`gauss_tpu.resilience.recover.solve_resilient`.
+- **serve** (``--serve-requests``): a live :class:`SolverServer` under
+  injected executable-compile failures and worker-dispatch stalls (deadline
+  pressure); every request must reach exactly one terminal status, and
+  every ``ok`` solution is verified.
+- **checkpoint**: a checkpointed chunked factorization killed mid-run (the
+  ``checkpoint.group`` hook) must resume to a factorization bit-identical
+  to an uninterrupted run.
+
+The summary (``--summary-json``) is regress-ingestable
+(``kind: chaos_campaign``): recovery depth (``mean_rung``), typed-error
+rate, and per-case wall-clock enter ``reports/history.jsonl`` so a
+recovery-rate regression gates like a perf regression. Exit status: 2 when
+the invariant is violated (silent wrong answer or untyped error), 1 when
+``--regress-check`` finds an out-of-band metric, 0 otherwise.
+
+``make faults-check`` runs the CPU smoke configuration CI gates on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+#: solver-phase scenario catalog: (name, weight). Weights keep the common
+#: transient case dominant, like real fleets: most faults are one-shot.
+SCENARIOS = (
+    ("transient", 6),      # one-shot corruption at the primary engine
+    ("persistent", 2),     # corruption on EVERY primary-engine call
+    ("persistent_all", 1),  # both engines corrupted -> numpy rung
+    ("input", 1),          # corrupt the input itself -> typed error
+)
+CORRUPT_KINDS = ("nan", "inf", "bitflip", "near_zero_pivot")
+
+ENGINE_SITES = {"blocked": "core.blocked.factor",
+                "rank1": "core.gauss.solve"}
+
+
+def _system(rng: np.random.Generator, n: int):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)  # diagonally dominant
+    return a, rng.standard_normal(n)
+
+
+def _solver_case(i: int, seed: int, engines, sizes, panel, gate):
+    """Run one seeded solver case; returns its outcome record."""
+    from gauss_tpu.resilience import inject, recover
+    from gauss_tpu.verify import checks
+
+    rng = np.random.default_rng(np.random.SeedSequence((seed, i)))
+    engine = engines[i % len(engines)]
+    n = int(sizes[int(rng.integers(0, len(sizes)))])
+    names = [s for s, w in SCENARIOS for _ in range(w)]
+    scenario = names[int(rng.integers(0, len(names)))]
+    kind = CORRUPT_KINDS[int(rng.integers(0, len(CORRUPT_KINDS)))]
+    a, b = _system(rng, n)
+
+    specs = []
+    if scenario == "transient":
+        specs = [inject.FaultSpec(site=ENGINE_SITES[engine], kind=kind,
+                                  max_triggers=1, seed=i)]
+    elif scenario == "persistent":
+        specs = [inject.FaultSpec(site=ENGINE_SITES[engine], kind=kind,
+                                  max_triggers=None, seed=i)]
+    elif scenario == "persistent_all":
+        specs = [inject.FaultSpec(site=s, kind=kind, max_triggers=None,
+                                  seed=i + j)
+                 for j, s in enumerate(ENGINE_SITES.values())]
+    else:  # input
+        specs = [inject.FaultSpec(site="chaos.input",
+                                  kind="nan" if kind == "bitflip" else kind,
+                                  max_triggers=1, seed=i)]
+
+    out = {"case": i, "engine": engine, "n": n, "scenario": scenario,
+           "kind": kind}
+    with inject.plan(inject.FaultPlan(specs, seed=seed)) as ap:
+        if scenario == "input":
+            a = inject.corrupt_operand("chaos.input", a)
+        try:
+            res = recover.solve_resilient(a, b, engine=engine, panel=panel,
+                                          gate=gate)
+            # The runner's OWN verification — the invariant must not trust
+            # the ladder's gate to judge the ladder.
+            rel = checks.residual_norm(a, res.x, b, relative=True)
+            if np.isfinite(rel) and rel <= gate:
+                out.update(outcome="recovered" if res.rung_index else "ok",
+                           rung=res.rung, rung_index=res.rung_index,
+                           rel_residual=rel)
+            else:
+                out.update(outcome="silent_wrong", rung=res.rung,
+                           rel_residual=float(rel))
+        except recover.UnrecoverableSolveError as e:
+            out.update(outcome="typed_error", trigger=e.trigger)
+        except Exception as e:  # noqa: BLE001 — an untyped escape IS the bug
+            out.update(outcome="violation",
+                       error=f"{type(e).__name__}: {e}"[:200])
+        out["injected"] = ap.stats()
+    return out
+
+
+def run_solver_phase(cases: int, seed: int, engines, sizes, panel, gate,
+                     log=print) -> Dict:
+    from gauss_tpu import obs
+
+    outcomes: List[Dict] = []
+    with obs.span("chaos_solver_phase", cases=cases):
+        for i in range(cases):
+            outcomes.append(_solver_case(i, seed, engines, sizes, panel,
+                                         gate))
+            if (i + 1) % 50 == 0:
+                log(f"  solver cases: {i + 1}/{cases}")
+    by_rung: Dict[str, int] = {}
+    counts = {"ok": 0, "recovered": 0, "typed_error": 0, "silent_wrong": 0,
+              "violation": 0}
+    rung_depths = []
+    inj_site: Dict[str, int] = {}
+    inj_kind: Dict[str, int] = {}
+    injected = 0
+    for o in outcomes:
+        counts[o["outcome"]] = counts.get(o["outcome"], 0) + 1
+        if o["outcome"] in ("ok", "recovered"):
+            by_rung[o["rung"]] = by_rung.get(o["rung"], 0) + 1
+            rung_depths.append(o["rung_index"] + 1)
+        st = o.get("injected", {})
+        injected += st.get("triggered", 0)
+        for k, v in st.get("by_site", {}).items():
+            inj_site[k] = inj_site.get(k, 0) + v
+        for k, v in st.get("by_kind", {}).items():
+            inj_kind[k] = inj_kind.get(k, 0) + v
+    return {
+        "cases": cases, "counts": counts, "recovered_by_rung": by_rung,
+        "mean_rung": (round(float(np.mean(rung_depths)), 4)
+                      if rung_depths else None),
+        "typed_error_rate": round(counts["typed_error"] / cases, 4)
+        if cases else None,
+        "injected": injected, "injected_by_site": inj_site,
+        "injected_by_kind": inj_kind,
+    }
+
+
+def run_serve_phase(requests: int, seed: int, gate: float) -> Dict:
+    from gauss_tpu import obs
+    from gauss_tpu.resilience import inject
+    from gauss_tpu.serve import ServeConfig, SolverServer
+    from gauss_tpu.verify import checks
+
+    cfg = ServeConfig(ladder=(32, 64), max_batch=4, panel=16, refine_steps=1,
+                      verify_gate=gate, max_retries=2, retry_backoff_s=0.0,
+                      unhealthy_after=2, device_probe_cooldown_s=0.05)
+    plan = inject.FaultPlan([
+        inject.FaultSpec(site="serve.cache.compile", kind="compile_fail",
+                         p=0.35, max_triggers=None, seed=1),
+        inject.FaultSpec(site="serve.worker.dispatch", kind="delay",
+                         p=0.25, max_triggers=None, param=0.02, seed=2),
+    ], seed=seed)
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0x5e12e)))
+    counts: Dict[str, int] = {}
+    incorrect = 0
+    unresolved = 0
+    injected = {}
+    with obs.span("chaos_serve_phase", requests=requests):
+        with inject.plan(plan) as ap:
+            with SolverServer(cfg) as srv:
+                handles = []
+                for i in range(requests):
+                    n = int(rng.integers(8, 49))
+                    a, b = _system(rng, n)
+                    # every 5th request runs under deadline pressure
+                    dl = 0.01 if i % 5 == 4 else None
+                    handles.append((a, b, srv.submit(a, b, deadline_s=dl)))
+                for a, b, h in handles:
+                    try:
+                        res = h.result(timeout=120)
+                    except TimeoutError:
+                        unresolved += 1
+                        continue
+                    counts[res.status] = counts.get(res.status, 0) + 1
+                    if res.status == "ok":
+                        rel = checks.residual_norm(a, res.x, b,
+                                                   relative=True)
+                        if not rel <= gate:
+                            incorrect += 1
+            injected = ap.stats()
+    return {"requests": requests, "counts": counts, "incorrect": incorrect,
+            "unresolved": unresolved, "injected": injected.get("triggered", 0),
+            "injected_by_site": injected.get("by_site", {})}
+
+
+def run_checkpoint_phase(tmpdir: str) -> Dict:
+    import jax.numpy as jnp
+
+    from gauss_tpu import obs
+    from gauss_tpu.core import blocked
+    from gauss_tpu.resilience import checkpoint as ckpt
+    from gauss_tpu.resilience import inject
+
+    rng = np.random.default_rng(2584580)
+    n = 96
+    a = (rng.standard_normal((n, n)) + np.diag([float(n)] * n)).astype(
+        np.float32)
+    kw = dict(panel=16, chunk=2)
+    with obs.span("chaos_checkpoint_phase"):
+        clean = ckpt.lu_factor_blocked_chunked_checkpointed(
+            a, f"{tmpdir}/chaos_ck_clean.npz", **kw)
+        path = f"{tmpdir}/chaos_ck_killed.npz"
+        plan = inject.FaultPlan([inject.FaultSpec(
+            site="checkpoint.group", kind="raise", max_triggers=1, skip=2)])
+        killed = False
+        with inject.plan(plan) as ap:
+            try:
+                ckpt.lu_factor_blocked_chunked_checkpointed(a, path, **kw)
+            except inject.SimulatedFaultError:
+                killed = True
+            injected = ap.stats()["triggered"]
+        resumed = ckpt.lu_factor_blocked_chunked_checkpointed(a, path, **kw)
+        identical = all(
+            np.array_equal(np.asarray(getattr(clean, f)),
+                           np.asarray(getattr(resumed, f)))
+            for f in ("m", "perm", "min_abs_pivot", "linv", "uinv"))
+        # and the factor actually solves
+        b = rng.standard_normal(n)
+        x = np.asarray(blocked.lu_solve(resumed, jnp.asarray(b, jnp.float32)))
+        from gauss_tpu.verify import checks
+
+        rel = checks.residual_norm(a, x, b, relative=True)
+    return {"ran": True, "killed": killed, "bit_identical": bool(identical),
+            "injected": injected, "resumed_rel_residual": float(rel)}
+
+
+def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
+    """(metric, value, unit) records a campaign contributes to the
+    regression history. All slow-side-gated: recovery regressing shows as a
+    DEEPER mean rung or a HIGHER typed-error rate; throughput regressing as
+    more seconds per case."""
+    out: List[Tuple[str, float, str]] = []
+    sol = summary.get("solver") or {}
+    if isinstance(sol.get("mean_rung"), (int, float)) and sol["mean_rung"] > 0:
+        out.append(("chaos:solver/mean_rung", sol["mean_rung"], "rung"))
+    ter = sol.get("typed_error_rate")
+    if isinstance(ter, (int, float)) and ter > 0:
+        out.append(("chaos:solver/typed_error_rate", ter, "ratio"))
+    wall = summary.get("wall_s")
+    cases = sol.get("cases")
+    if isinstance(wall, (int, float)) and wall > 0 and cases:
+        out.append(("chaos:solver/s_per_case", round(wall / cases, 6), "s"))
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.resilience.chaos",
+        description="Seeded chaos campaign: inject faults across engines "
+                    "and hook points; assert every fault is recovered "
+                    "(verified) or a typed error — never a silent wrong "
+                    "answer.")
+    p.add_argument("--cases", type=int, default=200,
+                   help="solver-phase fault cases (default 200)")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--engines", default="blocked,rank1",
+                   help="comma-separated primary engines (default both)")
+    p.add_argument("--sizes", default="24,32,48",
+                   help="comma-separated system sizes (small: the campaign "
+                        "is about fault paths, not FLOPs)")
+    p.add_argument("--panel", type=int, default=16)
+    p.add_argument("--gate", type=float, default=1e-4,
+                   help="relative-residual verification bar (default 1e-4)")
+    p.add_argument("--serve-requests", type=int, default=30,
+                   help="serve-phase request count (0 disables the phase)")
+    p.add_argument("--no-checkpoint", action="store_true",
+                   help="skip the checkpoint kill/resume phase")
+    p.add_argument("--tmpdir", default="/tmp",
+                   help="where the checkpoint phase writes its files")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="append the campaign's obs JSONL stream (faults, "
+                        "recovery events, serving events) here")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the campaign summary (regress-ingestable: "
+                        "kind=chaos_campaign)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append this campaign's records to the regression "
+                        "history (default reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate this campaign against the history baselines "
+                        "(exit 1 when out of band)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    honor_jax_platforms()
+
+    from gauss_tpu import obs
+    from gauss_tpu.obs import regress
+
+    engines = [e.strip() for e in args.engines.split(",") if e.strip()]
+    sizes = [int(s) for s in args.sizes.split(",")]
+    bad = [e for e in engines if e not in ENGINE_SITES]
+    if bad:
+        print(f"chaos: unknown engine(s) {bad}; options: "
+              f"{sorted(ENGINE_SITES)}", file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    with obs.run(metrics_out=args.metrics_out, tool="chaos_campaign",
+                 cases=args.cases, seed=args.seed):
+        solver = run_solver_phase(args.cases, args.seed, engines, sizes,
+                                  args.panel, args.gate)
+        serve = (run_serve_phase(args.serve_requests, args.seed, args.gate)
+                 if args.serve_requests > 0 else {})
+        ckpt = ({} if args.no_checkpoint
+                else run_checkpoint_phase(args.tmpdir))
+        wall = round(time.perf_counter() - t0, 3)
+
+        violations = (solver["counts"]["silent_wrong"]
+                      + solver["counts"]["violation"]
+                      + (serve.get("incorrect", 0) if serve else 0)
+                      + (serve.get("unresolved", 0) if serve else 0)
+                      + (0 if not ckpt or ckpt["bit_identical"] else 1))
+        injected = (solver["injected"] + (serve.get("injected", 0))
+                    + (ckpt.get("injected", 0) if ckpt else 0))
+        sites = dict(solver["injected_by_site"])
+        for k, v in (serve.get("injected_by_site") or {}).items():
+            sites[k] = sites.get(k, 0) + v
+        if ckpt.get("injected"):
+            sites["checkpoint.group"] = (sites.get("checkpoint.group", 0)
+                                         + ckpt["injected"])
+        summary = {
+            "kind": "chaos_campaign", "seed": args.seed,
+            "engines": engines, "sizes": sizes, "gate": args.gate,
+            "injected": injected, "injected_by_site": sites,
+            "solver": solver, "serve": serve, "checkpoint": ckpt,
+            "wall_s": wall, "invariant_ok": violations == 0,
+        }
+        obs.emit("chaos_campaign",
+                 **{k: v for k, v in summary.items() if k != "kind"})
+
+    c = solver["counts"]
+    print(f"chaos campaign: {args.cases} solver case(s) over "
+          f"{'+'.join(engines)} @ n={sizes}, {injected} fault(s) injected "
+          f"across {len(sites)} site(s)")
+    print(f"  solver: {c['ok']} clean, {c['recovered']} recovered "
+          f"(by rung: {solver['recovered_by_rung']}), "
+          f"{c['typed_error']} typed error(s), "
+          f"{c['silent_wrong']} SILENT WRONG, {c['violation']} untyped")
+    if serve:
+        print(f"  serve: {serve['requests']} request(s) -> "
+              f"{serve['counts']}, {serve['incorrect']} incorrect, "
+              f"{serve['unresolved']} unresolved, "
+              f"{serve['injected']} fault(s)")
+    if ckpt:
+        print(f"  checkpoint: killed={ckpt['killed']} "
+              f"bit_identical={ckpt['bit_identical']} "
+              f"rel_residual={ckpt['resumed_rel_residual']:.3e}")
+    print(f"  invariant {'HOLDS' if violations == 0 else 'VIOLATED'} "
+          f"({wall} s)")
+
+    if args.summary_json:
+        parent = os.path.dirname(args.summary_json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.summary_json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    records = [{"metric": m, "value": v, "unit": u, "source": "chaos",
+                "kind": "chaos"} for m, v, u in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(
+            records, regress.load_history(history_path))
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = 1
+    if args.history is not None and records and rc == 0:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+
+    if violations:
+        print(f"chaos: INVARIANT VIOLATED ({violations} case(s))",
+              file=sys.stderr)
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

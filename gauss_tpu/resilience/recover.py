@@ -1,0 +1,255 @@
+"""solve_resilient: health-gated solves with an explicit escalation ladder.
+
+The obs health monitors (finite / min-pivot / residual) so far only record
+trouble; this module ACTS on it. Every candidate solution is gated on the
+same three monitors ``obs.health`` records, and a failed gate escalates
+along an explicit recovery ladder instead of returning a wrong answer or
+crashing:
+
+    rung 0  primary engine        blocked f32 factor + host-f64 refinement
+                                  (or the rank-1 oracle engine)
+    rung 1  pivot_safe            re-factor with ``zero_pivot_safe``
+                                  pivoting (a corrupted or near-singular
+                                  system factors to a FINITE factor the
+                                  residual gate can judge) + refinement
+    rung 2  ds_refine             double-single on-device refinement
+                                  (core.dsfloat — the Carson & Higham-style
+                                  mixed-precision rung, cf. PAPERS.md)
+    rung 3  alternate engine      the other engine (blocked <-> rank-1):
+                                  survives a fault pinned to one engine's
+                                  code path
+    rung 4  numpy_f64             host LAPACK in float64 — always available,
+                                  the serving layer's degraded lane
+
+Each escalation emits an obs ``recovery`` event (trigger, rung, attempt,
+outcome), so the summarizer's resilience section and the chaos campaign
+count recoveries from the stream. Only when every rung has failed does a
+typed :class:`UnrecoverableSolveError` surface — the invariant the chaos
+campaign asserts is exactly "verified solution or this error, never a
+silent wrong answer".
+
+A healthy solve pays one rung-0 solve plus the gate's O(n^2) host residual
+(which the refined solvers compute anyway) and emits nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.verify import checks
+
+#: relative-residual acceptance bar (the reference EPSILON, BASELINE.json)
+DEFAULT_GATE = 1e-4
+
+ENGINES = ("blocked", "rank1")
+
+def default_rungs(engine: str = "blocked") -> Tuple[str, ...]:
+    """The ladder's rung names in escalation order for a primary engine."""
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r}; options: {ENGINES}")
+    alternate = "rank1" if engine == "blocked" else "blocked"
+    return (engine, "pivot_safe", "ds_refine", alternate, "numpy_f64")
+
+
+class UnrecoverableSolveError(RuntimeError):
+    """The ladder is exhausted: every rung failed its gate or raised.
+
+    ``trigger``: the last rung's failure reason; ``attempts``: the
+    (rung, trigger) history — what the obs stream also recorded.
+    """
+
+    def __init__(self, message: str, trigger: Optional[str] = None,
+                 attempts: Optional[List[Tuple[str, str]]] = None):
+        super().__init__(message)
+        self.trigger = trigger
+        self.attempts = list(attempts or ())
+
+
+@dataclasses.dataclass
+class ResilientResult:
+    """A gated solve: the solution plus how hard the ladder worked for it."""
+
+    x: np.ndarray
+    rung: str                  # the rung that produced the accepted solution
+    rung_index: int            # 0 = healthy first try
+    attempts: int              # rungs tried (1 = no escalation)
+    rel_residual: float
+    escalations: List[Tuple[str, str]]  # (rung, trigger) of each failure
+
+    @property
+    def recovered(self) -> bool:
+        return self.rung_index > 0
+
+
+def _gate(a64: np.ndarray, b64: np.ndarray, x, factors=None,
+          gate: float = DEFAULT_GATE) -> Tuple[bool, str, float]:
+    """The health monitors as an accept/reject decision: returns
+    ``(ok, trigger, rel_residual)``. Order matters — a NaN solution must
+    report ``nonfinite``, not a meaningless residual."""
+    x = np.asarray(x, dtype=np.float64)
+    if not np.isfinite(x).all():
+        return False, "nonfinite_solution", float("inf")
+    if factors is not None:
+        mp = getattr(factors, "min_abs_pivot", None)
+        if mp is not None:
+            mp = float(np.asarray(mp))
+            if not mp > 0.0:  # 0 (singular) and NaN both fail
+                return False, "zero_pivot", float("inf")
+    rel = checks.residual_norm(a64, x, b64, relative=True)
+    if not rel <= gate:
+        return False, "residual", rel
+    return True, "", rel
+
+
+def _refine_host(fac, a64, b64, x, iters: int):
+    """Classical host-f64 iterative refinement through existing factors."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    x = np.asarray(x, dtype=np.float64)
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        d = np.asarray(blocked.lu_solve(fac, jnp.asarray(r, jnp.float32)),
+                       dtype=np.float64)
+        x = x + d
+    return x
+
+
+def _rung_blocked(a64, b64, panel, iters):
+    from gauss_tpu.core import blocked
+
+    x, fac = blocked.solve_refined(a64, b64, panel=panel, iters=iters)
+    return x, fac
+
+
+def _rung_pivot_safe(a64, b64, panel, iters):
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    fac = blocked.lu_factor_blocked(jnp.asarray(a64, jnp.float32),
+                                    panel=panel, zero_pivot_safe=True)
+    x = np.asarray(blocked.lu_solve(fac, jnp.asarray(b64, jnp.float32)),
+                   dtype=np.float64)
+    return _refine_host(fac, a64, b64, x, iters), fac
+
+
+def _rung_ds(a64, b64, panel, iters):
+    from gauss_tpu.core import dsfloat
+
+    x, fac = dsfloat.solve_ds(a64, b64, panel=panel)
+    return np.asarray(x, dtype=np.float64), fac
+
+
+def _rung_rank1(a64, b64, panel, iters):
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import gauss
+
+    a32 = jnp.asarray(a64, jnp.float32)
+    if b64.ndim == 1:
+        x = np.asarray(gauss.gauss_solve(a32, jnp.asarray(b64, jnp.float32)),
+                       dtype=np.float64)
+    else:
+        # The rank-1 oracle solves one RHS at a time; k is small in practice
+        # (the serve ladder caps nrhs buckets) and this is a recovery rung,
+        # not a hot path.
+        cols = [np.asarray(gauss.gauss_solve(
+            a32, jnp.asarray(b64[:, j], jnp.float32)), dtype=np.float64)
+            for j in range(b64.shape[1])]
+        x = np.stack(cols, axis=1)
+    return x, None
+
+
+def _rung_numpy(a64, b64, panel, iters):
+    return np.linalg.solve(a64, b64), None
+
+
+_RUNG_FNS: Dict[str, Callable] = {
+    "blocked": _rung_blocked,
+    "pivot_safe": _rung_pivot_safe,
+    "ds_refine": _rung_ds,
+    "rank1": _rung_rank1,
+    "numpy_f64": _rung_numpy,
+}
+
+
+def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
+                    engine: str = "blocked",
+                    rungs: Optional[Sequence[str]] = None,
+                    panel: Optional[int] = None,
+                    refine_iters: int = 2) -> ResilientResult:
+    """Solve ``a @ x = b`` with health gating and ladder escalation.
+
+    Returns a :class:`ResilientResult` (``.x`` float64, plus which rung
+    served it). Raises :class:`UnrecoverableSolveError` when every rung
+    fails — and immediately for non-finite INPUT operands, which no rung
+    can recover — and plain ``ValueError`` for malformed requests (wrong
+    shapes, unknown rung names): those are programming errors, not faults.
+
+    ``rungs`` overrides the ladder (names from ``_RUNG_FNS``); the serving
+    layer's degraded lane passes ``("numpy_f64", "rank1")`` — same gating,
+    same events, same typed error, different rung order.
+    """
+    a64 = np.asarray(a, dtype=np.float64)
+    b64 = np.asarray(b, dtype=np.float64)
+    n = a64.shape[0]
+    if a64.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a64.shape}")
+    if b64.shape[:1] != (n,) or b64.ndim > 2:
+        raise ValueError(f"b must be (n,) or (n, k) with n={n}, "
+                         f"got {b64.shape}")
+    if not (np.isfinite(a64).all() and np.isfinite(b64).all()):
+        # A non-finite operand is not a recoverable fault — there is no
+        # well-posed system behind it for ANY rung to solve. Typed, so the
+        # chaos invariant (recovered or typed error) holds for input
+        # corruption too.
+        obs.counter("resilience.unrecoverable")
+        obs.emit("recovery", trigger="nonfinite_input", rung="input",
+                 attempt=0, outcome="unrecoverable")
+        raise UnrecoverableSolveError(
+            "non-finite entries in the input operands (NaN/Inf); no "
+            "recovery rung can restore a system that was never well-posed",
+            trigger="nonfinite_input")
+    ladder = tuple(rungs) if rungs is not None else default_rungs(engine)
+    unknown = [r for r in ladder if r not in _RUNG_FNS]
+    if unknown:
+        raise ValueError(f"unknown ladder rung(s) {unknown}; options: "
+                         f"{sorted(_RUNG_FNS)}")
+
+    escalations: List[Tuple[str, str]] = []
+    for i, rung in enumerate(ladder):
+        try:
+            x, fac = _RUNG_FNS[rung](a64, b64, panel, refine_iters)
+            ok, trigger, rel = _gate(a64, b64, x, factors=fac, gate=gate)
+        except Exception as e:  # noqa: BLE001 — a rung failing IS the signal
+            ok, trigger, rel = False, f"exception:{type(e).__name__}", None
+        if ok:
+            if i > 0:
+                obs.counter("resilience.recovered")
+                obs.emit("recovery", trigger=escalations[-1][1], rung=rung,
+                         rung_index=i, attempt=i + 1, outcome="recovered",
+                         rel_residual=rel)
+            return ResilientResult(x=np.asarray(x, dtype=np.float64),
+                                   rung=rung, rung_index=i, attempts=i + 1,
+                                   rel_residual=rel,
+                                   escalations=escalations)
+        escalations.append((rung, trigger))
+        obs.counter("resilience.escalations")
+        obs.emit("recovery", trigger=trigger, rung=rung, rung_index=i,
+                 attempt=i + 1, outcome="escalate",
+                 **({"rel_residual": rel} if rel is not None
+                    and np.isfinite(rel) else {}))
+
+    obs.counter("resilience.unrecoverable")
+    obs.emit("recovery", trigger=escalations[-1][1], rung=ladder[-1],
+             attempt=len(ladder), outcome="unrecoverable")
+    raise UnrecoverableSolveError(
+        f"recovery ladder exhausted after {len(ladder)} rung(s) "
+        f"({', '.join(f'{r}: {t}' for r, t in escalations)})",
+        trigger=escalations[-1][1], attempts=escalations)

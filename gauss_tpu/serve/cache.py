@@ -27,6 +27,7 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
 
 
 class CacheKey(NamedTuple):
@@ -132,6 +133,11 @@ class ExecutableCache:
         # different key must not wait behind them.
         obs.counter("serve.cache.misses")
         obs.emit("serve_cache", event="miss", **key._asdict())
+        if _inject.enabled():
+            # Hook point "serve.cache.compile": a simulated scoped-VMEM /
+            # compile failure on executable build — RuntimeError-shaped, so
+            # the server's transient-error retry/breaker path owns it.
+            _inject.maybe_raise("serve.cache.compile")
         entry = (builder or (lambda k: BatchedExecutable(k, panel=panel)))(key)
         with self._lock:
             # A racing miss may have inserted the same key; last write wins

@@ -34,6 +34,7 @@ from typing import Optional
 import numpy as np
 
 from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
 from gauss_tpu.serve import buckets
 from gauss_tpu.serve.admission import (
     STATUS_EXPIRED,
@@ -69,6 +70,7 @@ class SolverServer:
         self._queue: "_queue.Queue[ServeRequest]" = _queue.Queue()
         self._depth = 0                   # admission-visible queue depth
         self._depth_lock = threading.Lock()
+        self._closed = False              # guarded by _depth_lock
         self._drain_rate = 0.0            # EWMA requests/s, for retry-after
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -81,14 +83,28 @@ class SolverServer:
         if self._worker is not None and self._worker.is_alive():
             return self
         self._stop.clear()
+        with self._depth_lock:
+            self._closed = False
         self._worker = threading.Thread(target=self._run, name="gauss-serve",
                                         daemon=True)
         self._worker.start()
         return self
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
-        """Stop the worker; with ``drain`` (default) pending requests are
-        served first, otherwise they resolve as rejected."""
+        """Stop the worker; with ``drain`` (default) requests accepted
+        before the stop began are served first, otherwise they resolve as
+        rejected.
+
+        Every accepted request resolves with exactly one terminal status:
+        admission closes FIRST (under the same lock submits enqueue under,
+        so a submit is either fully before the close — and will be drained
+        or flushed below — or fully after it and rejected synchronously in
+        :meth:`submit`). Without the closed gate, a request enqueued during
+        or after this method's final flush was simply dropped: never served,
+        never resolved, a client blocked forever (the shutdown race
+        tests/test_serve.py::test_stop_shutdown_race pins)."""
+        with self._depth_lock:
+            self._closed = True
         if self._worker is not None:
             if drain:
                 deadline = time.monotonic() + timeout
@@ -98,7 +114,11 @@ class SolverServer:
             self._queue.put(None)  # type: ignore[arg-type] # wake the worker
             self._worker.join(timeout=timeout)
             self._worker = None
-        # Anything still queued after a non-drain stop is refused, not lost.
+        else:
+            self._stop.set()
+        # Anything still queued (non-drain stop, drain timeout, or requests
+        # that raced the drain window) is refused, not lost — no further
+        # submit can enqueue once _closed is set, so this flush is final.
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -106,6 +126,9 @@ class SolverServer:
                 break
             if req is not None and not req.done:
                 self._depth_add(-1)
+                obs.counter("serve.rejected")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_REJECTED, reason="server_stopped")
                 req.resolve(ServeResult(status=STATUS_REJECTED,
                                         error="server stopped"))
 
@@ -142,7 +165,25 @@ class SolverServer:
         if deadline_s is None:
             deadline_s = self.config.deadline_default_s
         req = ServeRequest(a, b, deadline_s=deadline_s)
-        if self._depth_snapshot() >= self.config.max_queue:
+        # Admission is ONE critical section: the closed/full check and the
+        # enqueue happen under the lock stop() closes admission under, so a
+        # request is either enqueued strictly before the close (stop's
+        # drain/flush owns it) or rejected here — there is no window where
+        # an accepted request can miss both and hang its client.
+        with self._depth_lock:
+            closed = self._closed
+            full = not closed and self._depth >= self.config.max_queue
+            if not closed and not full:
+                self._depth += 1
+                self._queue.put(req)
+        if closed:
+            obs.counter("serve.rejected")
+            obs.emit("serve_request", id=req.id, n=req.n,
+                     status=STATUS_REJECTED, reason="server_stopped")
+            req.resolve(ServeResult(status=STATUS_REJECTED,
+                                    error="server stopped"))
+            return req
+        if full:
             hint = self.retry_after_hint()
             obs.counter("serve.rejected")
             obs.emit("serve_request", id=req.id, n=req.n, status=STATUS_REJECTED,
@@ -151,9 +192,7 @@ class SolverServer:
             req.resolve(ServeResult(status=STATUS_REJECTED,
                                     retry_after_s=hint, error="queue full"))
             return req
-        self._depth_add(1)
         obs.counter("serve.submitted")
-        self._queue.put(req)
         return req
 
     def solve(self, a, b, deadline_s: Optional[float] = None,
@@ -175,6 +214,10 @@ class SolverServer:
             if req.n <= self.ladder[-1]:
                 batch.extend(self._drain_same_bucket(req))
             self._depth_add(-len(batch))
+            if _inject.enabled():
+                # Hook point "serve.worker.dispatch": injected worker stall
+                # (deadline pressure — expired requests must shed, not hang).
+                _inject.maybe_delay("serve.worker.dispatch")
             t0 = time.perf_counter()
             served = self._dispatch(batch)
             dt = time.perf_counter() - t0
@@ -339,11 +382,22 @@ class SolverServer:
         self._finish(req, np.asarray(x), lane="handoff", bucket_n=None)
 
     def _serve_numpy(self, req: ServeRequest) -> None:
-        """Degraded host lane: plain LAPACK solve, verified like the rest."""
+        """Degraded host lane, through the SAME recovery ladder the solver
+        stack uses (gauss_tpu.resilience.recover) rather than the ad-hoc
+        one-shot ``np.linalg.solve`` it used to be: the host LAPACK rung
+        first (the device lane is the thing that is sick), escalating to the
+        rank-1 device engine if even LAPACK cannot pass the gate — and a
+        TYPED UnrecoverableSolveError, with recovery events in the stream,
+        when nothing can."""
+        from gauss_tpu.resilience import recover
+
+        gate = self.config.verify_gate or recover.DEFAULT_GATE
         try:
             with obs.span("serve_numpy", n=req.n):
-                x = np.linalg.solve(req.a.astype(np.float64),
-                                    req.b.astype(np.float64))
+                rr = recover.solve_resilient(
+                    req.a.astype(np.float64), req.b.astype(np.float64),
+                    gate=gate, rungs=("numpy_f64", "rank1"))
+            x = rr.x
         except Exception as e:  # noqa: BLE001 — lane boundary
             obs.counter("serve.failed")
             obs.emit("serve_request", id=req.id, n=req.n,

@@ -40,3 +40,63 @@ def test_malformed_body_line_raises_valueerror():
         datfile.read_dat(io.StringIO("3 3 1\n1 2\n0 0 0\n"))
     with pytest.raises(ValueError, match="malformed"):
         datfile.read_dat(io.StringIO("3 3 1\nx y z\n0 0 0\n"))
+
+
+# -- strict-mode hardening (resilience PR): typed errors with line numbers --
+
+def test_nan_inf_values_rejected_with_line_number():
+    """float() happily parses 'nan'/'inf' (so does the reference's fscanf);
+    strict mode must refuse them before they poison a solve."""
+    with pytest.raises(datfile.DatFormatError, match="non-finite") as ei:
+        datfile.read_dat(io.StringIO("2 2 2\n1 1 1.0\n2 2 nan\n0 0 0\n"))
+    assert ei.value.line == 3
+    with pytest.raises(datfile.DatFormatError, match="non-finite") as ei:
+        datfile.read_dat(io.StringIO("2 2 1\n1 2 -inf\n0 0 0\n"))
+    assert ei.value.line == 2
+    # strict=False keeps reference fscanf parity.
+    _, _, _, vals = datfile.read_dat(
+        io.StringIO("2 2 1\n1 2 inf\n0 0 0\n"), strict=False)
+    assert np.isinf(vals[0])
+
+
+def test_duplicate_entry_error_names_both_lines():
+    with pytest.raises(datfile.DatFormatError, match="first at line 2") as ei:
+        datfile.read_dat(io.StringIO("3 3 3\n2 1 5\n1 1 1\n2 1 7\n0 0 0\n"))
+    assert ei.value.line == 4
+
+
+def test_missing_terminator_line_number_and_escape():
+    with pytest.raises(datfile.DatFormatError, match="terminator") as ei:
+        datfile.read_dat(io.StringIO("2 2 1\n1 1 3.5\n"))
+    assert ei.value.line == 2
+    n, rows, cols, vals = datfile.read_dat(io.StringIO("2 2 1\n1 1 3.5\n"),
+                                           strict=False)
+    assert n == 2 and vals[0] == 3.5
+
+
+def test_malformed_header_is_typed_with_line_one():
+    with pytest.raises(datfile.DatFormatError) as ei:
+        datfile.read_dat(io.StringIO("2 x 1\n1 1 3.5\n0 0 0\n"))
+    assert ei.value.line == 1
+    with pytest.raises(datfile.DatFormatError) as ei:
+        datfile.read_dat(io.StringIO("-2 -2 1\n1 1 3.5\n0 0 0\n"))
+    assert ei.value.line == 1
+
+
+def test_datformaterror_is_valueerror():
+    """Pre-existing `except ValueError` call sites (the CLIs) keep catching
+    the new typed errors."""
+    assert issubclass(datfile.DatFormatError, ValueError)
+    err = datfile.DatFormatError("boom", line=7)
+    assert "line 7" in str(err) and err.line == 7
+
+
+def test_strict_roundtrip_unaffected(tmp_path):
+    """write_dat output (terminated, duplicate-free, finite) parses clean
+    under the strict default."""
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((6, 6))
+    p = tmp_path / "clean.dat"
+    datfile.write_dat(p, a)
+    np.testing.assert_array_equal(
+        datfile.read_dat_dense(p, engine="python"), a)

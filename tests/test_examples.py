@@ -30,3 +30,12 @@ def test_serve_quickstart_runs():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "0 INCORRECT" in r.stdout
     assert "lane=batched" in r.stdout
+
+
+def test_resilient_solve_runs():
+    r = _run(["examples/resilient_solve.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "rung=pivot_safe" in r.stdout
+    assert "typed UnrecoverableSolveError" in r.stdout
+    assert "killed mid-factorization" in r.stdout
+    assert "bit-identical to uninterrupted: True" in r.stdout

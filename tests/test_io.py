@@ -26,8 +26,13 @@ def test_read_dat_dense():
     np.testing.assert_array_equal(dense, expected)
 
 
-def test_missing_terminator_ok():
-    dense = datfile.read_dat_dense(io.StringIO("2 2 1\n1 2 4\n"))
+def test_missing_terminator_strict_vs_reference():
+    """Strict (default) treats a missing `0 0 0` terminator as a truncated
+    file; strict=False keeps the reference's EOF-terminated acceptance."""
+    with pytest.raises(datfile.DatFormatError, match="terminator"):
+        datfile.read_dat_dense(io.StringIO("2 2 1\n1 2 4\n"))
+    dense = datfile.read_dat_dense(io.StringIO("2 2 1\n1 2 4\n"),
+                                   strict=False)
     assert dense[0, 1] == 4.0
 
 
@@ -67,8 +72,13 @@ def test_internal_equals_generator():
         synthetic.internal_matrix(6), synthetic.generator_matrix(6))
 
 
-def test_duplicate_coordinates_last_wins():
-    dense = datfile.read_dat_dense(io.StringIO("2 2 2\n1 1 3\n1 1 9\n0 0 0\n"))
+def test_duplicate_coordinates_strict_vs_reference():
+    """Strict (default) rejects duplicate (row, col) entries as corrupt;
+    strict=False keeps the reference's last-wins densifying overwrite."""
+    text = "2 2 2\n1 1 3\n1 1 9\n0 0 0\n"
+    with pytest.raises(datfile.DatFormatError, match="duplicate"):
+        datfile.read_dat_dense(io.StringIO(text))
+    dense = datfile.read_dat_dense(io.StringIO(text), strict=False)
     assert dense[0, 0] == 9.0
 
 

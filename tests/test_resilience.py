@@ -1,0 +1,435 @@
+"""Resilience-layer tests: the fault-injection framework (determinism,
+hook-point plumbing through core/serve/dist), the recovery ladder (gating,
+escalation order, typed exhaustion), panel-granular checkpoint/resume
+(bit-identity after a kill), the chaos campaign runner, and the
+summarize/regress integration.
+
+All CPU (conftest pins the platform); sizes stay small — these tests are
+about fault PATHS, not FLOPs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.core import blocked
+from gauss_tpu.obs import regress, summarize
+from gauss_tpu.resilience import checkpoint as ckpt
+from gauss_tpu.resilience import chaos, inject, recover
+from gauss_tpu.verify import checks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _system(rng, n, k=None):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    b = rng.standard_normal(n) if k is None else rng.standard_normal((n, k))
+    return a, b
+
+
+# -- inject: plan parsing + deterministic triggering -----------------------
+
+def test_fault_plan_parse_json_and_compact():
+    p = inject.FaultPlan.parse(
+        '{"seed": 7, "faults": [{"site": "core.blocked.factor", '
+        '"kind": "nan", "p": 0.5, "max_triggers": 2}]}')
+    assert p.seed == 7
+    assert p.specs[0].site == "core.blocked.factor"
+    assert p.specs[0].p == 0.5 and p.specs[0].max_triggers == 2
+    q = inject.FaultPlan.parse(
+        "a.site=inf:p=0.25:max=3:skip=1;b.site=delay:param=0.5")
+    assert len(q.specs) == 2
+    assert q.specs[0] == inject.FaultSpec(site="a.site", kind="inf", p=0.25,
+                                          max_triggers=3, skip=1, seed=0)
+    assert q.specs[1].kind == "delay" and q.specs[1].param == 0.5
+    for bad in ("", "siteonly", "a=notakind", "a=nan:bogus=1"):
+        with pytest.raises(ValueError):
+            inject.FaultPlan.parse(bad)
+
+
+def test_poll_deterministic_and_bounded():
+    def run():
+        p = inject.FaultPlan([inject.FaultSpec(
+            site="s", kind="nan", p=0.5, max_triggers=3, seed=4)], seed=9)
+        with inject.plan(p) as ap:
+            fired = [inject.poll("s") is not None for _ in range(40)]
+            return fired, ap.stats()
+
+    f1, s1 = run()
+    f2, s2 = run()
+    assert f1 == f2 and s1 == s2          # seeded: identical replay
+    assert sum(f1) == 3                   # max_triggers bound holds
+    assert s1["triggered"] == 3 and s1["polls"]["s"] == 40
+
+
+def test_skip_delays_first_trigger():
+    p = inject.FaultPlan([inject.FaultSpec(site="s", kind="raise",
+                                           max_triggers=1, skip=2)])
+    with inject.plan(p):
+        inject.maybe_raise("s")
+        inject.maybe_raise("s")
+        with pytest.raises(inject.SimulatedFaultError):
+            inject.maybe_raise("s")
+
+
+def test_no_plan_is_inert_and_plans_do_not_stack():
+    assert not inject.enabled()
+    assert inject.poll("anything") is None
+    a = np.ones((4, 4))
+    assert inject.corrupt_operand("anything", a) is a
+    p = inject.FaultPlan([inject.FaultSpec(site="s", kind="nan")])
+    with inject.plan(p):
+        assert inject.enabled()
+        with pytest.raises(RuntimeError, match="already installed"):
+            inject.install(p)
+    assert not inject.enabled()
+
+
+def test_corrupt_kinds():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((32, 32))
+    a_orig = a.copy()
+
+    def corrupted(kind, **kw):
+        p = inject.FaultPlan([inject.FaultSpec(site="s", kind=kind, **kw)])
+        with inject.plan(p):
+            return inject.corrupt_operand("s", a, panel=8)
+
+    nan = corrupted("nan")
+    assert nan is not a and np.isnan(nan).sum() == 32 * 8
+    assert np.isinf(corrupted("inf")).any()
+    bf = corrupted("bitflip")
+    assert (bf != a).sum() == 1  # exactly one element changed
+    nz = corrupted("near_zero_pivot")
+    j = int(np.argmax((nz != a).any(axis=0)))
+    np.testing.assert_allclose(nz[j:, j], a[j:, j] * 1e-30)
+    np.testing.assert_array_equal(a, a_orig)  # corruption copies, never mutates
+
+
+def test_env_var_activation_in_subprocess(tmp_path):
+    """GAUSS_FAULTS installs a plan at import — the worker-subprocess
+    channel; kind=kill exits with the distinctive code."""
+    code = ("from gauss_tpu.resilience import inject\n"
+            "assert inject.enabled()\n"
+            "inject.maybe_kill('w')\n"
+            "raise SystemExit(99)  # unreachable\n")
+    env = {**os.environ, "GAUSS_FAULTS": "w=kill"}
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == inject.KILL_EXIT_CODE, r.stderr
+
+
+def test_multihost_straggler_and_kill_hooks(monkeypatch):
+    """The dist.multihost hook points fire around initialize(): straggler
+    sleeps, worker kill raises (in-process stand-in for os._exit)."""
+    from gauss_tpu.dist import multihost
+
+    calls = []
+    monkeypatch.setattr(multihost, "_INITIALIZED", None)
+
+    class _FakeDist:
+        def initialize(self, **kw):
+            calls.append(kw)
+
+    import jax
+
+    monkeypatch.setattr(jax, "distributed", _FakeDist())
+    p = inject.FaultPlan([
+        inject.FaultSpec(site="dist.multihost.straggler", kind="delay",
+                         param=0.05),
+        inject.FaultSpec(site="dist.multihost.worker", kind="raise"),
+    ])
+    with inject.plan(p):
+        t0 = time.perf_counter()
+        with pytest.raises(inject.SimulatedFaultError, match="worker"):
+            multihost.initialize("127.0.0.1:1", 1, 0)
+        assert time.perf_counter() - t0 >= 0.05
+    assert calls  # the straggler delayed but did not prevent the join
+
+
+# -- recover: gating + ladder ----------------------------------------------
+
+def test_clean_solve_is_rung_zero_and_silent(rng):
+    a, b = _system(rng, 32)
+    with obs.run() as rec:
+        res = recover.solve_resilient(a, b)
+    assert res.rung == "blocked" and res.rung_index == 0 and not res.recovered
+    assert res.rel_residual <= 1e-4
+    assert not [e for e in rec.events if e["type"] == "recovery"]
+
+
+def test_injected_corruption_recovers_with_events(rng):
+    a, b = _system(rng, 32)
+    x_ref = np.linalg.solve(a, b)
+    plan = inject.FaultPlan.parse("core.blocked.factor=nan:max=1")
+    with obs.run() as rec:
+        with inject.plan(plan) as ap:
+            res = recover.solve_resilient(a, b)
+    assert ap.stats()["triggered"] == 1
+    assert res.recovered and res.rung_index >= 1
+    assert checks.elementwise_match(res.x, x_ref, 1e-4)
+    evs = [e for e in rec.events if e["type"] == "recovery"]
+    outcomes = [e["outcome"] for e in evs]
+    assert outcomes[0] == "escalate" and outcomes[-1] == "recovered"
+    assert evs[0]["trigger"] == "nonfinite_solution"
+    assert {"rung", "attempt", "trigger", "outcome"} <= set(evs[0])
+    faults = [e for e in rec.events if e["type"] == "fault"]
+    assert faults and faults[0]["site"] == "core.blocked.factor"
+
+
+def test_near_zero_pivot_recovery(rng):
+    a, b = _system(rng, 32)
+    plan = inject.FaultPlan.parse("core.blocked.factor=near_zero_pivot:max=1")
+    with inject.plan(plan):
+        res = recover.solve_resilient(a, b)
+    assert res.rel_residual <= 1e-4
+
+
+def test_persistent_both_engines_reaches_numpy(rng):
+    a, b = _system(rng, 24)
+    plan = inject.FaultPlan([
+        inject.FaultSpec(site="core.blocked.factor", kind="inf",
+                         max_triggers=None),
+        inject.FaultSpec(site="core.gauss.solve", kind="inf",
+                         max_triggers=None)])
+    with inject.plan(plan):
+        res = recover.solve_resilient(a, b)
+    assert res.rung == "numpy_f64"
+    assert res.rel_residual <= 1e-4
+    assert len(res.escalations) == 4
+
+
+def test_rank1_engine_ladder(rng):
+    a, b = _system(rng, 24)
+    plan = inject.FaultPlan.parse("core.gauss.solve=nan:max=1")
+    with inject.plan(plan):
+        res = recover.solve_resilient(a, b, engine="rank1")
+    assert res.rel_residual <= 1e-4 and res.recovered
+
+
+def test_multirhs_through_ladder(rng):
+    a, b = _system(rng, 24, k=3)
+    plan = inject.FaultPlan([
+        inject.FaultSpec(site="core.blocked.factor", kind="nan",
+                         max_triggers=None)])
+    with inject.plan(plan):
+        res = recover.solve_resilient(a, b)
+    assert res.x.shape == (24, 3)
+    assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+
+
+def test_nonfinite_input_typed_error(rng):
+    a, b = _system(rng, 16)
+    a[3, 5] = np.nan
+    with obs.run() as rec:
+        with pytest.raises(recover.UnrecoverableSolveError) as ei:
+            recover.solve_resilient(a, b)
+    assert ei.value.trigger == "nonfinite_input"
+    evs = [e for e in rec.events if e["type"] == "recovery"]
+    assert evs and evs[-1]["outcome"] == "unrecoverable"
+
+
+def test_singular_system_exhausts_ladder_typed(rng):
+    a = np.zeros((12, 12))
+    a[0, :] = 1.0  # rank 1: no rung can solve it
+    b = np.ones(12)
+    with pytest.raises(recover.UnrecoverableSolveError) as ei:
+        recover.solve_resilient(a, b)
+    assert len(ei.value.attempts) == 5
+    rungs = [r for r, _ in ei.value.attempts]
+    assert rungs == ["blocked", "pivot_safe", "ds_refine", "rank1",
+                     "numpy_f64"]
+
+
+def test_bad_requests_are_valueerrors(rng):
+    a, b = _system(rng, 8)
+    with pytest.raises(ValueError):
+        recover.solve_resilient(a[:4], b)
+    with pytest.raises(ValueError):
+        recover.solve_resilient(a, b, rungs=("bogus",))
+    with pytest.raises(ValueError):
+        recover.default_rungs("bogus")
+
+
+def test_zero_pivot_safe_factor_finite_on_singular():
+    """The ladder's re-factor rung: an exactly singular matrix factors to a
+    FINITE factor under zero_pivot_safe (min_abs_pivot records 0), where
+    the default factorization NaN-poisons the trailing rows."""
+    import jax.numpy as jnp
+
+    a = np.ones((16, 16), dtype=np.float32)  # rank 1
+    fac = blocked.lu_factor_blocked(jnp.asarray(a), panel=8,
+                                    zero_pivot_safe=True)
+    assert float(fac.min_abs_pivot) == 0.0
+    assert np.isfinite(np.asarray(fac.m)).all()
+
+
+# -- checkpoint ------------------------------------------------------------
+
+def test_checkpoint_kill_resume_bit_identical(tmp_path, rng):
+    n = 96
+    a = _system(rng, n)[0].astype(np.float32)
+    kw = dict(panel=16, chunk=2)
+    clean = ckpt.lu_factor_blocked_chunked_checkpointed(
+        a, tmp_path / "clean.npz", **kw)
+    assert not (tmp_path / "clean.npz").exists()  # removed on success
+
+    path = tmp_path / "killed.npz"
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site="checkpoint.group", kind="raise", max_triggers=1, skip=2)])
+    with obs.run() as rec:
+        with inject.plan(plan):
+            with pytest.raises(inject.SimulatedFaultError):
+                ckpt.lu_factor_blocked_chunked_checkpointed(a, path, **kw)
+        assert path.exists()  # the carry survived the kill
+        resumed = ckpt.lu_factor_blocked_chunked_checkpointed(a, path, **kw)
+    assert not path.exists()
+    for f in ("m", "perm", "min_abs_pivot", "linv", "uinv"):
+        np.testing.assert_array_equal(np.asarray(getattr(clean, f)),
+                                      np.asarray(getattr(resumed, f)))
+    evs = [e for e in rec.events if e["type"] == "checkpoint"]
+    assert [e for e in evs if e["event"] == "save"]
+    assert [e for e in evs if e["event"] == "resume"]
+    # The resumed factor agrees with the one-shot chunked factorization.
+    import jax.numpy as jnp
+
+    one_shot = blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=16,
+                                                 chunk=2)
+    np.testing.assert_allclose(np.asarray(resumed.m),
+                               np.asarray(one_shot.m), rtol=1e-5, atol=1e-5)
+
+
+def test_checkpoint_mismatch_is_typed(tmp_path, rng):
+    a = _system(rng, 64)[0].astype(np.float32)
+    other = _system(rng, 64)[0].astype(np.float32)
+    path = tmp_path / "ck.npz"
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site="checkpoint.group", kind="raise", max_triggers=1, skip=1)])
+    with inject.plan(plan):
+        with pytest.raises(inject.SimulatedFaultError):
+            ckpt.lu_factor_blocked_chunked_checkpointed(
+                a, path, panel=16, chunk=1)
+    # Resuming a DIFFERENT matrix (or different statics) against the saved
+    # carry must refuse, not silently mix factorizations.
+    with pytest.raises(ckpt.CheckpointMismatchError):
+        ckpt.lu_factor_blocked_chunked_checkpointed(
+            other, path, panel=16, chunk=1)
+    with pytest.raises(ckpt.CheckpointMismatchError):
+        ckpt.lu_factor_blocked_chunked_checkpointed(
+            a, path, panel=16, chunk=2)
+    # resume=False ignores the stale file and recomputes from scratch.
+    fac = ckpt.lu_factor_blocked_chunked_checkpointed(
+        a, path, panel=16, chunk=1, resume=False)
+    assert np.isfinite(np.asarray(fac.m)).all()
+
+
+# -- serve fallback lane reuses the ladder ---------------------------------
+
+def test_serve_numpy_lane_is_ladder_backed(rng):
+    from gauss_tpu.serve import ServeConfig, SolverServer
+
+    srv = SolverServer(ServeConfig(ladder=(16, 32), panel=16,
+                                   unhealthy_after=1, max_retries=0,
+                                   retry_backoff_s=0.0,
+                                   device_probe_cooldown_s=60.0,
+                                   verify_gate=1e-4))
+
+    def broken_get(key, builder=None, panel=None):
+        raise RuntimeError("injected device failure")
+
+    srv.cache.get = broken_get
+    a, b = _system(rng, 12)
+    bad = np.zeros((12, 12))
+    bad[0, :] = 1.0
+    with srv:
+        ok = srv.solve(a, b)
+        failed = srv.solve(bad, np.ones(12))
+    assert ok.status == "ok" and ok.lane == "numpy"
+    assert checks.residual_norm(a, ok.x, b, relative=True) <= 1e-4
+    # An unsolvable system through the degraded lane fails TYPED — the
+    # ladder's UnrecoverableSolveError, not a bare LinAlgError.
+    assert failed.status == "failed"
+    assert "UnrecoverableSolveError" in failed.error
+
+
+# -- chaos campaign --------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_campaign_small_end_to_end(tmp_path):
+    summary_path = tmp_path / "chaos.json"
+    metrics_path = tmp_path / "chaos.jsonl"
+    rc = chaos.main(["--cases", "12", "--serve-requests", "6",
+                     "--seed", "5", "--tmpdir", str(tmp_path),
+                     "--summary-json", str(summary_path),
+                     "--metrics-out", str(metrics_path)])
+    assert rc == 0
+    summary = json.loads(summary_path.read_text())
+    assert summary["kind"] == "chaos_campaign"
+    assert summary["invariant_ok"]
+    assert summary["injected"] >= 12
+    assert summary["solver"]["counts"]["silent_wrong"] == 0
+    assert summary["solver"]["counts"]["violation"] == 0
+    assert summary["checkpoint"]["bit_identical"]
+    # regress ingest path
+    recs = regress.ingest_file(summary_path)
+    assert recs and all(r["kind"] == "chaos" for r in recs)
+    assert any(r["metric"] == "chaos:solver/mean_rung" for r in recs)
+    # the stream renders a resilience section
+    events = obs.read_events(metrics_path)
+    rs = summarize.resilience_summary(events)
+    assert rs["injections"]["total"] == summary["injected"]
+
+
+def test_chaos_history_records_shape():
+    recs = chaos.history_records(
+        {"solver": {"mean_rung": 2.1, "typed_error_rate": 0.08,
+                    "cases": 100},
+         "wall_s": 10.0})
+    assert ("chaos:solver/mean_rung", 2.1, "rung") in recs
+    assert ("chaos:solver/typed_error_rate", 0.08, "ratio") in recs
+    assert ("chaos:solver/s_per_case", 0.1, "s") in recs
+    assert chaos.history_records({"solver": {}, "wall_s": None}) == []
+
+
+# -- summarize resilience section ------------------------------------------
+
+def test_resilience_summary_section_and_json(tmp_path):
+    with obs.run(metrics_out=str(tmp_path / "rs.jsonl")) as rec:
+        obs.emit("fault", site="core.blocked.factor", kind="nan", seq=1)
+        obs.emit("fault", site="serve.cache.compile", kind="compile_fail",
+                 seq=1)
+        obs.emit("recovery", trigger="nonfinite_solution", rung="blocked",
+                 rung_index=0, attempt=1, outcome="escalate")
+        obs.emit("recovery", trigger="nonfinite_solution", rung="pivot_safe",
+                 rung_index=1, attempt=2, outcome="recovered",
+                 rel_residual=1e-9)
+        obs.emit("recovery", trigger="residual", rung="numpy_f64",
+                 attempt=5, outcome="unrecoverable")
+        obs.emit("checkpoint", event="save", path="x", next_group=2)
+        obs.emit("checkpoint", event="resume", path="x", next_group=2)
+    events = obs.read_events(tmp_path / "rs.jsonl")
+    rs = summarize.resilience_summary(events)
+    assert rs["injections"]["total"] == 2
+    assert rs["injections"]["by_site"] == {"core.blocked.factor": 1,
+                                           "serve.cache.compile": 1}
+    assert rs["recoveries"] == {"total": 1, "by_rung": {"pivot_safe": 1}}
+    assert rs["escalations"] == 1 and rs["unrecoverable"] == 1
+    assert rs["checkpoints"] == {"save": 1, "resume": 1}
+    text = summarize.summarize_events(events, rec.run_id)
+    assert "resilience:" in text and "pivot_safe" in text
+    payload = summarize.run_summary(events, rec.run_id)
+    json.dumps(payload)
+    assert payload["resilience"]["recoveries"]["total"] == 1
+    # Runs without resilience events carry no section.
+    with obs.run(metrics_out=str(tmp_path / "plain.jsonl")) as r2:
+        obs.emit("custom")
+    plain = obs.read_events(tmp_path / "plain.jsonl")
+    assert summarize.resilience_summary(plain) == {}
+    assert "resilience:" not in summarize.summarize_events(plain, r2.run_id)

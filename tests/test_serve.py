@@ -8,6 +8,7 @@ jitted-executable compiles to one small set shared across tests.
 """
 
 import json
+import threading
 import time
 
 import numpy as np
@@ -301,6 +302,96 @@ def test_nontransient_error_fails_without_retry(rng):
     assert res.status == STATUS_FAILED
     assert "deterministic" in res.error
     assert not [e for e in rec.events if e["type"] == "serve_retry"]
+
+
+def test_breaker_cooldown_probe_success_restores_device_lane(rng):
+    """Full breaker lifecycle through the SERVER (not just LaneHealth):
+    the device lane trips into numpy, the cooldown elapses, the probe batch
+    goes back through the device lane, succeeds, and the lane is restored —
+    the path test_serve.py never exercised before this PR."""
+    srv = SolverServer(_config(unhealthy_after=1, max_retries=0,
+                               retry_backoff_s=0.0,
+                               device_probe_cooldown_s=0.15))
+    real_get = srv.cache.get
+    broken = {"on": True}
+
+    def flaky_get(key, builder=None, panel=None):
+        if broken["on"]:
+            raise RuntimeError("injected transient device failure")
+        return real_get(key, builder=builder, panel=panel)
+
+    srv.cache.get = flaky_get
+    a, b = _system(rng, 8)
+    with srv:
+        assert srv.solve(a, b).lane == "numpy"   # trips the breaker
+        assert srv.health.open
+        assert srv.solve(a, b).lane == "numpy"   # held open: no device try
+        broken["on"] = False                      # device "recovers"
+        time.sleep(0.2)                           # cooldown elapses
+        res = srv.solve(a, b)                     # the probe batch
+        assert res.status == STATUS_OK and res.lane == "batched"
+        assert not srv.health.open                # circuit closed again
+        assert srv.solve(a, b).lane == "batched"
+
+
+def test_breaker_probe_failure_extends_cooldown(rng):
+    """The other probe outcome: the probe batch fails, the breaker re-opens
+    for another full cooldown, and requests stay on the numpy lane."""
+    srv = SolverServer(_config(unhealthy_after=1, max_retries=0,
+                               retry_backoff_s=0.0,
+                               device_probe_cooldown_s=0.15))
+    probes = []
+
+    def broken_get(key, builder=None, panel=None):
+        probes.append(time.perf_counter())
+        raise RuntimeError("injected transient device failure")
+
+    srv.cache.get = broken_get
+    a, b = _system(rng, 8)
+    with obs.run() as rec:
+        with srv:
+            assert srv.solve(a, b).lane == "numpy"  # trips (1st device try)
+            time.sleep(0.2)                          # cooldown elapses
+            assert srv.solve(a, b).lane == "numpy"  # probe fails -> numpy
+            assert srv.health.open                   # re-opened
+            assert srv.solve(a, b).lane == "numpy"  # still held: NO probe
+    assert len(probes) == 2  # initial failure + exactly one failed probe
+    trips = [e for e in rec.events if e["type"] == "serve_fallback"]
+    assert len(trips) == 2  # each failed probe re-trips with a fresh cooldown
+
+
+def test_stop_shutdown_race_every_request_terminal(rng):
+    """The shutdown race the stop() rework pins: submits racing stop(drain)
+    must each resolve with exactly one terminal status — served, rejected,
+    or failed — never silently dropped."""
+    srv = SolverServer(_config())
+    srv.start()
+    a, b = _system(rng, 8)
+    handles = []
+    stop_started = threading.Event()
+
+    def submitter():
+        for _ in range(200):
+            handles.append(srv.submit(a, b))
+            if stop_started.is_set():
+                break
+
+    threads = [threading.Thread(target=submitter) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    stop_started.set()
+    srv.stop(drain=True, timeout=120)
+    for t in threads:
+        t.join()
+    assert handles
+    statuses = [h.result(timeout=30).status for h in handles]
+    assert all(s in (STATUS_OK, STATUS_REJECTED, STATUS_FAILED)
+               for s in statuses)
+    # Post-stop submits reject synchronously instead of hanging a client.
+    late = srv.submit(a, b)
+    assert late.done and late.result(0).status == STATUS_REJECTED
+    assert "stopped" in late.result(0).error
 
 
 def test_lane_health_circuit_breaker():
