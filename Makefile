@@ -52,12 +52,18 @@ serve-check:
 	print('serve-check: serving summary ok:', sv[0]['requests'])"
 
 # The resilience gate (CI-callable): a CPU chaos smoke campaign — 200
-# seeded fault cases across both engines plus serve and checkpoint phases
-# (<60 s; small n, fault paths not FLOPs) asserting the chaos invariant
-# (every injected fault recovered-and-verified or a typed error; exit 2 on
-# a silent wrong answer), gated against the regression history (exit 1
-# when recovery depth / typed-error rate / per-case cost leave the band),
-# then the recorded stream is asserted to carry a resilience summary.
+# seeded fault cases across both engines plus serve, checkpoint, and
+# supervised-fleet phases (small n, fault paths not FLOPs) asserting the
+# chaos invariant (every injected fault recovered-and-verified or a typed
+# error; exit 2 on a silent wrong answer), gated against the regression
+# history (exit 1 when recovery depth / typed-error rate / per-case cost
+# leave the band), then the recorded stream is asserted to carry a
+# resilience summary. The second leg is the bounded-time multihost fleet
+# smoke: a 2-worker supervised solve with worker 1 KILLED mid-factorization
+# must restart-and-resume from the sharded checkpoint, verify at 1e-4, and
+# finish inside the timeout (a hang fails the gate by construction); its
+# recovery metrics (restarts, resume latency, rung) append to
+# reports/history.jsonl and are gated by obs.regress.
 faults-check:
 	rm -rf $(FAULTS_SMOKE) && mkdir -p $(FAULTS_SMOKE)
 	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.resilience.chaos --cases 200 \
@@ -69,6 +75,17 @@ faults-check:
 	rs=[r['resilience'] for r in runs.values() if r.get('resilience')]; \
 	assert rs and rs[0]['injections']['total'] >= 200, rs; \
 	print('faults-check: resilience summary ok:', rs[0]['injections']['total'], 'injections')"
+	timeout -k 10 240 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.resilience.fleet -s 64 --workers 2 --panel 16 --chunk 1 \
+	  --seed 258458 --inject 'fleet.worker.group=kill:skip=2' \
+	  --inject-worker 1 --stall-after 5 --job-timeout 180 \
+	  --metrics-out $(FAULTS_SMOKE)/fleet.jsonl \
+	  --summary-json $(FAULTS_SMOKE)/fleet.json --history --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(FAULTS_SMOKE)/fleet.jsonl --json \
+	  | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	fl=[r['fleet'] for r in runs.values() if r.get('fleet')]; \
+	assert fl and fl[0]['restarts'] >= 1 and fl[0]['solves'] == 1, fl; \
+	print('faults-check: fleet summary ok:', fl[0])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -35,6 +35,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gauss_tpu.dist.mesh import ROWS_AXIS, make_mesh
+from gauss_tpu.resilience import fleet as _fleet
+from gauss_tpu.resilience import watchdog as _watchdog
 from gauss_tpu.utils import compat
 
 
@@ -205,7 +207,13 @@ def solve_dist_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     obs.record_collective_budget("gauss_dist", solver, a_c, b_c,
                                  n=n, npad=npad,
                                  shards=int(mesh.devices.size))
-    return solver(a_c, b_c)[:n]
+    # Fleet hooks: heartbeat at the stage boundary, and — only when a
+    # watchdog deadline is configured (a supervised worker) — a deadline
+    # around the blocking collective program, so a dead peer becomes a
+    # typed WorkerLostError instead of an infinite block.
+    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist", n=n)
+    return _watchdog.guarded_device(lambda: solver(a_c, b_c),
+                                    site="dist.gauss_dist.solve")[:n]
 
 
 def gauss_solve_dist(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:

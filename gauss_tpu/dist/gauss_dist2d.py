@@ -43,6 +43,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from gauss_tpu.dist.gauss_dist import _cyclic_perm, _host_dtype
 from gauss_tpu.dist.mesh import make_mesh_2d_auto
+from gauss_tpu.resilience import fleet as _fleet
+from gauss_tpu.resilience import watchdog as _watchdog
 from gauss_tpu.utils import compat
 
 
@@ -192,7 +194,11 @@ def solve_dist2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     obs.record_collective_budget("gauss_dist2d", solver, a_c, b_c,
                                  n=n, npad=npad,
                                  mesh_shape=list(mesh.devices.shape))
-    x_cyc = solver(a_c, b_c)
+    # Fleet hooks (see gauss_dist.solve_dist_staged): heartbeat + optional
+    # collective watchdog deadline for supervised workers.
+    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist2d", n=n)
+    x_cyc = _watchdog.guarded_device(lambda: solver(a_c, b_c),
+                                     site="dist.gauss_dist2d.solve")
     # x_cyc[k] = x[cperm[k]]; undo (gather runs on the mesh's backend).
     inv = np.empty(npad, dtype=np.int64)
     inv[cperm] = np.arange(npad)

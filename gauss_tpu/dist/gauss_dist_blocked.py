@@ -53,6 +53,8 @@ from gauss_tpu.core.blocked import (_fold_transpositions, _panel_factor_jax,
                                     unit_lower_inv)
 from gauss_tpu.dist.gauss_dist import _host_dtype
 from gauss_tpu.dist.mesh import make_mesh
+from gauss_tpu.resilience import fleet as _fleet
+from gauss_tpu.resilience import watchdog as _watchdog
 from gauss_tpu.utils import compat
 
 DEFAULT_PANEL_DIST = 128
@@ -336,8 +338,14 @@ def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
                                  n=n, npad=npad, panel=panel,
                                  nblocks=npad // panel,
                                  shards=int(mesh.devices.size))
+    # Fleet hooks: heartbeat at the stage boundary; supervised workers
+    # additionally get a watchdog deadline so a peer hung inside the
+    # per-panel psum/all_gather protocol surfaces as WorkerLostError.
+    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked", n=n)
     with obs.span("dist_factor_solve", n=n, panel=panel):
-        x, *_ = jax.block_until_ready(solver(a_c))
+        x, *_ = _watchdog.guarded_device(
+            lambda: jax.block_until_ready(solver(a_c)),
+            site="dist.gauss_dist_blocked.solve")
     return x[:n]
 
 
@@ -357,7 +365,9 @@ def factor_solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh):
     """Factor + solve a staged system; returns (x, DistBlockedLU)."""
     a_c, n, npad, panel = staged
     solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
-    x, a_fac, perm, min_piv = solver(a_c)
+    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked", n=n)
+    x, a_fac, perm, min_piv = _watchdog.guarded_device(
+        lambda: solver(a_c), site="dist.gauss_dist_blocked.factor")
     return x[:n], DistBlockedLU(a_fac, perm, min_piv, n, npad, panel, mesh)
 
 

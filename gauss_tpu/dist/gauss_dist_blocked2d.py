@@ -61,6 +61,8 @@ from gauss_tpu.dist.gauss_dist_blocked import (DEFAULT_PANEL_DIST,
                                                _block_cyclic_perm,
                                                auto_panel_dist)
 from gauss_tpu.dist.mesh import make_mesh_2d_auto
+from gauss_tpu.resilience import fleet as _fleet
+from gauss_tpu.resilience import watchdog as _watchdog
 from gauss_tpu.utils import compat
 
 
@@ -385,7 +387,12 @@ def factor_dist_blocked2d(staged, mesh: jax.sharding.Mesh) -> DistBlocked2DLU:
                                  n=n, npad=npad, panel=panel,
                                  nblocks=npad // panel,
                                  mesh_shape=list(mesh.devices.shape))
-    a_fac, perm, linvs, uinvs, min_piv = fac_fn(a_c)
+    # Fleet hooks (see gauss_dist.solve_dist_staged): heartbeat + optional
+    # collective watchdog deadline for supervised workers.
+    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked2d",
+                n=n)
+    a_fac, perm, linvs, uinvs, min_piv = _watchdog.guarded_device(
+        lambda: fac_fn(a_c), site="dist.gauss_dist_blocked2d.factor")
     return DistBlocked2DLU(a_fac, perm, linvs, uinvs, min_piv, n, npad,
                            panel, mesh)
 

@@ -82,9 +82,12 @@ def initialize(coordinator: Optional[str] = None,
     if _inject.enabled():
         # Hook point "dist.multihost.worker": kill THIS worker right after
         # it joined (kind="kill" is a real os._exit — the preempted-VM
-        # stand-in). Surviving ranks must surface a collective failure,
-        # never a silent wrong answer. Workers inherit the plan through
-        # the GAUSS_FAULTS environment variable.
+        # stand-in) or stall it forever (kind="stall" sleeps until an
+        # external kill — the hung-not-dead worker whose lease goes stale
+        # while its process lives). Surviving ranks must surface a
+        # collective failure or a watchdog timeout, never a silent wrong
+        # answer. Workers inherit the plan through the GAUSS_FAULTS
+        # environment variable.
         _inject.maybe_kill("dist.multihost.worker")
 
 

@@ -120,6 +120,18 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "fleet_solve":
+        # A gauss-fleet --summary-json report: recovery depth (rung), resume
+        # latency, and restart counts enter history so supervised-recovery
+        # regressions gate like perf regressions. Derivation lives with the
+        # fleet (single source); lazy import keeps jax out of this module.
+        from gauss_tpu.resilience.fleet import history_records as fleet_hist
+
+        for metric, value, unit in fleet_hist(doc):
+            rec = _record(metric, value, path, "fleet", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "chaos_campaign":
         # A chaos-campaign summary (python -m gauss_tpu.resilience.chaos
         # --summary-json): recovery-depth and per-case cost enter history so

@@ -296,6 +296,62 @@ def _resilience_lines(rs: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def fleet_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the fleet supervisor's events (``fleet``: launch / worker_dead /
+    worker_stalled / restart / shrink / local_finish / done, plus worker-side
+    peer_lost) and ``watchdog`` deadline trips into one report: failure
+    detections by cause, restarts and shrinks, the elastic rung reached,
+    and resume latency. Empty dict when the run supervised nothing."""
+    fl = [ev for ev in events if ev.get("type") == "fleet"]
+    wd = [ev for ev in events if ev.get("type") == "watchdog"]
+    if not (fl or wd):
+        return {}
+    by_event: Dict[str, int] = {}
+    deaths_by_cause: Dict[str, int] = {}
+    for ev in fl:
+        k = str(ev.get("event", "?"))
+        by_event[k] = by_event.get(k, 0) + 1
+        if k == "worker_dead":
+            cause = str(ev.get("cause", "?"))
+            deaths_by_cause[cause] = deaths_by_cause.get(cause, 0) + 1
+    dones = [ev for ev in fl if ev.get("event") == "done"]
+    last = dones[-1] if dones else {}
+    return {
+        "events": by_event,
+        "deaths": {"total": by_event.get("worker_dead", 0),
+                   "by_cause": deaths_by_cause},
+        "stalls": by_event.get("worker_stalled", 0),
+        "restarts": by_event.get("restart", 0),
+        "shrinks": by_event.get("shrink", 0),
+        "local_finishes": by_event.get("local_finish", 0),
+        "watchdog_timeouts": len(wd),
+        "solves": len(dones),
+        "rung": last.get("rung"),
+        "resume_latency_s": last.get("resume_latency_s"),
+    }
+
+
+def _fleet_lines(fs: Dict[str, Any]) -> List[str]:
+    lines = []
+    causes = ", ".join(f"{k} x{v}"
+                       for k, v in sorted(fs["deaths"]["by_cause"].items()))
+    lines.append(f"  worker deaths: {fs['deaths']['total']}"
+                 + (f"  ({causes})" if causes else "")
+                 + f"; {fs['stalls']} stall detection(s), "
+                 f"{fs['watchdog_timeouts']} watchdog timeout(s)")
+    lines.append(f"  recovery: {fs['restarts']} restart(s), "
+                 f"{fs['shrinks']} shrink(s), "
+                 f"{fs['local_finishes']} local finish(es)")
+    if fs["solves"]:
+        tail = f"  supervised solves: {fs['solves']}"
+        if fs["rung"]:
+            tail += f", last rung {fs['rung']}"
+        if isinstance(fs["resume_latency_s"], (int, float)):
+            tail += f", resume latency {_fmt(fs['resume_latency_s'])} s"
+        lines.append(tail)
+    return lines
+
+
 def _human_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -351,6 +407,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
         "serving": serving_summary(evs),
         "resilience": resilience_summary(evs),
+        "fleet": fleet_summary(evs),
         "comms": comms_summary(evs),
         "compile": [_strip(ev) for ev in evs
                     if ev.get("type") in ("compile", "cost")],
@@ -407,6 +464,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("resilience:")
         out.extend(_resilience_lines(resilience))
+
+    fleet = fleet_summary(evs)
+    if fleet:
+        out.append("")
+        out.append("fleet:")
+        out.extend(_fleet_lines(fleet))
 
     comms = comms_summary(evs)
     if comms:

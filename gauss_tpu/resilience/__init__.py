@@ -18,6 +18,18 @@ chaos-engineering way production serving stacks do:
 - :mod:`gauss_tpu.resilience.checkpoint` — panel-granular checkpoint/resume
   for the chunked blocked factorization: a killed long solve resumes from
   the last checkpoint, bit-identical to an uninterrupted run.
+- :mod:`gauss_tpu.resilience.watchdog` — deadlines around blocking
+  collectives and coordination barriers: a dead or stalled peer surfaces as
+  a typed :class:`WorkerLostError`, never an infinite block.
+- :mod:`gauss_tpu.resilience.dcheckpoint` — the SHARDED, coordinated form
+  of the checkpoint for multi-worker solves: per-worker atomic carry
+  shards, a digest-bearing coordinator manifest per generation, last-good
+  retention, world-size-independent assembly.
+- :mod:`gauss_tpu.resilience.fleet` — the supervisor (``gauss-fleet``):
+  lease-file heartbeats, dead/stalled worker classification,
+  restart-and-resume from the sharded checkpoint, and elastic degrade
+  (shrink the world, or finish in-process) — a verified solution or a
+  typed :class:`FleetError`, never a hang.
 - :mod:`gauss_tpu.resilience.chaos` — the campaign runner
   (``python -m gauss_tpu.resilience.chaos``): seeded randomized fault plans
   swept across engines and hook points, asserting the one invariant that
@@ -37,7 +49,8 @@ from gauss_tpu.resilience.inject import (  # noqa: F401
     SimulatedFaultError,
 )
 
-_LAZY = ("recover", "checkpoint", "chaos", "inject")
+_LAZY = ("recover", "checkpoint", "chaos", "inject", "watchdog",
+         "dcheckpoint", "fleet")
 
 
 def __getattr__(name):
@@ -49,6 +62,18 @@ def __getattr__(name):
         from gauss_tpu.resilience.recover import solve_resilient
 
         return solve_resilient
+    if name == "WorkerLostError":
+        from gauss_tpu.resilience.watchdog import WorkerLostError
+
+        return WorkerLostError
+    if name == "FleetError":
+        from gauss_tpu.resilience.fleet import FleetError
+
+        return FleetError
+    if name == "solve_supervised":
+        from gauss_tpu.resilience.fleet import solve_supervised
+
+        return solve_supervised
     if name in _LAZY:
         import importlib
 

@@ -7,7 +7,7 @@ asserts the one invariant a solver service must never break:
     independently verifies at the relative-residual gate — or surfaced as a
     typed error. Never a silent wrong answer.**
 
-Three phases:
+Four phases:
 
 - **solver** (``--cases``): each case draws an engine (blocked / rank-1), a
   size, and a fault scenario from a seeded catalog — transient or
@@ -23,6 +23,13 @@ Three phases:
 - **checkpoint**: a checkpointed chunked factorization killed mid-run (the
   ``checkpoint.group`` hook) must resume to a factorization bit-identical
   to an uninterrupted run.
+- **fleet** (``--no-fleet`` to skip): supervised multi-worker solves with
+  a worker KILLED (os._exit) and a worker STALLED (sleep-forever) at a
+  seeded panel group; the supervisor must detect (lease heartbeats for the
+  stall, exit status for the kill), restart-and-resume from the sharded
+  checkpoint, and finish with a verified solution **bit-identical** to the
+  unfaulted supervised run — or raise the typed ``FleetError``. Every wait
+  is deadline-bounded: zero hangs, by construction.
 
 The summary (``--summary-json``) is regress-ingestable
 (``kind: chaos_campaign``): recovery depth (``mean_rung``), typed-error
@@ -128,12 +135,14 @@ def run_solver_phase(cases: int, seed: int, engines, sizes, panel, gate,
     from gauss_tpu import obs
 
     outcomes: List[Dict] = []
+    t0 = time.perf_counter()
     with obs.span("chaos_solver_phase", cases=cases):
         for i in range(cases):
             outcomes.append(_solver_case(i, seed, engines, sizes, panel,
                                          gate))
             if (i + 1) % 50 == 0:
                 log(f"  solver cases: {i + 1}/{cases}")
+    phase_wall = round(time.perf_counter() - t0, 3)
     by_rung: Dict[str, int] = {}
     counts = {"ok": 0, "recovered": 0, "typed_error": 0, "silent_wrong": 0,
               "violation": 0}
@@ -159,7 +168,7 @@ def run_solver_phase(cases: int, seed: int, engines, sizes, panel, gate,
         "typed_error_rate": round(counts["typed_error"] / cases, 4)
         if cases else None,
         "injected": injected, "injected_by_site": inj_site,
-        "injected_by_kind": inj_kind,
+        "injected_by_kind": inj_kind, "wall_s": phase_wall,
     }
 
 
@@ -252,6 +261,52 @@ def run_checkpoint_phase(tmpdir: str) -> Dict:
             "injected": injected, "resumed_rel_residual": float(rel)}
 
 
+def run_fleet_phase(seed: int, gate: float) -> Dict:
+    """Supervised-multihost chaos: kill one fleet worker and stall another
+    at a seeded panel group. Invariant: the supervised job completes with a
+    verified solution — bit-identical to the unfaulted supervised run — or
+    a typed FleetError; never a hang (every wait is deadline-bounded)."""
+    from gauss_tpu import obs
+    from gauss_tpu.resilience import fleet
+
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0xF1EE7)))
+    n = 48
+    a, b = _system(rng, n)
+    kw = dict(workers=2, panel=16, chunk=1, gate=gate, stall_after_s=3.0,
+              barrier_deadline_s=45.0, job_timeout_s=150.0)
+    cases: List[Dict] = []
+    with obs.span("chaos_fleet_phase"):
+        clean = fleet.solve_supervised(a, b, **kw)
+        group = 1 + int(rng.integers(0, 2))  # kill/stall at group 1 or 2
+        for kind in ("kill", "stall"):
+            case = {"kind": kind, "group": group}
+            try:
+                res = fleet.solve_supervised(
+                    a, b, inject=f"fleet.worker.group={kind}:skip={group}",
+                    inject_worker=1, **kw)
+                case.update(
+                    outcome="recovered" if res.recovered else "ok",
+                    rung=res.rung, restarts=res.restarts,
+                    stalls=res.stalls,
+                    rel_residual=float(res.rel_residual),
+                    resume_latency_s=res.resume_latency_s,
+                    bit_identical=bool(np.array_equal(clean.x, res.x)))
+            except fleet.FleetError as e:
+                case.update(outcome="typed_error", error=str(e)[:200])
+            except Exception as e:  # noqa: BLE001 — an untyped escape IS the bug
+                case.update(outcome="violation",
+                            error=f"{type(e).__name__}: {e}"[:200])
+            cases.append(case)
+    violations = sum(
+        1 for c in cases
+        if c["outcome"] == "violation"
+        or (c["outcome"] in ("ok", "recovered")
+            and not c.get("bit_identical")))
+    return {"ran": True, "cases": cases, "injected": len(cases),
+            "clean_rel_residual": float(clean.rel_residual),
+            "violations": violations}
+
+
 def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
     """(metric, value, unit) records a campaign contributes to the
     regression history. All slow-side-gated: recovery regressing shows as a
@@ -264,7 +319,11 @@ def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
     ter = sol.get("typed_error_rate")
     if isinstance(ter, (int, float)) and ter > 0:
         out.append(("chaos:solver/typed_error_rate", ter, "ratio"))
-    wall = summary.get("wall_s")
+    # Prefer the solver phase's OWN wall-clock (recorded since the fleet
+    # phase joined the campaign — the CAMPAIGN wall would charge subprocess
+    # fleet solves to the per-case metric); older summaries fall back to
+    # the campaign wall, which for them was the same thing minus epsilon.
+    wall = sol.get("wall_s", summary.get("wall_s"))
     cases = sol.get("cases")
     if isinstance(wall, (int, float)) and wall > 0 and cases:
         out.append(("chaos:solver/s_per_case", round(wall / cases, 6), "s"))
@@ -293,6 +352,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve-phase request count (0 disables the phase)")
     p.add_argument("--no-checkpoint", action="store_true",
                    help="skip the checkpoint kill/resume phase")
+    p.add_argument("--no-fleet", action="store_true",
+                   help="skip the supervised-fleet kill/stall phase "
+                        "(subprocess workers; the slowest phase)")
     p.add_argument("--tmpdir", default="/tmp",
                    help="where the checkpoint phase writes its files")
     p.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -335,26 +397,34 @@ def main(argv=None) -> int:
                  if args.serve_requests > 0 else {})
         ckpt = ({} if args.no_checkpoint
                 else run_checkpoint_phase(args.tmpdir))
+        flt = ({} if args.no_fleet
+               else run_fleet_phase(args.seed, args.gate))
         wall = round(time.perf_counter() - t0, 3)
 
         violations = (solver["counts"]["silent_wrong"]
                       + solver["counts"]["violation"]
                       + (serve.get("incorrect", 0) if serve else 0)
                       + (serve.get("unresolved", 0) if serve else 0)
-                      + (0 if not ckpt or ckpt["bit_identical"] else 1))
+                      + (0 if not ckpt or ckpt["bit_identical"] else 1)
+                      + (flt.get("violations", 0) if flt else 0))
         injected = (solver["injected"] + (serve.get("injected", 0))
-                    + (ckpt.get("injected", 0) if ckpt else 0))
+                    + (ckpt.get("injected", 0) if ckpt else 0)
+                    + (flt.get("injected", 0) if flt else 0))
         sites = dict(solver["injected_by_site"])
         for k, v in (serve.get("injected_by_site") or {}).items():
             sites[k] = sites.get(k, 0) + v
         if ckpt.get("injected"):
             sites["checkpoint.group"] = (sites.get("checkpoint.group", 0)
                                          + ckpt["injected"])
+        if flt.get("injected"):
+            sites["fleet.worker.group"] = (sites.get("fleet.worker.group", 0)
+                                           + flt["injected"])
         summary = {
             "kind": "chaos_campaign", "seed": args.seed,
             "engines": engines, "sizes": sizes, "gate": args.gate,
             "injected": injected, "injected_by_site": sites,
             "solver": solver, "serve": serve, "checkpoint": ckpt,
+            "fleet": flt,
             "wall_s": wall, "invariant_ok": violations == 0,
         }
         obs.emit("chaos_campaign",
@@ -377,6 +447,14 @@ def main(argv=None) -> int:
         print(f"  checkpoint: killed={ckpt['killed']} "
               f"bit_identical={ckpt['bit_identical']} "
               f"rel_residual={ckpt['resumed_rel_residual']:.3e}")
+    if flt:
+        for c in flt["cases"]:
+            print(f"  fleet[{c['kind']}@group{c['group']}]: "
+                  f"{c['outcome']}"
+                  + (f" rung={c.get('rung')} restarts={c.get('restarts')} "
+                     f"stalls={c.get('stalls')} "
+                     f"bit_identical={c.get('bit_identical')}"
+                     if "rung" in c else f" ({c.get('error', '')[:80]})"))
     print(f"  invariant {'HOLDS' if violations == 0 else 'VIOLATED'} "
           f"({wall} s)")
 

@@ -44,7 +44,8 @@ SCHEMA = 1
 
 class CheckpointMismatchError(RuntimeError):
     """The checkpoint on disk does not belong to this (operand, statics)
-    factorization — resuming would produce a silently wrong factor."""
+    factorization — or is truncated/corrupt and cannot be trusted at all.
+    Either way, resuming from it would risk a silently wrong factor."""
 
 
 def _digest(a: np.ndarray) -> str:
@@ -52,6 +53,27 @@ def _digest(a: np.ndarray) -> str:
     h.update(str((a.shape, str(a.dtype))).encode())
     h.update(np.ascontiguousarray(a).tobytes())
     return h.hexdigest()[:16]
+
+
+def prev_path(path) -> str:
+    """Where :func:`save_state` keeps the PREVIOUS checkpoint generation."""
+    return os.fspath(path) + ".prev"
+
+
+def fsync_dir(parent: str) -> None:
+    """fsync a directory so a just-renamed file's entry survives a crash
+    (the rename itself is atomic, but durability of the new entry needs the
+    parent flushed). Best-effort — not every filesystem supports it."""
+    try:
+        fd = os.open(parent, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _group_step_jit(panel: int, chunk: int, panel_impl: str,
@@ -72,7 +94,15 @@ def _group_step_jit(panel: int, chunk: int, panel_impl: str,
 
 
 def save_state(path, *, meta: dict, m, perm, min_piv, linvs, uinvs) -> int:
-    """Atomically write one checkpoint (tmp + rename); returns bytes."""
+    """Durably write one checkpoint; returns bytes written.
+
+    tmp + fsync + rename + parent-dir fsync, and the checkpoint that was at
+    ``path`` is KEPT as ``path.prev`` (one previous generation): a process
+    killed at ANY instant of writing generation K leaves either K intact or
+    K−1 intact — never zero resumable checkpoints. (Without the file fsync,
+    a crash shortly after the rename could surface a truncated K with K−1
+    already gone; :func:`load_state` types that corruption, and the resume
+    path falls back to ``.prev``.)"""
     path = os.fspath(path)
     parent = os.path.dirname(path) or "."
     os.makedirs(parent, exist_ok=True)
@@ -85,21 +115,78 @@ def save_state(path, *, meta: dict, m, perm, min_piv, linvs, uinvs) -> int:
                 m=np.asarray(m), perm=np.asarray(perm),
                 min_piv=np.asarray(min_piv), linvs=np.asarray(linvs),
                 uinvs=np.asarray(uinvs))
+            f.flush()
+            os.fsync(f.fileno())
+        nbytes = os.path.getsize(tmp)
+        if os.path.exists(path):
+            os.replace(path, prev_path(path))
         os.replace(tmp, path)
+        fsync_dir(parent)
     except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
             pass
         raise
-    return os.path.getsize(path)
+    return nbytes
 
 
 def load_state(path) -> dict:
-    with np.load(os.fspath(path)) as z:
-        out = {k: z[k] for k in ("m", "perm", "min_piv", "linvs", "uinvs")}
-        out["meta"] = json.loads(bytes(z["meta"]).decode())
+    """Load one checkpoint. A file that cannot be parsed end to end — a
+    torn write, a truncated npz, mangled meta — raises a typed
+    :class:`CheckpointMismatchError` instead of leaking a raw zipfile/json/
+    numpy error, so callers can fall back to the previous generation."""
+    path = os.fspath(path)
+    try:
+        with np.load(path) as z:
+            out = {k: np.array(z[k])
+                   for k in ("m", "perm", "min_piv", "linvs", "uinvs")}
+            out["meta"] = json.loads(bytes(z["meta"]).decode())
+    except CheckpointMismatchError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any parse failure means corrupt
+        raise CheckpointMismatchError(
+            f"checkpoint at {path} is truncated or corrupt "
+            f"({type(e).__name__}: {e})") from e
     return out
+
+
+def _load_resume_state(path, meta: dict):
+    """Resolve the resumable state for ``meta``: the current checkpoint at
+    ``path``, falling back to the kept previous generation at ``path.prev``
+    when the current file is truncated/corrupt (a kill mid-write of K
+    resumes from K−1, never fails the job). Returns None when neither file
+    exists. A VALID checkpoint whose meta does not match stays a hard
+    :class:`CheckpointMismatchError` — that is a different factorization,
+    not a torn write, and falling back would silently mix systems."""
+    candidates = [path, prev_path(path)]
+    corrupt = None
+    for cand in candidates:
+        if not os.path.exists(cand):
+            continue
+        try:
+            state = load_state(cand)
+        except CheckpointMismatchError as e:
+            corrupt = e
+            obs.counter("resilience.checkpoint.corrupt")
+            obs.emit("checkpoint", event="corrupt", path=cand,
+                     error=str(e)[:200])
+            continue
+        disk = dict(state["meta"])
+        disk.pop("next_group", None)
+        disk.pop("panels_done", None)
+        if disk != meta or "next_group" not in state["meta"]:
+            raise CheckpointMismatchError(
+                f"checkpoint at {cand} does not match this factorization: "
+                f"checkpoint {disk}, requested {meta}")
+        if cand != path:
+            obs.emit("checkpoint", event="fallback_prev", path=cand)
+        return state
+    if corrupt is not None:
+        # Both generations unusable: surface the typed corruption rather
+        # than silently recomputing — the caller decides (resume=False).
+        raise corrupt
+    return None
 
 
 def lu_factor_blocked_chunked_checkpointed(
@@ -150,15 +237,11 @@ def lu_factor_blocked_chunked_checkpointed(
     min_piv = jnp.asarray(jnp.inf, m.dtype)
     linv_parts, uinv_parts = [], []
 
-    if resume and os.path.exists(path):
-        state = load_state(path)
+    state = _load_resume_state(path, meta) if resume else None
+    if state is not None:
         disk = dict(state["meta"])
-        next_group = disk.pop("next_group", None)
+        next_group = disk.pop("next_group")
         panels_done = disk.pop("panels_done", 0)
-        if disk != meta or next_group is None:
-            raise CheckpointMismatchError(
-                f"checkpoint at {path} does not match this factorization: "
-                f"checkpoint {disk}, requested {meta}")
         m = jnp.asarray(state["m"])
         perm = jnp.asarray(state["perm"])
         min_piv = jnp.asarray(state["min_piv"])
@@ -199,10 +282,11 @@ def lu_factor_blocked_chunked_checkpointed(
                      bytes=int(nbytes))
 
     if not keep:
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        for stale in (path, prev_path(path)):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
     obs.emit("checkpoint", event="complete", path=path, groups=-(-nb // chunk))
     return blocked.BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                              linv=jnp.concatenate(

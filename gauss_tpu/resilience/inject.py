@@ -20,11 +20,16 @@ in docs/RESILIENCE.md):
                             pressure) — gauss_tpu.serve.server
     dist.multihost.straggler  sleep ``param`` seconds in multihost
                             initialize — gauss_tpu.dist.multihost
-    dist.multihost.worker   kill the worker process (os._exit) after
+    dist.multihost.worker   kill the worker process (os._exit) or stall it
+                            forever (sleep until externally killed) after
                             multihost initialize — gauss_tpu.dist.multihost
     checkpoint.group        raise (simulated kill) or os._exit between
                             checkpointed factor groups —
                             gauss_tpu.resilience.checkpoint
+    fleet.worker.group      kill / stall / raise a supervised fleet worker
+                            between sharded-checkpoint groups (``skip``
+                            picks the group) — gauss_tpu.resilience
+                            .dcheckpoint
 
 Design rules:
 
@@ -70,7 +75,7 @@ ENV_VAR = "GAUSS_FAULTS"
 #: kinds that corrupt an operand array
 CORRUPT_KINDS = ("nan", "inf", "bitflip", "near_zero_pivot")
 #: kinds with dedicated action helpers
-ACTION_KINDS = ("raise", "compile_fail", "delay", "kill")
+ACTION_KINDS = ("raise", "compile_fail", "delay", "kill", "stall")
 KINDS = CORRUPT_KINDS + ACTION_KINDS
 
 #: exit status used by kind="kill" — distinctive, so a harness can tell an
@@ -376,13 +381,20 @@ def maybe_delay(site: str) -> float:
 def maybe_kill(site: str) -> None:
     """Poll ``site``; kind ``kill`` terminates the process immediately via
     ``os._exit`` (no cleanup, no atexit — the honest SIGKILL stand-in);
-    kind ``raise`` throws SimulatedFaultError instead (the in-process
-    variant tests use where a real exit would take the test runner down)."""
+    kind ``stall`` sleeps FOREVER (the hung-not-dead worker: the process
+    stays alive, its heartbeat goes stale, and only an external kill — the
+    fleet supervisor's — ends it), distinct from ``kill`` so watchdog/
+    stall-detection paths are testable separately from crash paths; kind
+    ``raise`` throws SimulatedFaultError instead (the in-process variant
+    tests use where a real exit would take the test runner down)."""
     sp = poll(site)
     if sp is None:
         return
     if sp.kind == "kill":
         os._exit(KILL_EXIT_CODE)
+    if sp.kind == "stall":
+        while True:  # pragma: no cover — only ends by external kill
+            time.sleep(3600.0)
     if sp.kind == "raise":
         raise SimulatedFaultError(f"injected worker kill at {site}")
 

@@ -24,6 +24,7 @@ Quick tour::
 """
 
 from gauss_tpu.serve.admission import (  # noqa: F401
+    STATUS_CANCELLED,
     STATUS_EXPIRED,
     STATUS_FAILED,
     STATUS_OK,

@@ -33,6 +33,7 @@ STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"      # queue full — never entered the queue
 STATUS_EXPIRED = "expired"        # deadline passed before compute
 STATUS_FAILED = "failed"          # lane error after retries
+STATUS_CANCELLED = "cancelled"    # client gave up waiting; worker skips it
 
 
 @dataclasses.dataclass
@@ -53,6 +54,9 @@ class ServeConfig:
     device_probe_cooldown_s: float = 5.0  # how long fallback lane holds
     deadline_default_s: Optional[float] = None  # applied when request has none
     verify_gate: Optional[float] = None  # rel-residual bar; None = no check
+    supervised_handoff: bool = False  # route oversized single-RHS solves
+    #                                   through the fleet supervisor
+    fleet_workers: int = 2          # world size for the supervised lane
 
 
 @dataclasses.dataclass
@@ -98,6 +102,7 @@ class ServeRequest:
         self.deadline = (self.t_submit + deadline_s
                          if deadline_s is not None else None)
         self._done = threading.Event()
+        self._resolve_lock = threading.Lock()
         self._result: Optional[ServeResult] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
@@ -105,15 +110,50 @@ class ServeRequest:
             return False
         return (time.perf_counter() if now is None else now) > self.deadline
 
-    def resolve(self, result: ServeResult) -> None:
-        result.latency_s = time.perf_counter() - self.t_submit
-        self._result = result
-        self._done.set()
+    def resolve(self, result: ServeResult) -> bool:
+        """Set the terminal result. FIRST resolve wins (a compare-and-set
+        under a lock): the worker finishing and the client cancelling can
+        race, and exactly one of them may own the terminal status — the
+        same exactly-one-terminal guarantee stop() gives the shutdown race.
+        Returns True when this call won; callers emit their terminal obs
+        event only then, so the stream carries one terminal per request
+        too."""
+        with self._resolve_lock:
+            if self._result is not None:
+                return False
+            result.latency_s = time.perf_counter() - self.t_submit
+            self._result = result
+            self._done.set()
+            return True
+
+    def cancel(self, error: str = "cancelled by client") -> bool:
+        """Resolve as cancelled (if still pending). The worker observes
+        ``done`` at drain/dispatch time and skips the request — a client
+        that stopped waiting no longer costs padding, H2D, or compute.
+        Returns True when the cancellation won the race."""
+        won = self.resolve(ServeResult(status=STATUS_CANCELLED, error=error))
+        if won:
+            from gauss_tpu import obs
+
+            obs.counter("serve.cancelled")
+            obs.emit("serve_request", id=self.id, n=self.n,
+                     status=STATUS_CANCELLED, reason=error)
+        return won
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
-        """Block until the request resolves (the client-side wait)."""
+        """Block until the request resolves (the client-side wait).
+
+        A timeout CANCELS the request before raising: the abandoned entry
+        is skipped by the worker instead of being served into the void
+        (and, before this, silently orphaned in the queue). If the worker
+        resolves in the race window the real result is returned instead —
+        either way the request ends with exactly one terminal status."""
         if not self._done.wait(timeout):
-            raise TimeoutError(f"request {self.id} still pending")
+            if self.cancel(error="client stopped waiting "
+                                 f"(result timeout {timeout} s)"):
+                raise TimeoutError(
+                    f"request {self.id} timed out after {timeout} s and "
+                    f"was cancelled")
         return self._result  # type: ignore[return-value]
 
     @property

@@ -124,13 +124,14 @@ class SolverServer:
                 req = self._queue.get_nowait()
             except _queue.Empty:
                 break
-            if req is not None and not req.done:
-                self._depth_add(-1)
+            if req is None:
+                continue
+            self._depth_add(-1)
+            if req.resolve(ServeResult(status=STATUS_REJECTED,
+                                       error="server stopped")):
                 obs.counter("serve.rejected")
                 obs.emit("serve_request", id=req.id, n=req.n,
                          status=STATUS_REJECTED, reason="server_stopped")
-                req.resolve(ServeResult(status=STATUS_REJECTED,
-                                        error="server stopped"))
 
     def __enter__(self) -> "SolverServer":
         return self.start()
@@ -177,20 +178,22 @@ class SolverServer:
                 self._depth += 1
                 self._queue.put(req)
         if closed:
-            obs.counter("serve.rejected")
-            obs.emit("serve_request", id=req.id, n=req.n,
-                     status=STATUS_REJECTED, reason="server_stopped")
-            req.resolve(ServeResult(status=STATUS_REJECTED,
-                                    error="server stopped"))
+            if req.resolve(ServeResult(status=STATUS_REJECTED,
+                                       error="server stopped")):
+                obs.counter("serve.rejected")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_REJECTED, reason="server_stopped")
             return req
         if full:
             hint = self.retry_after_hint()
-            obs.counter("serve.rejected")
-            obs.emit("serve_request", id=req.id, n=req.n, status=STATUS_REJECTED,
-                     reason="queue_full", retry_after_s=hint,
-                     queue_depth=self._depth_snapshot())
-            req.resolve(ServeResult(status=STATUS_REJECTED,
-                                    retry_after_s=hint, error="queue full"))
+            if req.resolve(ServeResult(status=STATUS_REJECTED,
+                                       retry_after_s=hint,
+                                       error="queue full")):
+                obs.counter("serve.rejected")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_REJECTED, reason="queue_full",
+                         retry_after_s=hint,
+                         queue_depth=self._depth_snapshot())
             return req
         obs.counter("serve.submitted")
         return req
@@ -261,13 +264,18 @@ class SolverServer:
         now = time.perf_counter()
         live = []
         for req in batch:
+            if req.done:
+                # Cancelled while queued (result-timeout propagation): the
+                # client already holds the terminal status; skip the work.
+                obs.counter("serve.cancelled_skipped")
+                continue
             if req.expired(now):
-                obs.counter("serve.expired")
-                obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_EXPIRED)
-                req.resolve(ServeResult(status=STATUS_EXPIRED,
-                                        error="deadline expired before "
-                                              "compute"))
+                if req.resolve(ServeResult(status=STATUS_EXPIRED,
+                                           error="deadline expired before "
+                                                 "compute")):
+                    obs.counter("serve.expired")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             status=STATUS_EXPIRED)
             else:
                 live.append(req)
         if not live:
@@ -339,13 +347,14 @@ class SolverServer:
                     self._serve_numpy(req)
                 return
             for req in reqs:
-                obs.counter("serve.failed")
-                obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_FAILED, lane="batched",
-                         error=f"{type(err).__name__}: {err}"[:200])
-                req.resolve(ServeResult(
-                    status=STATUS_FAILED, lane="batched", bucket_n=bucket_n,
-                    error=f"{type(err).__name__}: {err}"))
+                if req.resolve(ServeResult(
+                        status=STATUS_FAILED, lane="batched",
+                        bucket_n=bucket_n,
+                        error=f"{type(err).__name__}: {err}")):
+                    obs.counter("serve.failed")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             status=STATUS_FAILED, lane="batched",
+                             error=f"{type(err).__name__}: {err}"[:200])
             return
 
         self.health.record_success()
@@ -362,24 +371,40 @@ class SolverServer:
 
     def _serve_handoff(self, req: ServeRequest) -> None:
         """Oversized lane: one solve_handoff call per request (the routing
-        decision itself is emitted by solve_handoff as a ``route`` event)."""
+        decision itself is emitted by solve_handoff as a ``route`` event).
+        With ``supervised_handoff`` the single-RHS case routes through the
+        fleet supervisor instead — the long solve survives worker loss
+        (restart/resume from the sharded checkpoint, elastic degrade) where
+        a plain handoff would die with its process."""
         from gauss_tpu.core import blocked
 
+        cfg = self.config
+        lane = "handoff"
         try:
             with obs.span("serve_handoff", n=req.n):
-                x = blocked.solve_handoff(req.a.astype(np.float64),
-                                          req.b.astype(np.float64),
-                                          panel=self.config.panel,
-                                          iters=max(2, self.config.refine_steps))
+                if cfg.supervised_handoff and req.was_vector:
+                    from gauss_tpu.resilience import fleet
+
+                    lane = "fleet"
+                    obs.emit("route", tool="serve_handoff", lane="fleet",
+                             n=req.n, workers=cfg.fleet_workers)
+                    x = fleet.solve_supervised(
+                        req.a.astype(np.float64), req.b.astype(np.float64),
+                        workers=cfg.fleet_workers, panel=cfg.panel,
+                        refine_iters=max(2, cfg.refine_steps)).x
+                else:
+                    x = blocked.solve_handoff(
+                        req.a.astype(np.float64), req.b.astype(np.float64),
+                        panel=cfg.panel, iters=max(2, cfg.refine_steps))
         except Exception as e:  # noqa: BLE001 — lane boundary
-            obs.counter("serve.failed")
-            obs.emit("serve_request", id=req.id, n=req.n,
-                     status=STATUS_FAILED, lane="handoff",
-                     error=f"{type(e).__name__}: {e}"[:200])
-            req.resolve(ServeResult(status=STATUS_FAILED, lane="handoff",
-                                    error=f"{type(e).__name__}: {e}"))
+            if req.resolve(ServeResult(status=STATUS_FAILED, lane=lane,
+                                       error=f"{type(e).__name__}: {e}")):
+                obs.counter("serve.failed")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_FAILED, lane=lane,
+                         error=f"{type(e).__name__}: {e}"[:200])
             return
-        self._finish(req, np.asarray(x), lane="handoff", bucket_n=None)
+        self._finish(req, np.asarray(x), lane=lane, bucket_n=None)
 
     def _serve_numpy(self, req: ServeRequest) -> None:
         """Degraded host lane, through the SAME recovery ladder the solver
@@ -399,12 +424,12 @@ class SolverServer:
                     gate=gate, rungs=("numpy_f64", "rank1"))
             x = rr.x
         except Exception as e:  # noqa: BLE001 — lane boundary
-            obs.counter("serve.failed")
-            obs.emit("serve_request", id=req.id, n=req.n,
-                     status=STATUS_FAILED, lane="numpy",
-                     error=f"{type(e).__name__}: {e}"[:200])
-            req.resolve(ServeResult(status=STATUS_FAILED, lane="numpy",
-                                    error=f"{type(e).__name__}: {e}"))
+            if req.resolve(ServeResult(status=STATUS_FAILED, lane="numpy",
+                                       error=f"{type(e).__name__}: {e}")):
+                obs.counter("serve.failed")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         status=STATUS_FAILED, lane="numpy",
+                         error=f"{type(e).__name__}: {e}"[:200])
             return
         self._finish(req, x, lane="numpy", bucket_n=None)
 
@@ -416,23 +441,24 @@ class SolverServer:
 
             rel = checks.residual_norm(req.a, x, req.b, relative=True)
             if not rel <= self.config.verify_gate:
-                obs.counter("serve.failed")
-                obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_FAILED, lane=lane,
-                         rel_residual=rel, error="verify gate")
-                req.resolve(ServeResult(
-                    status=STATUS_FAILED, lane=lane, bucket_n=bucket_n,
-                    rel_residual=rel,
-                    error=f"relative residual {rel:.3e} exceeds the "
-                          f"{self.config.verify_gate:.0e} verify gate"))
+                if req.resolve(ServeResult(
+                        status=STATUS_FAILED, lane=lane, bucket_n=bucket_n,
+                        rel_residual=rel,
+                        error=f"relative residual {rel:.3e} exceeds the "
+                              f"{self.config.verify_gate:.0e} verify gate")):
+                    obs.counter("serve.failed")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             status=STATUS_FAILED, lane=lane,
+                             rel_residual=rel, error="verify gate")
                 return
-        self.requests_served += 1
         queue_s = time.perf_counter() - req.t_submit
+        if not req.resolve(ServeResult(status=STATUS_OK, x=x, lane=lane,
+                                       bucket_n=bucket_n, queue_s=queue_s,
+                                       rel_residual=rel)):
+            return  # cancelled mid-compute: the client owns the terminal
+        self.requests_served += 1
         obs.counter("serve.served")
         obs.histogram("serve.latency_s", queue_s)
         obs.emit("serve_request", id=req.id, n=req.n, k=req.k,
                  status=STATUS_OK, lane=lane, bucket_n=bucket_n,
                  latency_s=round(queue_s, 6), rel_residual=rel)
-        req.resolve(ServeResult(status=STATUS_OK, x=x, lane=lane,
-                                bucket_n=bucket_n, queue_s=queue_s,
-                                rel_residual=rel))

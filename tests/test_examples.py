@@ -32,6 +32,13 @@ def test_serve_quickstart_runs():
     assert "lane=batched" in r.stdout
 
 
+def test_fleet_solve_runs():
+    r = _run(["examples/fleet_solve.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "kill=True stall=True" in r.stdout
+    assert "restart" in r.stdout
+
+
 def test_resilient_solve_runs():
     r = _run(["examples/resilient_solve.py"])
     assert r.returncode == 0, r.stdout + r.stderr

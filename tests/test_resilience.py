@@ -330,6 +330,86 @@ def test_checkpoint_mismatch_is_typed(tmp_path, rng):
     assert np.isfinite(np.asarray(fac.m)).all()
 
 
+def test_checkpoint_corrupt_file_typed_and_prev_fallback(tmp_path, rng):
+    """Satellite: a truncated checkpoint is a typed CheckpointMismatchError
+    (not a raw zipfile/numpy error), and when the previous generation was
+    kept the resume silently falls back to it — a kill during the write of
+    checkpoint K resumes from K−1 instead of failing."""
+    n = 96
+    a = _system(rng, n)[0].astype(np.float32)
+    path = tmp_path / "ck.npz"
+    kw = dict(panel=16, chunk=1, every_panels=1)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site="checkpoint.group", kind="raise", max_triggers=1, skip=3)])
+    with inject.plan(plan):
+        with pytest.raises(inject.SimulatedFaultError):
+            ckpt.lu_factor_blocked_chunked_checkpointed(a, path, **kw)
+    # Two generations on disk: current (K) and previous (K-1).
+    prev = tmp_path / "ck.npz.prev"
+    assert path.exists() and prev.exists()
+    k_cur = ckpt.load_state(path)["meta"]["next_group"]
+    assert ckpt.load_state(prev)["meta"]["next_group"] == k_cur - 1
+
+    # Corrupt the CURRENT file (torn write): load is typed...
+    path.write_bytes(path.read_bytes()[:100])
+    with pytest.raises(ckpt.CheckpointMismatchError, match="corrupt"):
+        ckpt.load_state(path)
+    # ...and the checkpointed factorization resumes from K-1.
+    with obs.run() as rec:
+        resumed = ckpt.lu_factor_blocked_chunked_checkpointed(a, path, **kw)
+    evs = [e for e in rec.events if e["type"] == "checkpoint"]
+    assert [e for e in evs if e["event"] == "corrupt"]
+    assert [e for e in evs if e["event"] == "fallback_prev"]
+    res_ev = [e for e in evs if e["event"] == "resume"]
+    assert res_ev and res_ev[0]["next_group"] == k_cur - 1
+    clean = ckpt.lu_factor_blocked_chunked_checkpointed(
+        a, tmp_path / "clean.npz", **kw)
+    np.testing.assert_array_equal(np.asarray(resumed.m),
+                                  np.asarray(clean.m))
+    assert not path.exists() and not prev.exists()  # success cleans both
+
+
+def test_checkpoint_both_generations_corrupt_is_typed(tmp_path, rng):
+    a = _system(rng, 64)[0].astype(np.float32)
+    path = tmp_path / "ck.npz"
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site="checkpoint.group", kind="raise", max_triggers=1, skip=2)])
+    with inject.plan(plan):
+        with pytest.raises(inject.SimulatedFaultError):
+            ckpt.lu_factor_blocked_chunked_checkpointed(
+                a, path, panel=16, chunk=1, every_panels=1)
+    for p in (path, tmp_path / "ck.npz.prev"):
+        p.write_bytes(b"not a checkpoint")
+    with pytest.raises(ckpt.CheckpointMismatchError, match="corrupt"):
+        ckpt.lu_factor_blocked_chunked_checkpointed(a, path, panel=16,
+                                                    chunk=1)
+    # resume=False recomputes from scratch regardless.
+    fac = ckpt.lu_factor_blocked_chunked_checkpointed(
+        a, path, panel=16, chunk=1, resume=False)
+    assert np.isfinite(np.asarray(fac.m)).all()
+
+
+def test_stall_kind_sleeps_until_killed(tmp_path):
+    """Satellite: kind=stall hangs the process forever (the hung-not-dead
+    worker) — the subprocess stays alive past a grace period and only an
+    external kill ends it, unlike kind=kill's immediate os._exit."""
+    code = ("from gauss_tpu.resilience import inject\n"
+            "print('armed', flush=True)\n"
+            "inject.maybe_kill('w')\n"
+            "print('unreachable', flush=True)\n")
+    env = {**os.environ, "GAUSS_FAULTS": "w=stall"}
+    p = subprocess.Popen([sys.executable, "-c", code], env=env, cwd=REPO,
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "armed"
+        time.sleep(1.0)
+        assert p.poll() is None          # still alive: stalled, not dead
+    finally:
+        p.kill()
+        out, _ = p.communicate(timeout=60)
+    assert "unreachable" not in out
+
+
 # -- serve fallback lane reuses the ladder ---------------------------------
 
 def test_serve_numpy_lane_is_ladder_backed(rng):
@@ -377,14 +457,25 @@ def test_chaos_campaign_small_end_to_end(tmp_path):
     assert summary["solver"]["counts"]["silent_wrong"] == 0
     assert summary["solver"]["counts"]["violation"] == 0
     assert summary["checkpoint"]["bit_identical"]
+    # the supervised-fleet phase: kill + stall both recovered, bit-identical
+    assert summary["fleet"]["violations"] == 0
+    assert {c["kind"] for c in summary["fleet"]["cases"]} == {"kill",
+                                                             "stall"}
+    assert all(c.get("bit_identical") for c in summary["fleet"]["cases"]
+               if c["outcome"] in ("ok", "recovered"))
     # regress ingest path
     recs = regress.ingest_file(summary_path)
     assert recs and all(r["kind"] == "chaos" for r in recs)
     assert any(r["metric"] == "chaos:solver/mean_rung" for r in recs)
-    # the stream renders a resilience section
+    # the stream renders a resilience section. Fleet-phase faults fire
+    # inside WORKER subprocesses (their fault events live in the job's
+    # per-worker streams), so the campaign stream carries everything else.
     events = obs.read_events(metrics_path)
     rs = summarize.resilience_summary(events)
-    assert rs["injections"]["total"] == summary["injected"]
+    assert rs["injections"]["total"] == (summary["injected"]
+                                         - summary["fleet"]["injected"])
+    # ...and a fleet section from the supervisor's events.
+    assert summarize.fleet_summary(events)["solves"] == 3
 
 
 def test_chaos_history_records_shape():

@@ -360,6 +360,82 @@ def test_breaker_probe_failure_extends_cooldown(rng):
     assert len(trips) == 2  # each failed probe re-trips with a fresh cooldown
 
 
+def test_result_timeout_cancels_request(rng):
+    """Satellite: a result(timeout) that expires CANCELS the queued request
+    — the worker skips it (never serves into the void), and exactly one
+    terminal 'cancelled' status/event exists (the result-timeout mirror of
+    the stop()-race guarantee)."""
+    from gauss_tpu.resilience import inject
+    from gauss_tpu.serve import STATUS_CANCELLED
+
+    a, b = _system(rng, 8)
+    # Stall the worker before dispatch so the queued request is still
+    # pending when the client gives up.
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site="serve.worker.dispatch", kind="delay", param=0.4,
+        max_triggers=None)])
+    with obs.run() as rec:
+        with inject.plan(plan):
+            with SolverServer(_config()) as srv:
+                h = srv.submit(a, b)
+                with pytest.raises(TimeoutError, match="cancelled"):
+                    h.result(timeout=0.05)
+                assert h.done
+                res = h.result(0)
+                assert res.status == STATUS_CANCELLED
+                # give the worker time to drain past the cancelled entry
+                ok = srv.submit(a, b).result(timeout=60)
+                assert ok.status == STATUS_OK
+    # the cancelled request was resolved exactly once, and never served
+    assert h.result(0).status == STATUS_CANCELLED
+    terminal = [e for e in rec.events if e["type"] == "serve_request"
+                and e.get("id") == h.id]
+    assert len(terminal) == 1 and terminal[0]["status"] == STATUS_CANCELLED
+
+
+def test_cancel_loses_race_to_completion(rng, server):
+    """cancel() after the worker resolved is a no-op: the ok result stands
+    and result(timeout) returns it instead of raising."""
+    a, b = _system(rng, 8)
+    h = server.submit(a, b)
+    res = h.result(timeout=60)
+    assert res.status == STATUS_OK
+    assert h.cancel() is False
+    assert h.result(0.001).status == STATUS_OK
+
+
+def test_resolve_is_first_wins(rng):
+    from gauss_tpu.serve import ServeResult
+    from gauss_tpu.serve.admission import STATUS_CANCELLED
+
+    req = ServeRequest(np.eye(4), np.ones(4))
+    assert req.resolve(ServeResult(status=STATUS_OK)) is True
+    assert req.resolve(ServeResult(status=STATUS_FAILED)) is False
+    assert req.cancel() is False
+    assert req.result(0).status == STATUS_OK
+    req2 = ServeRequest(np.eye(4), np.ones(4))
+    assert req2.cancel() is True
+    assert req2.result(0).status == STATUS_CANCELLED
+
+
+def test_supervised_handoff_lane(rng):
+    """Oversized single-RHS requests route through the fleet supervisor
+    when supervised_handoff is set: the route event says lane=fleet and
+    the solution verifies."""
+    a, b = _system(rng, 24)   # past the (16,) ladder top -> handoff lane
+    cfg = _config(ladder=(16,), supervised_handoff=True, fleet_workers=1)
+    with obs.run() as rec:
+        with SolverServer(cfg) as srv:
+            res = srv.solve(a, b, timeout=180)
+    assert res.status == STATUS_OK and res.lane == "fleet"
+    assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+    routes = [e for e in rec.events if e["type"] == "route"
+              and e.get("lane") == "fleet"]
+    assert routes and routes[0]["tool"] == "serve_handoff"
+    assert [e for e in rec.events if e["type"] == "fleet"
+            and e.get("event") == "done"]
+
+
 def test_stop_shutdown_race_every_request_terminal(rng):
     """The shutdown race the stop() rework pins: submits racing stop(drain)
     must each resolve with exactly one terminal status — served, rejected,
