@@ -6,8 +6,10 @@ PYTHON ?= python
 OBS_SMOKE ?= /tmp/gauss_obs_check.jsonl
 SERVE_SMOKE ?= /tmp/gauss_serve_check
 FAULTS_SMOKE ?= /tmp/gauss_faults_check
+STRUCT_SMOKE ?= /tmp/gauss_structure_check
 
-.PHONY: all native test bench datasets obs-check serve-check faults-check clean
+.PHONY: all native test bench datasets obs-check serve-check faults-check \
+	structure-check clean
 
 all: native
 
@@ -86,6 +88,26 @@ faults-check:
 	fl=[r['fleet'] for r in runs.values() if r.get('fleet')]; \
 	assert fl and fl[0]['restarts'] >= 1 and fl[0]['solves'] == 1, fl; \
 	print('faults-check: fleet summary ok:', fl[0])"
+
+# The structure gate (CI-callable): detect -> route -> engine -> 1e-4
+# verify across all four structure classes (SPD/Cholesky, banded,
+# block-diagonal, dense) on the deterministic generators, exit 2 on any
+# misroute or verification failure, gated against the regression history
+# (exit 1 out-of-band: a class silently demoting back to dense LU moves
+# its flops_ratio/s_per_solve out of band), then the recorded stream is
+# asserted to carry a structure-lanes summary with zero demotions.
+structure-check:
+	rm -rf $(STRUCT_SMOKE) && mkdir -p $(STRUCT_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.structure.check \
+	  --spd-n 96 --banded-n 512 --banded-bw 1 \
+	  --blockdiag-n 96 --block 16 --dense-n 96 --seed 258458 \
+	  --metrics-out $(STRUCT_SMOKE)/structure.jsonl \
+	  --summary-json $(STRUCT_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(STRUCT_SMOKE)/structure.jsonl \
+	  --json | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	st=[r['structure'] for r in runs.values() if r.get('structure')]; \
+	assert st and st[0]['solves'] >= 4 and st[0]['demotions'] == 0, st; \
+	print('structure-check: structure summary ok:', st[0]['engines'])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

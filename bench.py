@@ -191,6 +191,13 @@ if __name__ == "__main__":
             cli.regress_history or regress.default_history_path())
         verdicts = [regress.evaluate(record["metric"], record["value"],
                                      history)]
+        # The hard ratchet: the fresh headline also gates against the
+        # committed best-prior record (1.476 ms, BENCH_r03) — the median
+        # band tolerates a slow NORM, the ratchet refuses one.
+        ratchet = regress.evaluate_ratchet(record["metric"],
+                                           record["value"])
+        if ratchet is not None:
+            verdicts.append(ratchet)
         if record.get("refined_value"):
             verdicts.append(regress.evaluate(
                 f"{record['metric']}:refined", record["refined_value"],

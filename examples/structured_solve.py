@@ -1,0 +1,79 @@
+"""Structure-aware solve quickstart: detect, route, and demote safely.
+
+Run on any backend (CPU works):
+
+    JAX_PLATFORMS=cpu python examples/structured_solve.py
+
+Builds one system per structure class (SPD, tridiagonal, block-diagonal,
+dense), shows the detector's classification, and solves each through
+``solve_auto`` — the SPD system takes the half-price blocked Cholesky, the
+tridiagonal one the O(n) associative-scan Thomas engine, the block-diagonal
+one a single vmap-batched dispatch, and the dense one general LU. Then a
+LYING structure tag is forced through the fault-injection hook to show the
+recovery ladder demoting to general LU with a verified answer instead of
+shipping a wrong one. See docs/STRUCTURE.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()
+
+import numpy as np
+
+from gauss_tpu.io import synthetic
+from gauss_tpu.resilience import inject
+from gauss_tpu.structure import detect_structure, solve_auto
+from gauss_tpu.structure.detect import STRUCTURE_KINDS
+from gauss_tpu.verify import checks
+
+
+def main():
+    rng = np.random.default_rng(258458)
+    n = 64
+    systems = {
+        "spd": synthetic.spd_matrix(n),
+        "banded": synthetic.banded_matrix(n, 1),
+        "blockdiag": synthetic.blockdiag_matrix(n, 8),
+        "dense": synthetic.dense_matrix(n),
+    }
+
+    print("== detect -> route -> engine -> 1e-4 gate ==")
+    for name, a in systems.items():
+        b = rng.standard_normal(n)
+        info = detect_structure(a)
+        res = solve_auto(a, b, info=info)
+        rel = checks.residual_norm(a, res.x, b, relative=True)
+        print(f"  {name:9s} detected={info.kind:9s} "
+              f"bandwidth={info.bandwidth:2d} blocks={len(info.blocks):2d} "
+              f"-> engine={res.rung:9s} rel_residual={rel:.2e}")
+        assert rel <= 1e-4
+
+    print()
+    print("== a lying classifier cannot ship a wrong answer ==")
+    a = systems["dense"]          # NOT symmetric...
+    b = rng.standard_normal(n)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site="structure.detect", kind="mistag",
+        param=float(STRUCTURE_KINDS.index("spd")),  # ...but tagged SPD
+        max_triggers=1)])
+    with inject.plan(plan):
+        res = solve_auto(a, b)
+    rel = checks.residual_norm(a, res.x, b, relative=True)
+    print(f"  forced tag=spd on a non-symmetric matrix: Cholesky rejected "
+          f"it (typed NotSPDError),")
+    print(f"  ladder demoted to engine={res.rung} "
+          f"(rung {res.rung_index}), rel_residual={rel:.2e} — "
+          f"verified, not silently wrong")
+    assert res.recovered and rel <= 1e-4
+    print()
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,12 @@ de-duplicates that into one algorithmic core with pluggable execution backends:
                           checkpoint/resume for long factorizations, and the
                           chaos campaign runner (the reference aborts on a
                           bad pivot; this layer recovers or fails TYPED)
+- ``gauss_tpu.structure`` — structure-aware solves: SPD/banded/block-diagonal
+                          detection (straight off the .dat coordinate
+                          stream) + blocked Cholesky, scan-Thomas/band-LU,
+                          and vmap-batched block engines behind one
+                          ``solve_auto`` router with recovery-ladder
+                          demotion (the reference densifies everything)
 """
 
 __version__ = "0.1.0"

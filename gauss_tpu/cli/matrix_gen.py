@@ -1,8 +1,15 @@
-"""Matrix generator CLI: emits the synthetic matrix in .dat format to stdout.
+"""Matrix generator CLI: emits a synthetic matrix in .dat format to stdout.
 
 Reference surface (matrices_dense/matrix_gen.cc + Makefile): ``./matrix_gen <n>``.
 Dispatches to the native C++ tool when built (identical output); otherwise
 falls back to the Python writer.
+
+``--structure`` extends the reference surface with the structure classes the
+router (:mod:`gauss_tpu.structure`) recognizes — ``spd``, ``banded:<b>``,
+``blockdiag:<k>``, ``dense`` — in the SAME reference-compatible ``.dat``
+coordinate format (sparse classes drop exact zeros, which is exactly what a
+coordinate format is for), so datasets, serving loadgen mixes, and the
+chaos campaign can exercise the structured engines end to end.
 """
 
 from __future__ import annotations
@@ -14,11 +21,34 @@ import sys
 from gauss_tpu.io import datfile, synthetic
 
 
+def structured_matrix(n: int, structure: str):
+    """Build the matrix for a ``--structure`` spec; returns
+    ``(matrix, drop_zeros)``. Specs: ``spd``, ``banded:<b>`` (default b=1),
+    ``blockdiag:<k>`` (block size, default max(1, n // 8)), ``dense``."""
+    kind, _, arg = structure.partition(":")
+    if kind == "spd":
+        return synthetic.spd_matrix(n), False
+    if kind == "banded":
+        return synthetic.banded_matrix(n, int(arg) if arg else 1), True
+    if kind == "blockdiag":
+        block = int(arg) if arg else max(1, n // 8)
+        return synthetic.blockdiag_matrix(n, block), True
+    if kind == "dense":
+        return synthetic.dense_matrix(n), False
+    raise ValueError(
+        f"unknown --structure {structure!r}; options: spd, banded:<b>, "
+        f"blockdiag:<k>, dense")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="matrix_gen",
-        description="Emit the synthetic benchmark matrix in .dat coordinate format.")
+        description="Emit a synthetic benchmark matrix in .dat coordinate format.")
     p.add_argument("n", type=int, help="matrix dimension")
+    p.add_argument("--structure", default=None, metavar="SPEC",
+                   help="structured generation mode: spd | banded:<b> | "
+                        "blockdiag:<k> | dense (default: the reference "
+                        "matrix_gen.cc min-matrix)")
     p.add_argument("--python", action="store_true",
                    help="force the Python writer (skip the native tool)")
     p.add_argument("--metrics-out", metavar="PATH", default=None,
@@ -27,13 +57,24 @@ def main(argv=None) -> int:
     if args.n <= 0:
         print("matrix_gen: n must be positive", file=sys.stderr)
         return 1
+    if args.structure is not None:
+        try:
+            matrix, drop_zeros = structured_matrix(args.n, args.structure)
+        except ValueError as e:
+            print(f"matrix_gen: {e}", file=sys.stderr)
+            return 1
+    else:
+        matrix, drop_zeros = None, False
 
     from gauss_tpu import obs
 
     with obs.run(metrics_out=args.metrics_out, tool="matrix_gen") as rec:
-        obs.emit("config", tool="matrix_gen", n=args.n)
+        obs.emit("config", tool="matrix_gen", n=args.n,
+                 structure=args.structure)
         rc = None
-        if not args.python:
+        if not args.python and matrix is None:
+            # The native C++ tool only knows the reference min-matrix;
+            # structured modes always take the Python writer.
             try:
                 from gauss_tpu import native
 
@@ -44,10 +85,14 @@ def main(argv=None) -> int:
             except Exception:
                 rc = None  # fall back to Python below
         if rc is None:
-            # Values are small integers; .17g prints them exactly.
+            # Values are small integers or exact powers of rho; .17g
+            # prints them with an exact float64 round trip either way.
             with obs.span("generate_python"):
-                datfile.write_dat(sys.stdout,
-                                  synthetic.generator_matrix(args.n))
+                datfile.write_dat(
+                    sys.stdout,
+                    matrix if matrix is not None
+                    else synthetic.generator_matrix(args.n),
+                    drop_zeros=drop_zeros)
             rc = 0
     if args.metrics_out:
         print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}",

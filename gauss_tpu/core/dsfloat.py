@@ -261,25 +261,31 @@ def ds_residual(at: DS, x: DS, b: DS) -> DS:
     return ds_add(b, ds_neg(ax))
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3) -> DS:
+@partial(jax.jit, static_argnames=("iters", "solve_fn"))
+def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None) -> DS:
     """On-device iterative refinement with double-single residuals.
 
-    fac: a :class:`gauss_tpu.core.blocked.BlockedLU` of A (f32).
+    fac: a :class:`gauss_tpu.core.blocked.BlockedLU` of A (f32) — or any
+    factorization object ``solve_fn`` knows how to solve against.
     at:  A transposed, double-single (from :func:`to_ds` of the f64 matrix).
     b:   right-hand side, double-single.
     x0:  initial f32 solve ``lu_solve(fac, b.hi)``.
-    Each iteration: r = b - A x (double-single), d = lu_solve(fac, r.hi + r.lo
-    collapsed to f32 — the correction only needs f32 relative accuracy), and a
-    double-single solution update. The whole loop compiles into the caller's
-    program; nothing touches the host.
+    solve_fn: the correction solver ``(fac, r) -> d`` (static; default
+    ``blocked.lu_solve``). The structure engines thread their own — e.g.
+    ``structure.cholesky.cholesky_solve`` — so every factorization family
+    shares ONE double-single refinement implementation.
+    Each iteration: r = b - A x (double-single), d = solve_fn(fac, r.hi +
+    r.lo collapsed to f32 — the correction only needs f32 relative
+    accuracy), and a double-single solution update. The whole loop compiles
+    into the caller's program; nothing touches the host.
     """
-    from gauss_tpu.core.blocked import lu_solve
+    if solve_fn is None:
+        from gauss_tpu.core.blocked import lu_solve as solve_fn
 
     x = ds_from_f32(x0)
     for _ in range(iters):
         r = ds_residual(at, x, b)
-        d = lu_solve(fac, r.hi + r.lo)
+        d = solve_fn(fac, r.hi + r.lo)
         x = ds_add(x, ds_from_f32(d))
     return x
 

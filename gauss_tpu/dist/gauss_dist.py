@@ -210,10 +210,14 @@ def solve_dist_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     # Fleet hooks: heartbeat at the stage boundary, and — only when a
     # watchdog deadline is configured (a supervised worker) — a deadline
     # around the blocking collective program, so a dead peer becomes a
-    # typed WorkerLostError instead of an infinite block.
-    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist", n=n)
-    return _watchdog.guarded_device(lambda: solver(a_c, b_c),
-                                    site="dist.gauss_dist.solve")[:n]
+    # typed WorkerLostError instead of an infinite block. Guarded at
+    # solver-build time (one predicate), so the unsupervised hot path
+    # carries zero hook plumbing (ROADMAP perf item / ISSUE 6).
+    if _fleet.active() or _watchdog.enabled():
+        _fleet.beat(phase="dist_factor_solve", engine="gauss_dist", n=n)
+        return _watchdog.guarded_device(lambda: solver(a_c, b_c),
+                                        site="dist.gauss_dist.solve")[:n]
+    return solver(a_c, b_c)[:n]
 
 
 def gauss_solve_dist(a, b, mesh: jax.sharding.Mesh = None) -> jax.Array:

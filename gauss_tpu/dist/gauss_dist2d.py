@@ -195,10 +195,14 @@ def solve_dist2d_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
                                  n=n, npad=npad,
                                  mesh_shape=list(mesh.devices.shape))
     # Fleet hooks (see gauss_dist.solve_dist_staged): heartbeat + optional
-    # collective watchdog deadline for supervised workers.
-    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist2d", n=n)
-    x_cyc = _watchdog.guarded_device(lambda: solver(a_c, b_c),
-                                     site="dist.gauss_dist2d.solve")
+    # collective watchdog deadline for supervised workers; compiled out of
+    # the unsupervised path at solver-build time.
+    if _fleet.active() or _watchdog.enabled():
+        _fleet.beat(phase="dist_factor_solve", engine="gauss_dist2d", n=n)
+        x_cyc = _watchdog.guarded_device(lambda: solver(a_c, b_c),
+                                         site="dist.gauss_dist2d.solve")
+    else:
+        x_cyc = solver(a_c, b_c)
     # x_cyc[k] = x[cperm[k]]; undo (gather runs on the mesh's backend).
     inv = np.empty(npad, dtype=np.int64)
     inv[cperm] = np.arange(npad)

@@ -341,11 +341,19 @@ def solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh) -> jax.Array:
     # Fleet hooks: heartbeat at the stage boundary; supervised workers
     # additionally get a watchdog deadline so a peer hung inside the
     # per-panel psum/all_gather protocol surfaces as WorkerLostError.
-    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked", n=n)
+    # Guarded at solver-build time: the unsupervised path carries zero
+    # hook plumbing.
+    hooks = _fleet.active() or _watchdog.enabled()
+    if hooks:
+        _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked",
+                    n=n)
     with obs.span("dist_factor_solve", n=n, panel=panel):
-        x, *_ = _watchdog.guarded_device(
-            lambda: jax.block_until_ready(solver(a_c)),
-            site="dist.gauss_dist_blocked.solve")
+        if hooks:
+            x, *_ = _watchdog.guarded_device(
+                lambda: jax.block_until_ready(solver(a_c)),
+                site="dist.gauss_dist_blocked.solve")
+        else:
+            x, *_ = jax.block_until_ready(solver(a_c))
     return x[:n]
 
 
@@ -365,9 +373,13 @@ def factor_solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh):
     """Factor + solve a staged system; returns (x, DistBlockedLU)."""
     a_c, n, npad, panel = staged
     solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
-    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked", n=n)
-    x, a_fac, perm, min_piv = _watchdog.guarded_device(
-        lambda: solver(a_c), site="dist.gauss_dist_blocked.factor")
+    if _fleet.active() or _watchdog.enabled():
+        _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked",
+                    n=n)
+        x, a_fac, perm, min_piv = _watchdog.guarded_device(
+            lambda: solver(a_c), site="dist.gauss_dist_blocked.factor")
+    else:
+        x, a_fac, perm, min_piv = solver(a_c)
     return x[:n], DistBlockedLU(a_fac, perm, min_piv, n, npad, panel, mesh)
 
 

@@ -388,11 +388,15 @@ def factor_dist_blocked2d(staged, mesh: jax.sharding.Mesh) -> DistBlocked2DLU:
                                  nblocks=npad // panel,
                                  mesh_shape=list(mesh.devices.shape))
     # Fleet hooks (see gauss_dist.solve_dist_staged): heartbeat + optional
-    # collective watchdog deadline for supervised workers.
-    _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked2d",
-                n=n)
-    a_fac, perm, linvs, uinvs, min_piv = _watchdog.guarded_device(
-        lambda: fac_fn(a_c), site="dist.gauss_dist_blocked2d.factor")
+    # collective watchdog deadline for supervised workers; compiled out of
+    # the unsupervised path at solver-build time.
+    if _fleet.active() or _watchdog.enabled():
+        _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked2d",
+                    n=n)
+        a_fac, perm, linvs, uinvs, min_piv = _watchdog.guarded_device(
+            lambda: fac_fn(a_c), site="dist.gauss_dist_blocked2d.factor")
+    else:
+        a_fac, perm, linvs, uinvs, min_piv = fac_fn(a_c)
     return DistBlocked2DLU(a_fac, perm, linvs, uinvs, min_piv, n, npad,
                            panel, mesh)
 

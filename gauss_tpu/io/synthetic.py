@@ -61,3 +61,57 @@ def manufactured_rhs(a: np.ndarray, x_true: np.ndarray = None) -> np.ndarray:
     if x_true is None:
         x_true = manufactured_solution(a.shape[0])
     return a @ np.asarray(x_true, dtype=np.float64)
+
+
+# -- structured generators (gauss_tpu.structure) ---------------------------
+#
+# Deterministic matrices for each structure class the router recognizes, so
+# datasets, serving mixes, and the chaos campaign can exercise the
+# structured engines end to end. All values round-trip exactly through the
+# .dat writer's %.17g (matrix_gen CLI --structure).
+
+def spd_matrix(n: int, rho: float = 0.25, dtype=np.float64) -> np.ndarray:
+    """Symmetric positive-definite Kac-Murdock-Szego matrix
+    ``a_ij = rho^|i-j|``: SPD for |rho| < 1, and for rho <= 1/3 every
+    Gershgorin disc sits strictly in the positive half-line
+    (off-diagonal row sums < 2*rho/(1-rho) <= 1 = diagonal), so the
+    structure detector can CERTIFY it rather than guess."""
+    i = np.arange(n)
+    return (rho ** np.abs(np.subtract.outer(i, i))).astype(dtype)
+
+
+def banded_matrix(n: int, bandwidth: int = 1, dtype=np.float64) -> np.ndarray:
+    """Strictly diagonally dominant symmetric band: ``2*(b+1)`` on the
+    diagonal, ``-1`` within the band — the structured analog of the
+    internal benchmark matrix (tridiagonal at b=1)."""
+    a = np.zeros((n, n), dtype=dtype)
+    np.fill_diagonal(a, 2.0 * (bandwidth + 1))
+    for k in range(1, min(bandwidth, n - 1) + 1):
+        idx = np.arange(n - k)
+        a[idx, idx + k] = -1.0
+        a[idx + k, idx] = -1.0
+    return a
+
+
+def blockdiag_matrix(n: int, block: int = 32, dtype=np.float64) -> np.ndarray:
+    """Block-diagonal matrix of SPD "min matrix" blocks (the internal
+    benchmark formula per block, plus a per-block diagonal shift so blocks
+    differ); the last block is ragged when ``block`` does not divide n."""
+    a = np.zeros((n, n), dtype=dtype)
+    for c, s in enumerate(range(0, n, block)):
+        w = min(block, n - s)
+        i = np.arange(w)
+        blk = 2.0 * (np.minimum.outer(i, i) + 1) + np.eye(w) * (c % 7)
+        a[s:s + w, s:s + w] = blk
+    return a
+
+
+def dense_matrix(n: int, rho: float = 0.25, dtype=np.float64) -> np.ndarray:
+    """Deterministic NON-symmetric dense matrix (the general-LU class):
+    the KMS matrix with its upper triangle scaled 1.5x. Still strictly
+    diagonally dominant (off-diagonal row sums < 2.5*rho/(1-rho) < 1 for
+    rho = 0.25), hence invertible — but symmetric it is not, so the
+    detector must refuse the Cholesky route."""
+    a = spd_matrix(n, rho=rho, dtype=np.float64)
+    a += np.triu(0.5 * a, 1)
+    return a.astype(dtype)

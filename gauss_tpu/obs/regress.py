@@ -51,6 +51,19 @@ DEFAULT_BAND = 1.2        # slow-side relative tolerance vs the median
 EPOCH_DRIFT_CEILING = 1.5  # documented epoch envelope (BENCH_STABILITY.md)
 MIN_SAMPLES = 3
 
+#: Hard ratchet records: the best COMMITTED value per metric, gated by
+#: ``evaluate_ratchet`` (used by ``bench.py --regress``). Unlike the
+#: median baseline — which a few slow epochs can drag upward — a ratchet
+#: value only ever moves DOWN: update it when a round beats it, never
+#: because regressing became normal. The 1.476 ms n=2048 record is
+#: BENCH_r03 (round 3, ≈345x the reference CPU baseline).
+RATCHET_BASELINES = {"gauss_n2048_wallclock": 0.001476}
+#: A fresh headline worse than ratchet * this ceiling fails the gate even
+#: when the median band would wave it through (the ceiling reuses the
+#: documented epoch-drift envelope: beyond 1.5x the best-ever epoch, the
+#: slowdown cannot be tunnel noise).
+RATCHET_MAX_RATIO = EPOCH_DRIFT_CEILING
+
 
 def default_history_path() -> str:
     here = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -129,6 +142,20 @@ def ingest_file(path) -> List[Dict[str, Any]]:
 
         for metric, value, unit in fleet_hist(doc):
             rec = _record(metric, value, path, "fleet", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
+    if isinstance(doc, dict) and doc.get("kind") == "structured_solve":
+        # A structure-check summary (python -m gauss_tpu.structure.check
+        # --summary-json): per-class seconds-per-solve and FLOP ratio vs
+        # dense LU enter history, so a class silently demoting back to
+        # general LU gates exactly like a perf regression. Derivation
+        # lives with the checker (single source); lazy import keeps jax
+        # out of this module.
+        from gauss_tpu.structure.check import history_records as struct_hist
+
+        for metric, value, unit in struct_hist(doc):
+            rec = _record(metric, value, path, "structure", unit=unit)
             if rec:
                 records.append(rec)
         return records
@@ -269,6 +296,38 @@ def evaluate(metric: str, value: float, history: List[Dict[str, Any]],
                        note=f"{ratio:.2f}x median, beyond the "
                             f"{EPOCH_DRIFT_CEILING}x epoch-drift ceiling — "
                             f"likely a code regression")
+    return verdict
+
+
+def evaluate_ratchet(metric: str, value: float) -> Optional[Dict[str, Any]]:
+    """Classify a fresh measurement against the committed best-prior
+    ratchet (None when the metric has no ratchet record). The returned
+    verdict has the same shape :func:`evaluate` produces, so
+    :func:`format_verdicts` and gate loops consume both uniformly."""
+    best = RATCHET_BASELINES.get(metric)
+    if best is None:
+        return None
+    ratio = value / best if best > 0 else float("inf")
+    verdict: Dict[str, Any] = {
+        "metric": f"{metric}:vs_best", "value": value, "samples": 1,
+        "baseline": best, "threshold": round(best * RATCHET_MAX_RATIO, 9),
+        "rel_band": RATCHET_MAX_RATIO, "ratio": round(ratio, 3)}
+    if value <= best:
+        verdict.update(status="fast",
+                       note="at or below the committed best — ratchet the "
+                            "record down (update RATCHET_BASELINES)")
+    elif ratio <= RATCHET_MAX_RATIO:
+        verdict.update(status="ok",
+                       note=f"{ratio:.2f}x the committed best "
+                            f"({best:.6g} s), inside the "
+                            f"{RATCHET_MAX_RATIO}x ratchet ceiling")
+    else:
+        verdict.update(status="out-of-band",
+                       note=f"{ratio:.2f}x the committed best "
+                            f"({best:.6g} s) — past the "
+                            f"{RATCHET_MAX_RATIO}x ratchet ceiling; the "
+                            f"single-chip record only ratchets down "
+                            f"(ROADMAP perf item)")
     return verdict
 
 

@@ -296,6 +296,53 @@ def _resilience_lines(rs: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def structure_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the structure router's events (``structure`` detections /
+    routing tags, ``structure_solve`` engine outcomes) into per-structure
+    lanes: what was detected, what was routed, which engine actually
+    served, and how often a route demoted to general LU. Empty dict when
+    the run routed nothing."""
+    dets = [ev for ev in events if ev.get("type") == "structure"]
+    solves = [ev for ev in events if ev.get("type") == "structure_solve"]
+    if not (dets or solves):
+        return {}
+    detected: Dict[str, int] = {}
+    routed: Dict[str, int] = {}
+    for ev in dets:
+        d = str(ev.get("detected", "?"))
+        t = str(ev.get("tag", "?"))
+        detected[d] = detected.get(d, 0) + 1
+        routed[t] = routed.get(t, 0) + 1
+    engines: Dict[str, int] = {}
+    demotions = 0
+    rels: List[float] = []
+    for ev in solves:
+        eng = str(ev.get("engine", "?"))
+        engines[eng] = engines.get(eng, 0) + 1
+        if ev.get("demoted"):
+            demotions += 1
+        if isinstance(ev.get("rel_residual"), (int, float)):
+            rels.append(float(ev["rel_residual"]))
+    return {
+        "detected": detected, "routed": routed, "engines": engines,
+        "solves": len(solves), "demotions": demotions,
+        "worst_rel_residual": max(rels) if rels else None,
+    }
+
+
+def _structure_lines(st: Dict[str, Any]) -> List[str]:
+    lines = []
+    det = ", ".join(f"{k} x{v}" for k, v in sorted(st["detected"].items()))
+    lines.append(f"  detected: {det or '-'}")
+    eng = ", ".join(f"{k} x{v}" for k, v in sorted(st["engines"].items()))
+    lines.append(f"  lanes: {eng or '-'}  ({st['solves']} solve(s), "
+                 f"{st['demotions']} demotion(s) to general LU)")
+    if st["worst_rel_residual"] is not None:
+        lines.append(f"  worst rel residual: "
+                     f"{_fmt(st['worst_rel_residual'])}")
+    return lines
+
+
 def fleet_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the fleet supervisor's events (``fleet``: launch / worker_dead /
     worker_stalled / restart / shrink / local_finish / done, plus worker-side
@@ -406,6 +453,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "profile": flat_profile(evs),
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
         "serving": serving_summary(evs),
+        "structure": structure_summary(evs),
         "resilience": resilience_summary(evs),
         "fleet": fleet_summary(evs),
         "comms": comms_summary(evs),
@@ -458,6 +506,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("serving:")
         out.extend(_serving_lines(serving))
+
+    structure = structure_summary(evs)
+    if structure:
+        out.append("")
+        out.append("structure lanes:")
+        out.extend(_structure_lines(structure))
 
     resilience = resilience_summary(evs)
     if resilience:

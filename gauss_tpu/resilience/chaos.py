@@ -7,7 +7,7 @@ asserts the one invariant a solver service must never break:
     independently verifies at the relative-residual gate — or surfaced as a
     typed error. Never a silent wrong answer.**
 
-Four phases:
+Five phases:
 
 - **solver** (``--cases``): each case draws an engine (blocked / rank-1), a
   size, and a fault scenario from a seeded catalog — transient or
@@ -30,6 +30,11 @@ Four phases:
   checkpoint, and finish with a verified solution **bit-identical** to the
   unfaulted supervised run — or raise the typed ``FleetError``. Every wait
   is deadline-bounded: zero hangs, by construction.
+- **structure** (``--no-structure`` to skip): structured solves
+  (gauss_tpu.structure) under a LYING classifier — every engine x every
+  wrong tag, forced through the ``structure.detect`` mis-tag hook; the
+  router must demote down the recovery ladder to general LU and end with
+  an independently verified solution or a typed error.
 
 The summary (``--summary-json``) is regress-ingestable
 (``kind: chaos_campaign``): recovery depth (``mean_rung``), typed-error
@@ -307,6 +312,66 @@ def run_fleet_phase(seed: int, gate: float) -> Dict:
             "violations": violations}
 
 
+def run_structure_phase(seed: int, gate: float) -> Dict:
+    """Structured-solve chaos: force a WRONG structure tag (every engine x
+    every wrong tag, via the ``structure.detect`` mis-tag hook) and assert
+    the router's invariant — the recovery ladder demotes to general LU and
+    the result is independently verified at the gate, or the error is
+    typed. A lying classifier must never produce a silent wrong answer."""
+    from gauss_tpu import obs
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.resilience import inject, recover
+    from gauss_tpu.structure import STRUCTURE_KINDS, solve_auto
+    from gauss_tpu.verify import checks
+
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0x5717)))
+    n = 48
+    systems = {
+        "spd": synthetic.spd_matrix(n),
+        "banded": synthetic.banded_matrix(n, 1),
+        "blockdiag": synthetic.blockdiag_matrix(n, 8),
+        "dense": synthetic.dense_matrix(n),
+    }
+    cases: List[Dict] = []
+    injected = 0
+    with obs.span("chaos_structure_phase"):
+        for true_kind, a in systems.items():
+            b = rng.standard_normal(n)
+            for wrong_idx, wrong in enumerate(STRUCTURE_KINDS):
+                if wrong == true_kind:
+                    continue
+                case = {"true": true_kind, "forced": wrong}
+                plan = inject.FaultPlan([inject.FaultSpec(
+                    site="structure.detect", kind="mistag",
+                    param=float(wrong_idx), max_triggers=1)], seed=seed)
+                with inject.plan(plan) as ap:
+                    try:
+                        res = solve_auto(a, b, gate=gate)
+                        rel = checks.residual_norm(a, res.x, b,
+                                                   relative=True)
+                        if np.isfinite(rel) and rel <= gate:
+                            case.update(outcome=("demoted"
+                                                 if res.rung_index else "ok"),
+                                        engine=res.rung,
+                                        rel_residual=float(rel))
+                        else:
+                            case.update(outcome="silent_wrong",
+                                        engine=res.rung,
+                                        rel_residual=float(rel))
+                    except recover.UnrecoverableSolveError as e:
+                        case.update(outcome="typed_error", trigger=e.trigger)
+                    except Exception as e:  # noqa: BLE001 — untyped IS the bug
+                        case.update(outcome="violation",
+                                    error=f"{type(e).__name__}: {e}"[:200])
+                    injected += ap.stats()["triggered"]
+                cases.append(case)
+    violations = sum(1 for c in cases
+                     if c["outcome"] in ("silent_wrong", "violation"))
+    return {"ran": True, "cases": cases, "injected": injected,
+            "demotions": sum(1 for c in cases if c["outcome"] == "demoted"),
+            "violations": violations}
+
+
 def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
     """(metric, value, unit) records a campaign contributes to the
     regression history. All slow-side-gated: recovery regressing shows as a
@@ -355,6 +420,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-fleet", action="store_true",
                    help="skip the supervised-fleet kill/stall phase "
                         "(subprocess workers; the slowest phase)")
+    p.add_argument("--no-structure", action="store_true",
+                   help="skip the structured-solve mis-tag phase")
     p.add_argument("--tmpdir", default="/tmp",
                    help="where the checkpoint phase writes its files")
     p.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -399,6 +466,8 @@ def main(argv=None) -> int:
                 else run_checkpoint_phase(args.tmpdir))
         flt = ({} if args.no_fleet
                else run_fleet_phase(args.seed, args.gate))
+        struct = ({} if args.no_structure
+                  else run_structure_phase(args.seed, args.gate))
         wall = round(time.perf_counter() - t0, 3)
 
         violations = (solver["counts"]["silent_wrong"]
@@ -406,10 +475,12 @@ def main(argv=None) -> int:
                       + (serve.get("incorrect", 0) if serve else 0)
                       + (serve.get("unresolved", 0) if serve else 0)
                       + (0 if not ckpt or ckpt["bit_identical"] else 1)
-                      + (flt.get("violations", 0) if flt else 0))
+                      + (flt.get("violations", 0) if flt else 0)
+                      + (struct.get("violations", 0) if struct else 0))
         injected = (solver["injected"] + (serve.get("injected", 0))
                     + (ckpt.get("injected", 0) if ckpt else 0)
-                    + (flt.get("injected", 0) if flt else 0))
+                    + (flt.get("injected", 0) if flt else 0)
+                    + (struct.get("injected", 0) if struct else 0))
         sites = dict(solver["injected_by_site"])
         for k, v in (serve.get("injected_by_site") or {}).items():
             sites[k] = sites.get(k, 0) + v
@@ -419,12 +490,15 @@ def main(argv=None) -> int:
         if flt.get("injected"):
             sites["fleet.worker.group"] = (sites.get("fleet.worker.group", 0)
                                            + flt["injected"])
+        if struct.get("injected"):
+            sites["structure.detect"] = (sites.get("structure.detect", 0)
+                                         + struct["injected"])
         summary = {
             "kind": "chaos_campaign", "seed": args.seed,
             "engines": engines, "sizes": sizes, "gate": args.gate,
             "injected": injected, "injected_by_site": sites,
             "solver": solver, "serve": serve, "checkpoint": ckpt,
-            "fleet": flt,
+            "fleet": flt, "structure": struct,
             "wall_s": wall, "invariant_ok": violations == 0,
         }
         obs.emit("chaos_campaign",
@@ -455,6 +529,13 @@ def main(argv=None) -> int:
                      f"stalls={c.get('stalls')} "
                      f"bit_identical={c.get('bit_identical')}"
                      if "rung" in c else f" ({c.get('error', '')[:80]})"))
+    if struct:
+        by_outcome: Dict[str, int] = {}
+        for c in struct["cases"]:
+            by_outcome[c["outcome"]] = by_outcome.get(c["outcome"], 0) + 1
+        print(f"  structure: {len(struct['cases'])} mis-tag case(s) -> "
+              f"{by_outcome}, {struct['demotions']} demotion(s), "
+              f"{struct['violations']} violation(s)")
     print(f"  invariant {'HOLDS' if violations == 0 else 'VIOLATED'} "
           f"({wall} s)")
 

@@ -206,6 +206,13 @@ def lu_factor_blocked_chunked_checkpointed(
     :class:`CheckpointMismatchError`. On success the checkpoint is removed
     unless ``keep``.
 
+    ``path=None`` DISABLES checkpointing at trace time: the call delegates
+    to the fully-jitted one-program ``lu_factor_blocked_chunked`` — no
+    host-stepped group split, no per-group device sync, no hook polls —
+    so callers can thread one entry point and pay the checkpoint machinery
+    only when they actually configured a checkpoint (ROADMAP perf item:
+    hooks compiled out unless enabled).
+
     Returns a :class:`gauss_tpu.core.blocked.BlockedLU`.
     """
     import jax
@@ -213,6 +220,11 @@ def lu_factor_blocked_chunked_checkpointed(
 
     from gauss_tpu.core import blocked
 
+    if path is None:
+        return blocked.lu_factor_blocked_chunked(
+            jnp.asarray(a), panel=panel,
+            chunk=blocked.CHUNK_DEFAULT if chunk is None else chunk,
+            panel_impl=panel_impl, gemm_precision=gemm_precision)
     a = np.asarray(a)
     n = a.shape[0]
     if a.shape != (n, n):

@@ -84,6 +84,16 @@ class FleetError(RuntimeError):
 
 # -- lease heartbeats ------------------------------------------------------
 
+def active() -> bool:
+    """Is this process a supervised fleet worker (lease env configured)?
+    One environ lookup. The distributed engines consult this — together
+    with ``watchdog.enabled()`` — ONCE per staged solve, so the
+    heartbeat/watchdog hook plumbing is skipped entirely on the
+    unsupervised hot path (the hooks are guarded where the solver is
+    BUILT, not polled inside it)."""
+    return bool(os.environ.get(ENV_LEASE))
+
+
 def lease_path(jobdir, worker: int) -> str:
     return os.path.join(os.fspath(jobdir), "leases", f"w{worker}.json")
 

@@ -30,6 +30,11 @@ in docs/RESILIENCE.md):
                             between sharded-checkpoint groups (``skip``
                             picks the group) — gauss_tpu.resilience
                             .dcheckpoint
+    structure.detect        force the structure router's routing tag to
+                            ``STRUCTURE_KINDS[int(param)]`` (kind
+                            ``mistag``) — proves a lying classifier
+                            demotes to general LU instead of shipping a
+                            wrong answer — gauss_tpu.structure.router
 
 Design rules:
 
@@ -74,8 +79,10 @@ ENV_VAR = "GAUSS_FAULTS"
 
 #: kinds that corrupt an operand array
 CORRUPT_KINDS = ("nan", "inf", "bitflip", "near_zero_pivot")
-#: kinds with dedicated action helpers
-ACTION_KINDS = ("raise", "compile_fail", "delay", "kill", "stall")
+#: kinds with dedicated action helpers; ``mistag`` forces the structure
+#: router's routing tag to ``STRUCTURE_KINDS[int(param)]`` (see
+#: gauss_tpu.structure.router.routed_tag) — the lying-classifier fault.
+ACTION_KINDS = ("raise", "compile_fail", "delay", "kill", "stall", "mistag")
 KINDS = CORRUPT_KINDS + ACTION_KINDS
 
 #: exit status used by kind="kill" — distinctive, so a harness can tell an

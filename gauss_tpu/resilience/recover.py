@@ -170,13 +170,66 @@ def _rung_numpy(a64, b64, panel, iters):
     return np.linalg.solve(a64, b64), None
 
 
+def _rung_cholesky(a64, b64, panel, iters):
+    """SPD rung: blocked Cholesky + host-f64 refinement. A non-SPD operand
+    raises the typed NotSPDError, which the ladder records as
+    ``exception:NotSPDError`` and escalates past — the structured ->
+    general-LU demotion in action."""
+    from gauss_tpu.structure import cholesky
+
+    return cholesky.solve_spd_refined(a64, b64, panel=panel, iters=iters)
+
+
+def _rung_banded(a64, b64, panel, iters):
+    """Banded rung: O(n*b^2) band solve + refinement; a matrix whose true
+    bandwidth busts the band limit raises StructureMismatchError and the
+    ladder demotes."""
+    from gauss_tpu.structure import banded
+
+    return banded.solve_banded_refined(a64, b64, iters=iters), None
+
+
+def _rung_blockdiag(a64, b64, panel, iters):
+    """Block-diagonal rung: vmap-batched small-block solves; an
+    unpartitionable matrix raises StructureMismatchError and the ladder
+    demotes."""
+    from gauss_tpu.structure import blockdiag
+
+    return blockdiag.solve_blockdiag(a64, b64, refine_steps=iters), None
+
+
 _RUNG_FNS: Dict[str, Callable] = {
     "blocked": _rung_blocked,
     "pivot_safe": _rung_pivot_safe,
     "ds_refine": _rung_ds,
     "rank1": _rung_rank1,
     "numpy_f64": _rung_numpy,
+    "cholesky": _rung_cholesky,
+    "banded": _rung_banded,
+    "blockdiag": _rung_blockdiag,
 }
+
+#: ladder head per structure tag; every structured ladder then demotes
+#: "blocked" (general LU) -> pivot_safe -> ds_refine -> numpy_f64, so a
+#: MISCLASSIFIED matrix — wrong tag, near-SPD that fails the Cholesky
+#: attempt, permuted "block-diagonal" — still ends 1e-4-verified or typed,
+#: exactly like a corrupted dense solve.
+_STRUCTURE_HEADS: Dict[str, Tuple[str, ...]] = {
+    "spd": ("cholesky",),
+    "banded": ("banded",),
+    "blockdiag": ("blockdiag",),
+    "dense": (),
+}
+
+
+def structured_rungs(tag: str) -> Tuple[str, ...]:
+    """The escalation ladder for a structure tag: the structured engine
+    first, then the general-LU demotion rungs."""
+    if tag not in _STRUCTURE_HEADS:
+        raise ValueError(f"unknown structure tag {tag!r}; options: "
+                         f"{sorted(_STRUCTURE_HEADS)}")
+    return _STRUCTURE_HEADS[tag] + ("blocked", "pivot_safe", "ds_refine",
+                                    "numpy_f64")
 
 
 def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,

@@ -57,6 +57,11 @@ class ServeConfig:
     supervised_handoff: bool = False  # route oversized single-RHS solves
     #                                   through the fleet supervisor
     fleet_workers: int = 2          # world size for the supervised lane
+    structure_aware: bool = False   # detect/accept structure tags, batch by
+    #                                 (bucket, tag), and give Gershgorin-
+    #                                 certified SPD batches the half-price
+    #                                 Cholesky executable (see
+    #                                 gauss_tpu.structure)
 
 
 @dataclasses.dataclass
@@ -85,11 +90,18 @@ class ServeRequest:
     _ids_lock = threading.Lock()
 
     def __init__(self, a: np.ndarray, b: np.ndarray,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 structure: Optional[str] = None):
         with ServeRequest._ids_lock:
             self.id = next(ServeRequest._ids)
         self.a = np.asarray(a)
         self.b = np.asarray(b)
+        #: structure routing tag ("spd" / "banded" / "blockdiag" / "dense"),
+        #: None when the server is not structure-aware. Part of the batch
+        #: compatibility key AND the executable cache key: identity-
+        #: extension bucket padding preserves SPD and bandwidth (tested in
+        #: tests/test_structure.py), so a tag survives padding.
+        self.structure = structure
         self.n = self.a.shape[0]
         if self.a.shape != (self.n, self.n):
             raise ValueError(f"expected square matrix, got {self.a.shape}")

@@ -20,6 +20,11 @@ Workload mixes are comma-separated weighted tokens::
   as the external programs do (io.synthetic.manufactured_rhs).
 - ``dataset:<name>`` — an io.datasets stand-in by name (the committed
   deterministic doubles of the reference Harwell-Boeing set).
+- ``spd:<n>`` / ``banded:<n>/<b>`` / ``blockdiag:<n>/<k>`` — the
+  structured generators (io.synthetic.spd_matrix / banded_matrix /
+  blockdiag_matrix), so a mix can drive the structure-aware serving
+  lanes (``ServeConfig(structure_aware=True)``) and the chaos campaign
+  end to end; ``<b>``/``<k>`` default to 1 / n // 8.
 
 Two driving modes: **closed** loop (``concurrency`` clients, each submits,
 waits, repeats — throughput self-clocks to service capacity) and **open**
@@ -91,10 +96,15 @@ def parse_mix(mix: str) -> List[Tuple[WorkloadSpec, float]]:
         if ":" not in token:
             raise ValueError(f"workload token {token!r} needs kind:arg")
         kind, arg = token.split(":", 1)
-        if kind not in ("random", "internal", "dat", "dataset"):
+        if kind not in ("random", "internal", "dat", "dataset",
+                        "spd", "banded", "blockdiag"):
             raise ValueError(f"unknown workload kind {kind!r} in {token!r}")
-        if kind in ("random", "internal") and int(arg) < 1:
+        if kind in ("random", "internal", "spd") and int(arg) < 1:
             raise ValueError(f"bad size in workload token {token!r}")
+        if kind in ("banded", "blockdiag"):
+            n_part = arg.split("/", 1)[0]
+            if int(n_part) < 1:
+                raise ValueError(f"bad size in workload token {token!r}")
         out.append((WorkloadSpec(kind=kind, arg=arg), weight))
     if not out:
         raise ValueError(f"empty workload mix {mix!r}")
@@ -132,6 +142,20 @@ def materialize(spec: WorkloadSpec, rng: np.random.Generator, nrhs: int = 1,
             a = np.asarray(read_dat_dense(spec.arg), dtype=np.float64)
             with _dat_lock:
                 _dat_cache[spec.arg] = a
+    elif spec.kind in ("spd", "banded", "blockdiag"):
+        from gauss_tpu.io import synthetic
+
+        if spec.kind == "spd":
+            a = synthetic.spd_matrix(int(spec.arg))
+        elif spec.kind == "banded":
+            n_s, _, b_s = spec.arg.partition("/")
+            a = synthetic.banded_matrix(int(n_s),
+                                        int(b_s) if b_s else 1)
+        else:
+            n_s, _, k_s = spec.arg.partition("/")
+            n_i = int(n_s)
+            a = synthetic.blockdiag_matrix(
+                n_i, int(k_s) if k_s else max(1, n_i // 8))
     elif spec.kind == "dataset":
         with _dat_lock:
             a = _dat_cache.get("dataset:" + spec.arg)

@@ -158,14 +158,27 @@ class SolverServer:
         return round(min(60.0, max(0.01, self.config.max_batch / rate)), 4)
 
     def submit(self, a, b, deadline_s: Optional[float] = None,
-               ) -> ServeRequest:
+               structure: Optional[str] = None) -> ServeRequest:
         """Enqueue one system. Returns the request handle immediately; a
         queue-full rejection resolves the handle synchronously with
         ``retry_after_s`` set (the client never blocks to learn it was
-        refused)."""
+        refused).
+
+        ``structure``: an optional routing tag (``gauss_tpu.structure``
+        kinds). With ``config.structure_aware`` an untagged request is
+        classified here (one O(n^2) scan against an O(n^3) solve); the tag
+        keys batching and the executable cache, and certified-SPD batches
+        take the Cholesky lane. Without ``structure_aware`` the tag is
+        ignored — the pre-existing single-lane behavior."""
         if deadline_s is None:
             deadline_s = self.config.deadline_default_s
-        req = ServeRequest(a, b, deadline_s=deadline_s)
+        if self.config.structure_aware and structure is None:
+            from gauss_tpu.structure import structure_tag
+
+            structure = structure_tag(a)
+        if not self.config.structure_aware:
+            structure = None
+        req = ServeRequest(a, b, deadline_s=deadline_s, structure=structure)
         # Admission is ONE critical section: the closed/full check and the
         # enqueue happen under the lock stop() closes admission under, so a
         # request is either enqueued strictly before the close (stop's
@@ -230,8 +243,10 @@ class SolverServer:
                                     if self._drain_rate else inst)
 
     def _drain_same_bucket(self, first: ServeRequest):
-        """Collect queued requests that share ``first``'s size bucket, up to
-        max_batch, optionally lingering for late same-bucket arrivals.
+        """Collect queued requests that share ``first``'s size bucket — and,
+        in structure-aware mode, its structure tag (an SPD batch must stay
+        all-SPD to take the Cholesky executable) — up to max_batch,
+        optionally lingering for late same-bucket arrivals.
         Different-bucket requests go straight back on the queue (order among
         survivors is preserved by the FIFO)."""
         want = buckets.bucket_for(first.n, self.ladder)
@@ -248,7 +263,8 @@ class SolverServer:
             if nxt is None:
                 continue
             if (nxt.n <= self.ladder[-1]
-                    and buckets.bucket_for(nxt.n, self.ladder) == want):
+                    and buckets.bucket_for(nxt.n, self.ladder) == want
+                    and nxt.structure == first.structure):
                 got.append(nxt)
             else:
                 requeue.append(nxt)
@@ -294,7 +310,8 @@ class SolverServer:
         bb = buckets.pow2_bucket(len(reqs), cap=cfg.max_batch)
         key = CacheKey(bucket_n=bucket_n, nrhs=nrhs, batch=bb,
                        dtype="float32", engine=cfg.engine,
-                       refine_steps=cfg.refine_steps, mesh=None)
+                       refine_steps=cfg.refine_steps, mesh=None,
+                       structure=reqs[0].structure)
 
         if not self.health.device_allowed():
             obs.counter("serve.fallback_batches")
@@ -364,7 +381,9 @@ class SolverServer:
         obs.histogram("serve.batch_occupancy", occupancy)
         obs.emit("serve_batch", bucket_n=bucket_n, nrhs=nrhs,
                  batch=len(reqs), batch_bucket=bb, occupancy=occupancy,
-                 seconds=round(batch_s, 6))
+                 seconds=round(batch_s, 6),
+                 **({"structure": reqs[0].structure}
+                    if reqs[0].structure else {}))
         for i, req in enumerate(reqs):
             xi = buckets.unpad_solution(x[i], req.n, req.k, req.was_vector)
             self._finish(req, xi, lane="batched", bucket_n=bucket_n)

@@ -1,0 +1,53 @@
+"""Structure-aware solves: detection + specialized engines + one router.
+
+The reference's L0 data layer is a *sparse-coordinate* format
+(``matrix_gen.cc`` emits ``row col value`` triples), yet every engine in the
+stack — like the reference's own 12 programs — densifies and runs general
+O(n^3) elimination regardless of what the matrix actually is. This package
+closes that gap (ROADMAP [scenarios]):
+
+- ``detect``    — a cheap structure classifier (:class:`StructureInfo`):
+                  symmetry, SPD-likelihood (Gershgorin), bandwidth,
+                  contiguous block-diagonal partition, density — computed
+                  for free from the ``.dat`` coordinate stream or from one
+                  O(n^2) scan of an in-memory array.
+- ``cholesky``  — blocked right-looking Cholesky (panel factor + SYRK
+                  trailing update) on the core.blocked panel machinery:
+                  ~2x fewer FLOPs than LU for SPD systems, no pivot
+                  gathers, typed :class:`NotSPDError` on failure.
+- ``banded``    — tridiagonal (``lax.associative_scan`` Thomas) and small-b
+                  blocked band LU engines whose cost scales with n*b^2,
+                  not n^3.
+- ``blockdiag`` — vmap-batched small-block solves through the serving
+                  layer's executable cache (one device dispatch for the
+                  whole partition).
+- ``router``    — :func:`solve_auto`: detect -> route -> engine -> the same
+                  1e-4 verify gate as dense LU, with misclassification
+                  demoting down the resilience recovery ladder to general
+                  LU (verified solution or typed error, never a silent
+                  wrong answer).
+
+Importing this package is numpy-cheap; the engines import jax lazily.
+"""
+
+from gauss_tpu.structure.detect import (  # noqa: F401
+    StructureInfo,
+    StructureMismatchError,
+    STRUCTURE_KINDS,
+    detect_structure,
+    detect_structure_coords,
+    detect_structure_dat,
+    structure_tag,
+)
+from gauss_tpu.structure.router import solve_auto  # noqa: F401
+
+__all__ = [
+    "StructureInfo",
+    "StructureMismatchError",
+    "STRUCTURE_KINDS",
+    "detect_structure",
+    "detect_structure_coords",
+    "detect_structure_dat",
+    "structure_tag",
+    "solve_auto",
+]

@@ -1,0 +1,314 @@
+"""Blocked right-looking Cholesky — the SPD half-price factorization.
+
+An SPD system solved by general LU pays for pivoting it provably does not
+need (Cholesky is unconditionally stable on SPD input) and for a full
+L *and* U it could have gotten as L and L^T. This engine is the blocked
+factorization :mod:`gauss_tpu.core.blocked` runs for LU, restructured for
+symmetry:
+
+- per panel: one small dense ``lax.linalg.cholesky`` of the diagonal block
+  (the panel factor), one GEMM ``L21 = A21 @ L11^-T`` (against the stored
+  explicit inverse — the same TRTRI+GEMM move the LU path uses), and one
+  SYRK-shaped trailing update ``A22 -= L21 @ L21^T`` on the MXU;
+- no pivot contest, no per-panel whole-matrix permutation gather (the
+  single largest non-GEMM cost of the LU loop), no U12 triangular solve;
+- identity padding to a panel multiple — an identity extension of an SPD
+  matrix is SPD, so the padded factorization is well-posed (the same
+  argument :func:`core.blocked._pad_to_panel` makes for LU, without
+  needing the pivoting half of it).
+
+Two trace forms mirror the LU policy: a flat ``fori_loop`` with masked
+full-size updates (flat compile payload — the CPU/large-n form) and a
+trace-time unrolled form whose trailing block genuinely shrinks (true
+n^3/3 FLOPs — the TPU form up to ``UNROLL_MAX_N``), resolved by
+:func:`resolve_chol_factor`.
+
+Failure is TYPED: a non-SPD operand surfaces inside the factorization as a
+non-positive (or NaN) diagonal of some ``L11``; the host entry points check
+``min_diag`` once and raise :class:`NotSPDError` — the router's signal to
+demote to general LU. Inside jit nothing raises (the NaN-as-0 fold makes
+``min_diag`` the witness), same contract as ``BlockedLU.min_abs_pivot``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+
+class NotSPDError(RuntimeError):
+    """The Cholesky factorization found a non-positive pivot: the matrix is
+    not positive definite (or not symmetric enough to pretend). ``min_diag``
+    carries the witness value (0.0 stands in for NaN)."""
+
+    def __init__(self, message: str, min_diag: float = 0.0):
+        super().__init__(message)
+        self.min_diag = min_diag
+
+
+class BlockedCholesky(NamedTuple):
+    """A = L @ L^T factorization state (identity-padded to a panel multiple).
+
+    m:    (npad, npad); L on and below the diagonal. Entries above the
+          diagonal are untouched input (never read by the solve — the
+          blockwise substitution's zero-meets argument masks them for free).
+    linv: (nb, panel, panel) explicit inverses of the diagonal L blocks, so
+          both substitution sweeps run as GEMM chains (cf. BlockedLU.linv).
+    min_diag: min over the diagonal of L; <= 0 means not SPD (NaN folds
+          to 0 so the witness is always comparable).
+    """
+
+    m: object
+    linv: object
+    min_diag: object
+
+
+def _chol_panel(d, panel: int, dtype):
+    """Factor one (panel, panel) diagonal block: L11, its inverse, and the
+    block's min diagonal (NaN -> 0). Single source for both trace forms."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    l11 = lax.linalg.cholesky(d)
+    dg = jnp.diagonal(l11)
+    dg = jnp.where(jnp.isnan(dg), jnp.zeros((), dtype), dg)
+    mind = jnp.min(dg)
+    # A non-SPD block yields NaNs; zero them so min_diag stays the single
+    # witness and downstream GEMMs cannot spray NaN past the typed check.
+    l11 = jnp.where(jnp.isnan(l11), jnp.zeros((), dtype), l11)
+    linv = lax.linalg.triangular_solve(
+        l11 + jnp.eye(panel, dtype=dtype) * (mind <= 0).astype(dtype),
+        jnp.eye(panel, dtype=dtype), left_side=True, lower=True)
+    return l11, linv, mind
+
+
+def cholesky_factor_blocked(a, panel: int | None = None,
+                            gemm_precision: str = "highest"):
+    """Flat-fori blocked Cholesky (jitted; masked full-size updates).
+
+    Returns a :class:`BlockedCholesky`; never raises on non-SPD input —
+    check ``min_diag`` (the host entries :func:`cholesky_factor` /
+    :func:`solve_spd_refined` do, and raise :class:`NotSPDError`).
+    """
+    return _cholesky_factor_fori(a, panel=panel,
+                                 gemm_precision=gemm_precision)
+
+
+def _factor_impl(a, panel, gemm_precision, unrolled: bool):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.kernels.matmul_pallas import resolve_precision
+
+    prec = resolve_precision(gemm_precision)
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = blocked._resolve_panel(n, panel, itemsize)
+    m = blocked._pad_to_panel(a, panel)
+    npad = m.shape[0]
+    nb = npad // panel
+    dtype = m.dtype
+
+    if unrolled:
+        min_diag = jnp.asarray(jnp.inf, dtype)
+        linvs = []
+        for kb in range(0, npad, panel):
+            d = m[kb:kb + panel, kb:kb + panel]
+            l11, linv, mind = _chol_panel(d, panel, dtype)
+            min_diag = jnp.minimum(min_diag, mind)
+            linvs.append(linv)
+            m = m.at[kb:kb + panel, kb:kb + panel].set(l11)
+            if kb + panel < npad:
+                a21 = m[kb + panel:, kb:kb + panel]
+                l21 = jnp.dot(a21, linv.T, precision=prec)
+                m = m.at[kb + panel:, kb:kb + panel].set(l21)
+                trail = m[kb + panel:, kb + panel:]
+                m = m.at[kb + panel:, kb + panel:].set(
+                    trail - jnp.dot(l21, l21.T, precision=prec))
+        return BlockedCholesky(m=m, linv=jnp.stack(linvs), min_diag=min_diag)
+
+    rows = jnp.arange(npad)
+
+    def outer(k, carry):
+        m, min_diag, linvs = carry
+        kb = k * panel
+        d = lax.dynamic_slice(m, (kb, kb), (panel, panel))
+        l11, linv, mind = _chol_panel(d, panel, dtype)
+        min_diag = jnp.minimum(min_diag, mind)
+        # L21 = A21 @ L11^-T, masked to the rows below the panel; the
+        # masked operand makes the SYRK update self-masking (the outer
+        # product is zero outside the trailing block).
+        colblk = lax.dynamic_slice(m, (0, kb), (npad, panel))
+        below = (rows >= kb + panel)[:, None]
+        l21 = jnp.dot(jnp.where(below, colblk, jnp.zeros((), dtype)),
+                      linv.T, precision=prec)
+        in_panel = ((rows >= kb) & (rows < kb + panel))[:, None]
+        l11_full = jnp.zeros((npad, panel), dtype)
+        l11_full = lax.dynamic_update_slice(l11_full, l11, (kb, 0))
+        colblk = jnp.where(in_panel, l11_full,
+                           jnp.where(below, l21, colblk))
+        m = lax.dynamic_update_slice(m, colblk, (0, kb))
+        m = m - jnp.dot(l21, l21.T, precision=prec)
+        # The panel's own rows/cols met a zero operand above, so only the
+        # trailing block actually changed — restore nothing.
+        linvs = lax.dynamic_update_slice(linvs, linv[None], (k, 0, 0))
+        return m, min_diag, linvs
+
+    m, min_diag, linvs = lax.fori_loop(
+        0, nb, outer, (m, jnp.asarray(jnp.inf, dtype),
+                       jnp.zeros((nb, panel, panel), dtype)))
+    return BlockedCholesky(m=m, linv=linvs, min_diag=min_diag)
+
+
+_JITTED = {}
+
+
+def _get_jitted(unrolled: bool):
+    """jit lazily so importing this module never imports jax."""
+    fn = _JITTED.get(unrolled)
+    if fn is None:
+        import jax
+
+        fn = jax.jit(partial(_factor_impl, unrolled=unrolled),
+                     static_argnames=("panel", "gemm_precision"))
+        _JITTED[unrolled] = fn
+    return fn
+
+
+def _cholesky_factor_fori(a, panel=None, gemm_precision="highest"):
+    return _get_jitted(False)(a, panel=panel, gemm_precision=gemm_precision)
+
+
+def cholesky_factor_blocked_unrolled(a, panel: int | None = None,
+                                     gemm_precision: str = "highest"):
+    """Trace-time unrolled blocked Cholesky: the trailing block genuinely
+    shrinks (true n^3/3 FLOPs, no masks) at the cost of one traced GEMM
+    shape per panel — the same trade as ``lu_factor_blocked_unrolled``."""
+    return _get_jitted(True)(a, panel=panel, gemm_precision=gemm_precision)
+
+
+def resolve_chol_factor(n: int, unroll="auto"):
+    """Factor-form policy, mirroring :func:`core.blocked.resolve_factor`:
+    unrolled on TPU up to the LU unroll ceiling (true triangular work),
+    flat fori everywhere else (flat compile payload)."""
+    import jax
+
+    from gauss_tpu.core import blocked
+
+    if unroll == "auto":
+        if (jax.default_backend() == "tpu"
+                and n <= blocked.UNROLL_MAX_N):
+            return cholesky_factor_blocked_unrolled
+        return cholesky_factor_blocked
+    if isinstance(unroll, str):
+        raise ValueError(f"unknown unroll {unroll!r}; options: "
+                         "(True, False, 'auto')")
+    return (cholesky_factor_blocked_unrolled if unroll
+            else cholesky_factor_blocked)
+
+
+def cholesky_factor(a, panel: int | None = None, unroll="auto",
+                    gemm_precision: str = "highest") -> BlockedCholesky:
+    """Host entry: factor and CHECK — raises :class:`NotSPDError` when the
+    factorization's min diagonal is not strictly positive."""
+    fac = resolve_chol_factor(np.shape(a)[0], unroll)(
+        a, panel=panel, gemm_precision=gemm_precision)
+    mind = float(np.asarray(fac.min_diag))
+    if not mind > 0.0:
+        raise NotSPDError(
+            f"matrix is not positive definite (Cholesky min diagonal "
+            f"{mind:g}); route to general LU", min_diag=mind)
+    return fac
+
+
+def cholesky_solve(fac: BlockedCholesky, b):
+    """Solve A x = b given A = L L^T: forward then transposed substitution,
+    both blockwise through the stored diagonal-block inverses — the
+    LU path's scan form (`core.blocked._blockwise_substitution_scan`)
+    reused verbatim: the backward sweep is the forward machinery run on
+    ``m.T`` with the transposed inverses (L^T's stale lower triangle meets
+    the still-zero solution region, the same zero-meets argument)."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    m = fac.m
+    npad = m.shape[0]
+    nb, panel, _ = fac.linv.shape
+    b = jnp.asarray(b, dtype=m.dtype)
+    was_vector = b.ndim == 1
+    b2 = b[:, None] if was_vector else b
+    if b2.ndim != 2:
+        raise ValueError(f"b must be (n,) or (n, k), got {b.shape}")
+    n, k = b2.shape
+    bp = jnp.zeros((npad, k), dtype=m.dtype).at[:n].set(b2)
+    linv_t = jnp.swapaxes(fac.linv, 1, 2)
+    y = blocked._blockwise_substitution_scan(m, fac.linv, bp, lower=True)
+    x = blocked._blockwise_substitution_scan(m.T, linv_t, y, lower=False)
+    x = x[:n]
+    return x[:, 0] if was_vector else x
+
+
+def solve_spd(a, b, panel: int | None = None, unroll="auto"):
+    """One f32-native factor + solve (no refinement); raises
+    :class:`NotSPDError` on non-SPD input. The structured sibling of
+    ``gauss_solve_blocked``."""
+    fac = cholesky_factor(a, panel=panel, unroll=unroll)
+    return cholesky_solve(fac, b)
+
+
+def solve_spd_refined(a, b, panel: int | None = None, iters: int = 2,
+                      dtype=None, unroll="auto", tol: float = 0.0):
+    """Mixed-precision SPD solve: f32 blocked Cholesky + host-f64 iterative
+    refinement — the product path, mirroring ``blocked.solve_refined``
+    contract for contract (x float64, ``(x, factors)`` return, ``tol``
+    early-exit). Raises :class:`NotSPDError` before any refinement work
+    when the factorization rejects the operand."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    a64 = np.asarray(a, dtype=np.float64)
+    b64 = np.asarray(b, dtype=np.float64)
+    fac = cholesky_factor(jnp.asarray(a64, dtype), panel=panel,
+                          unroll=unroll)
+    x = np.asarray(cholesky_solve(fac, jnp.asarray(b64, dtype)),
+                   dtype=np.float64)
+    tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
+            break
+        d = np.asarray(cholesky_solve(fac, jnp.asarray(r, dtype)),
+                       dtype=np.float64)
+        x = x + d
+    return x, fac
+
+
+def solve_spd_ds(a, b, iters: int | None = None, panel: int | None = None,
+                 unroll="auto"):
+    """Fully on-device SPD solve: f32 Cholesky + double-single refinement
+    (``core.dsfloat.refine_ds`` with this engine's solve threaded in) —
+    residuals never leave the device, the device-span timing form.
+    Returns ``(x float64, factors)``; raises :class:`NotSPDError`."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import dsfloat
+
+    if iters is None:
+        iters = dsfloat.DS_REFINE_STEPS
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    fac = cholesky_factor(jnp.asarray(a64, jnp.float32), panel=panel,
+                          unroll=unroll)
+    b_ds = dsfloat.to_ds(b64)
+    x0 = cholesky_solve(fac, b_ds.hi)
+    x = dsfloat.refine_ds(fac, dsfloat.to_ds(a64.T), b_ds, x0, iters=iters,
+                          solve_fn=cholesky_solve)
+    return dsfloat.ds_to_f64(x), fac

@@ -1,0 +1,120 @@
+"""solve_auto: detect -> route -> structured engine -> the same 1e-4 gate.
+
+One entry point turns the structure subsystem into a solver: classify the
+operand (:mod:`gauss_tpu.structure.detect`), pick the engine for its class,
+and run it through :func:`gauss_tpu.resilience.recover.solve_resilient`
+with the structured ladder (:func:`recover.structured_rungs`) — the
+structured engine is just rung 0, and everything below it is the SAME
+general-LU demotion chain every dense solve already has. The consequences
+fall out instead of being re-implemented:
+
+- every structured result passes the identical 1e-4 relative-residual gate
+  as dense LU (the ladder's gate IS ``verify.checks.residual_norm``);
+- a misclassified matrix — wrong tag, symmetric-but-indefinite, permuted
+  "block-diagonal" — fails its rung with a TYPED error or a residual miss
+  and demotes to general LU, ending verified or typed, never silently
+  wrong, never hung;
+- every escalation is an obs ``recovery`` event, and the routing decision
+  itself is an obs ``structure`` event, so the summarizer reports
+  per-structure lanes from the same stream as everything else.
+
+Hook point ``structure.detect`` (gauss_tpu.resilience.inject, kind
+``mistag``): forces the routing tag to ``STRUCTURE_KINDS[int(param)]`` —
+the chaos campaign's way of proving, on demand, that a lying classifier
+cannot produce a wrong answer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
+from gauss_tpu.resilience import recover
+from gauss_tpu.structure.detect import (
+    STRUCTURE_KINDS,
+    StructureInfo,
+    detect_structure,
+)
+
+#: which ladder rung counts as "the structured engine" per tag (anything
+#: else that serves the solution means the route DEMOTED)
+ENGINE_FOR_TAG = {"spd": "cholesky", "banded": "banded",
+                  "blockdiag": "blockdiag", "dense": "blocked"}
+
+
+def routed_tag(info: StructureInfo,
+               structure: Optional[str] = None) -> str:
+    """The tag :func:`solve_auto` will route on: the caller's override,
+    else the detected class — then through the ``structure.detect``
+    mis-tag hook (fault injection) when a plan is installed."""
+    tag = structure if structure is not None else info.kind
+    if tag not in STRUCTURE_KINDS:
+        raise ValueError(f"unknown structure tag {tag!r}; options: "
+                         f"{STRUCTURE_KINDS}")
+    if _inject.enabled():
+        sp = _inject.poll("structure.detect")
+        if sp is not None and sp.kind == "mistag":
+            tag = STRUCTURE_KINDS[int(sp.param) % len(STRUCTURE_KINDS)]
+    return tag
+
+
+def solve_auto(a, b, *, structure: Optional[str] = None,
+               info: Optional[StructureInfo] = None,
+               gate: float = recover.DEFAULT_GATE,
+               panel: Optional[int] = None,
+               refine_iters: int = 2) -> recover.ResilientResult:
+    """Structure-routed solve of ``a @ x = b``.
+
+    Returns the ladder's :class:`gauss_tpu.resilience.recover.
+    ResilientResult` — ``.x`` float64 at the original shape, ``.rung`` the
+    engine that actually served (``cholesky`` / ``banded`` / ``blockdiag``
+    / ``blocked`` / deeper), ``.rung_index > 0`` meaning the route demoted.
+    Raises :class:`recover.UnrecoverableSolveError` only when every rung —
+    structured AND general — failed; ``ValueError`` for malformed requests.
+
+    ``structure`` overrides detection (a serving layer that already knows
+    its tag skips the scan); ``info`` supplies a precomputed
+    :class:`StructureInfo` (e.g. from the ``.dat`` coordinate stream).
+    An honest rung-0 solve is bit-identical to calling that engine
+    directly — routing adds classification, not arithmetic.
+    """
+    a64 = np.asarray(a, dtype=np.float64)
+    b64 = np.asarray(b, dtype=np.float64)
+    n = a64.shape[0]
+    if a64.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a64.shape}")
+    if b64.shape[:1] != (n,) or b64.ndim > 2:
+        raise ValueError(f"b must be (n,) or (n, k) with n={n}, "
+                         f"got {b64.shape}")
+    if n == 0:
+        # The empty system: one valid solution, nothing to verify.
+        return recover.ResilientResult(
+            x=np.zeros_like(b64), rung="empty", rung_index=0, attempts=0,
+            rel_residual=0.0, escalations=[])
+    if info is None:
+        info = detect_structure(a64)
+    tag = routed_tag(info, structure)
+    obs.emit("structure", n=n, detected=info.kind, tag=tag,
+             symmetric=info.symmetric, spd_likely=info.spd_likely,
+             bandwidth=info.bandwidth, blocks=len(info.blocks),
+             density=round(info.density, 6))
+    if n == 1:
+        # Trivial 1x1: the host rung alone (a zero "matrix" is typed by
+        # the ladder, not a crash).
+        res = recover.solve_resilient(a64, b64, gate=gate,
+                                      rungs=("numpy_f64",))
+    else:
+        res = recover.solve_resilient(
+            a64, b64, gate=gate, panel=panel, refine_iters=refine_iters,
+            rungs=recover.structured_rungs(tag))
+    demoted = res.rung != ENGINE_FOR_TAG.get(tag, res.rung) and n > 1
+    obs.counter("structure.solves")
+    if demoted:
+        obs.counter("structure.demotions")
+    obs.emit("structure_solve", n=n, tag=tag, engine=res.rung,
+             demoted=demoted, rung_index=res.rung_index,
+             rel_residual=res.rel_residual)
+    return res

@@ -46,3 +46,12 @@ def test_resilient_solve_runs():
     assert "typed UnrecoverableSolveError" in r.stdout
     assert "killed mid-factorization" in r.stdout
     assert "bit-identical to uninterrupted: True" in r.stdout
+
+
+def test_structured_solve_runs():
+    r = _run(["examples/structured_solve.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "engine=cholesky" in r.stdout
+    assert "engine=banded" in r.stdout
+    assert "engine=blockdiag" in r.stdout
+    assert "verified, not silently wrong" in r.stdout

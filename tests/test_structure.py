@@ -1,0 +1,385 @@
+"""gauss_tpu.structure: detector edge cases, engines, router, serving lanes.
+
+The detector tests pin the ISSUE's edge-case list: near-SPD non-symmetric
+input must NOT certify, a bandwidth-n matrix degenerates to dense, a
+PERMUTED block-diagonal matrix must not be detected (falls back to dense
+LU), empty/1x1 systems are handled, and ``solve_auto`` is bit-identical to
+the direct engine on every structure class.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from gauss_tpu.io import synthetic
+from gauss_tpu.structure import (
+    StructureMismatchError,
+    banded,
+    blockdiag,
+    cholesky,
+    detect_structure,
+    detect_structure_dat,
+    solve_auto,
+    structure_tag,
+)
+from gauss_tpu.verify import checks
+
+GATE = 1e-4
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+# ---------------------------------------------------------------- detector
+
+def test_detect_spd_certified():
+    info = detect_structure(synthetic.spd_matrix(64))
+    assert info.kind == "spd"
+    assert info.symmetric and info.spd_likely
+    assert len(info.blocks) == 1
+    assert info.density == 1.0
+
+
+def test_detect_near_spd_nonsymmetric_is_dense():
+    a = synthetic.spd_matrix(48)
+    a[0, 1] += 1e-9  # near-SPD, but not symmetric — must NOT certify
+    info = detect_structure(a)
+    assert not info.symmetric and not info.spd_likely
+    assert info.kind == "dense"
+
+
+def test_detect_banded_and_bandwidth_n_degenerates_dense():
+    tri = synthetic.banded_matrix(64, 1)
+    info = detect_structure(tri)
+    assert info.kind == "banded" and info.bandwidth == 1
+    # bandwidth ~n: structurally a band, but past the engine's advantage —
+    # classifies dense (here: non-symmetric so not spd either)
+    wide = synthetic.dense_matrix(64)
+    info_w = detect_structure(wide)
+    assert info_w.bandwidth == 63
+    assert info_w.kind == "dense"
+
+
+def test_detect_blockdiag_contiguous_only():
+    a = synthetic.blockdiag_matrix(64, 8)
+    info = detect_structure(a)
+    assert info.kind == "blockdiag"
+    assert info.blocks == (8,) * 8
+    # a PERMUTED block-diagonal matrix must not be detected: the
+    # contiguous partition is gone, and the router falls back to dense LU
+    p = _rng(1).permutation(64)
+    info_p = detect_structure(a[np.ix_(p, p)])
+    assert info_p.kind == "dense"
+    assert len(info_p.blocks) == 1
+
+
+def test_detect_trivial_systems():
+    assert detect_structure(np.zeros((0, 0))).kind == "dense"
+    assert detect_structure(np.array([[3.0]])).kind == "dense"
+    diag = detect_structure(np.diag(np.arange(1.0, 9.0)))
+    assert diag.bandwidth == 0 and len(diag.blocks) == 8
+
+
+def test_detect_dat_stream_matches_dense_scan():
+    from gauss_tpu.io import datfile
+
+    for a in (synthetic.spd_matrix(24), synthetic.banded_matrix(24, 2),
+              synthetic.blockdiag_matrix(24, 6), synthetic.dense_matrix(24)):
+        buf = io.StringIO()
+        datfile.write_dat(buf, a, drop_zeros=True)
+        buf.seek(0)
+        assert detect_structure_dat(buf) == detect_structure(a)
+
+
+# ----------------------------------------------------------------- engines
+
+def test_cholesky_solves_and_types_non_spd():
+    import jax.numpy as jnp
+
+    a = synthetic.spd_matrix(48)
+    b = _rng(2).standard_normal(48)
+    x, fac = cholesky.solve_spd_refined(a, b)
+    assert checks.residual_norm(a, x, b, relative=True) <= GATE
+    assert float(np.asarray(fac.min_diag)) > 0
+    # symmetric but indefinite: typed NotSPDError, never NaN out
+    indef = a - 2.0 * np.eye(48)
+    with pytest.raises(cholesky.NotSPDError):
+        cholesky.cholesky_factor(jnp.asarray(indef, jnp.float32))
+
+
+def test_cholesky_multi_rhs_and_ds():
+    a = synthetic.spd_matrix(32)
+    b = _rng(3).standard_normal((32, 3))
+    x, _ = cholesky.solve_spd_refined(a, b)
+    assert x.shape == (32, 3)
+    assert checks.residual_norm(a, x, b, relative=True) <= GATE
+    xd, _ = cholesky.solve_spd_ds(a, b[:, 0], iters=3)
+    assert checks.residual_norm(a, xd, b[:, 0], relative=True) <= GATE
+
+
+def test_banded_tridiag_scan_large():
+    n = 2048
+    a = synthetic.banded_matrix(n, 1)
+    b = _rng(4).standard_normal(n)
+    x = banded.solve_banded_refined(a, b, bandwidth=1, iters=2)
+    assert checks.residual_norm(a, x, b, relative=True) <= GATE
+
+
+def test_banded_block_lu_and_mismatch():
+    a = synthetic.banded_matrix(96, 3)
+    b = _rng(5).standard_normal(96)
+    x = banded.solve_banded_refined(a, b, iters=2)
+    assert checks.residual_norm(a, x, b, relative=True) <= GATE
+    # a full matrix busts the band limit: typed, not slow-and-wrong
+    with pytest.raises(StructureMismatchError):
+        banded.solve_banded(synthetic.dense_matrix(32), b[:32])
+    # a lied-about bandwidth is typed too
+    with pytest.raises(StructureMismatchError):
+        banded.solve_banded(a, b, bandwidth=1)
+
+
+def test_blockdiag_one_dispatch_and_mismatch():
+    from gauss_tpu.structure.blockdiag import _exe_cache
+
+    a = synthetic.blockdiag_matrix(64 * 32, 32)  # the acceptance shape
+    b = _rng(6).standard_normal(64 * 32)
+    before = _exe_cache().misses + _exe_cache().hits
+    x = blockdiag.solve_blockdiag(a, b)
+    after = _exe_cache().misses + _exe_cache().hits
+    assert after - before == 1  # 64 uniform blocks -> ONE vmap dispatch
+    assert checks.residual_norm(a, x, b, relative=True) <= GATE
+    with pytest.raises(StructureMismatchError):
+        blockdiag.solve_blockdiag(synthetic.dense_matrix(32), b[:32])
+    with pytest.raises(StructureMismatchError):
+        # boundary that cuts through a block is a lie -> typed
+        blockdiag.solve_blockdiag(a, b, blocks=(16,) + (32,) * 63 + (16,))
+
+
+# ------------------------------------------------------------------ router
+
+def test_solve_auto_bit_identical_to_direct_engines():
+    rng = _rng(7)
+    n = 48
+    b = rng.standard_normal(n)
+
+    a = synthetic.spd_matrix(n)
+    res = solve_auto(a, b)
+    assert res.rung == "cholesky" and not res.recovered
+    direct, _ = cholesky.solve_spd_refined(a, b, panel=None, iters=2)
+    np.testing.assert_array_equal(res.x, direct)
+
+    a = synthetic.banded_matrix(n, 1)
+    res = solve_auto(a, b)
+    assert res.rung == "banded" and not res.recovered
+    np.testing.assert_array_equal(
+        res.x, banded.solve_banded_refined(a, b, iters=2))
+
+    a = synthetic.blockdiag_matrix(n, 8)
+    res = solve_auto(a, b)
+    assert res.rung == "blockdiag" and not res.recovered
+    np.testing.assert_array_equal(
+        res.x, blockdiag.solve_blockdiag(a, b, refine_steps=2))
+
+    from gauss_tpu.core import blocked
+
+    a = synthetic.dense_matrix(n)
+    res = solve_auto(a, b)
+    assert res.rung == "blocked" and not res.recovered
+    np.testing.assert_array_equal(
+        res.x, blocked.solve_refined(a, b, iters=2)[0])
+
+
+def test_solve_auto_trivial_and_errors():
+    assert solve_auto(np.zeros((0, 0)), np.zeros(0)).x.shape == (0,)
+    res = solve_auto(np.array([[4.0]]), np.array([2.0]))
+    np.testing.assert_allclose(res.x, [0.5])
+    with pytest.raises(ValueError):
+        solve_auto(np.zeros((2, 3)), np.zeros(2))
+    with pytest.raises(ValueError):
+        solve_auto(np.eye(2), np.zeros(2), structure="wavelet")
+
+
+def test_solve_auto_mistag_demotes_verified():
+    """A forced wrong structure tag on every engine ends in a demotion to
+    general LU with a verified solution or a typed error (the chaos
+    structure phase runs the full matrix; this pins one pair per engine)."""
+    from gauss_tpu.resilience import inject
+    from gauss_tpu.structure.detect import STRUCTURE_KINDS
+
+    rng = _rng(8)
+    n = 48
+    b = rng.standard_normal(n)
+    # (true system, forced tag) chosen so the forced engine must FAIL
+    cases = [
+        (synthetic.dense_matrix(n), "spd"),        # not symmetric
+        (synthetic.spd_matrix(n), "banded"),       # bandwidth too large
+        (synthetic.banded_matrix(n, 1), "blockdiag"),  # one block only
+    ]
+    for a, wrong in cases:
+        plan = inject.FaultPlan([inject.FaultSpec(
+            site="structure.detect", kind="mistag",
+            param=float(STRUCTURE_KINDS.index(wrong)), max_triggers=1)])
+        with inject.plan(plan):
+            res = solve_auto(a, b)
+        assert res.recovered, (wrong, res.rung)
+        assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+
+
+def test_bucket_padding_preserves_structure():
+    """Identity-extension bucket padding preserves SPD, bandwidth, and the
+    block partition — the property that makes structure tags valid cache-
+    key components in the serving layer."""
+    from gauss_tpu.serve import buckets
+
+    spd = synthetic.spd_matrix(24)
+    ap, _ = buckets.pad_system(spd, np.zeros(24), 32)
+    info = detect_structure(ap)
+    assert info.spd_likely and info.symmetric
+
+    tri = synthetic.banded_matrix(24, 1)
+    ap, _ = buckets.pad_system(tri, np.zeros(24), 32)
+    assert detect_structure(ap).bandwidth == 1
+
+    bd = synthetic.blockdiag_matrix(24, 6)
+    ap, _ = buckets.pad_system(bd, np.zeros(24), 32)
+    assert detect_structure(ap).blocks[:4] == (6, 6, 6, 6)
+
+
+# ----------------------------------------------------------------- serving
+
+def test_serve_structure_aware_lanes():
+    from gauss_tpu.serve import ServeConfig, SolverServer
+
+    cfg = ServeConfig(ladder=(32, 64), max_batch=4, panel=16,
+                      refine_steps=1, verify_gate=GATE,
+                      structure_aware=True)
+    rng = _rng(9)
+    with SolverServer(cfg) as srv:
+        handles = []
+        for i in range(9):
+            a = (synthetic.spd_matrix(24) if i % 3 == 0 else
+                 synthetic.dense_matrix(24) if i % 3 == 1 else
+                 synthetic.banded_matrix(40, 1))
+            b = rng.standard_normal(a.shape[0])
+            handles.append((a, b, srv.submit(a, b)))
+        for a, b, h in handles:
+            res = h.result(timeout=120)
+            assert res.status == "ok", (res.status, res.error)
+            assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+        tags = {k.structure for k in srv.cache.keys()}
+    assert "spd" in tags and "dense" in tags and "banded" in tags
+
+
+def test_serve_structure_unaware_unchanged():
+    from gauss_tpu.serve import ServeConfig, SolverServer
+
+    cfg = ServeConfig(ladder=(32,), max_batch=2, panel=16,
+                      verify_gate=GATE)
+    with SolverServer(cfg) as srv:
+        res = srv.solve(synthetic.spd_matrix(16),
+                        _rng(10).standard_normal(16))
+        assert res.ok
+        assert all(k.structure is None for k in srv.cache.keys())
+
+
+def test_loadgen_structured_tokens():
+    from gauss_tpu.serve import loadgen
+
+    specs = loadgen.parse_mix("spd:24,banded:32/1,blockdiag:24/6*2")
+    assert [s.kind for s, _ in specs] == ["spd", "banded", "blockdiag"]
+    rng = _rng(11)
+    for spec, _ in specs:
+        a, b = loadgen.materialize(spec, rng)
+        assert a.shape[0] == b.shape[0]
+    assert structure_tag(loadgen.materialize(specs[0][0], rng)[0]) == "spd"
+    with pytest.raises(ValueError):
+        loadgen.parse_mix("spd:0")
+
+
+# ------------------------------------------------- satellites: perf + gate
+
+def test_checkpointed_path_none_is_fully_jitted_parity():
+    """path=None compiles the one-program chunked factorization (no
+    host-stepped group split) and is bit-identical to it."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.resilience import checkpoint as ckpt
+
+    rng = _rng(12)
+    n = 64
+    a = (rng.standard_normal((n, n)) + np.diag([float(n)] * n)).astype(
+        np.float32)
+    f1 = ckpt.lu_factor_blocked_chunked_checkpointed(a, None, panel=16,
+                                                     chunk=2)
+    f2 = blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=16,
+                                           chunk=2)
+    for fld in ("m", "perm", "min_abs_pivot", "linv", "uinv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f1, fld)),
+                                      np.asarray(getattr(f2, fld)))
+
+
+def test_regress_ratchet_gate():
+    from gauss_tpu.obs import regress
+
+    best = regress.RATCHET_BASELINES["gauss_n2048_wallclock"]
+    ok = regress.evaluate_ratchet("gauss_n2048_wallclock", best * 1.2)
+    assert ok["status"] == "ok"
+    fast = regress.evaluate_ratchet("gauss_n2048_wallclock", best * 0.9)
+    assert fast["status"] == "fast"
+    bad = regress.evaluate_ratchet("gauss_n2048_wallclock",
+                                   best * (regress.RATCHET_MAX_RATIO + 0.1))
+    assert bad["status"] == "out-of-band"
+    assert regress.evaluate_ratchet("no_such_metric", 1.0) is None
+
+
+def test_structure_check_cli_smoke(tmp_path):
+    from gauss_tpu.structure import check as scheck
+
+    summary_path = tmp_path / "summary.json"
+    rc = scheck.main(["--spd-n", "32", "--banded-n", "64", "--banded-bw",
+                      "1", "--blockdiag-n", "32", "--block", "8",
+                      "--dense-n", "32", "--repeats", "1",
+                      "--summary-json", str(summary_path)])
+    assert rc == 0
+    import json
+
+    summary = json.loads(summary_path.read_text())
+    assert summary["kind"] == "structured_solve" and summary["ok"]
+    assert set(summary["classes"]) == {"spd", "banded", "blockdiag",
+                                       "dense"}
+    assert summary["classes"]["spd"]["engine"] == "cholesky"
+    # and the regress sentinel can ingest it
+    from gauss_tpu.obs import regress
+
+    recs = regress.ingest_file(summary_path)
+    assert any(r["metric"] == "structure:spd/flops_ratio" for r in recs)
+
+
+def test_chaos_structure_phase():
+    from gauss_tpu.resilience.chaos import run_structure_phase
+
+    out = run_structure_phase(seed=2584580, gate=GATE)
+    assert out["violations"] == 0
+    assert out["injected"] == len(out["cases"]) == 12
+    assert out["demotions"] >= 4  # every truly-wrong engine demoted
+
+
+def test_summarize_structure_section(tmp_path):
+    from gauss_tpu import obs
+    from gauss_tpu.obs import registry, summarize
+
+    out = tmp_path / "structure.jsonl"
+    with obs.run(metrics_out=str(out)) as rec:
+        solve_auto(synthetic.spd_matrix(24), np.ones(24))
+    events = registry.read_events(str(out))
+    st = summarize.structure_summary(events)
+    assert st["detected"] == {"spd": 1}
+    assert st["engines"] == {"cholesky": 1}
+    assert st["demotions"] == 0
+    text = summarize.summarize_run(events, rec.run_id)
+    assert "structure lanes:" in text
+    payload = summarize.run_summary(events, rec.run_id)
+    assert payload["structure"]["solves"] == 1
