@@ -7,9 +7,10 @@ OBS_SMOKE ?= /tmp/gauss_obs_check.jsonl
 SERVE_SMOKE ?= /tmp/gauss_serve_check
 FAULTS_SMOKE ?= /tmp/gauss_faults_check
 STRUCT_SMOKE ?= /tmp/gauss_structure_check
+TUNE_SMOKE ?= /tmp/gauss_tune_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
-	structure-check clean
+	structure-check tune-check clean
 
 all: native
 
@@ -108,6 +109,28 @@ structure-check:
 	st=[r['structure'] for r in runs.values() if r.get('structure')]; \
 	assert st and st[0]['solves'] >= 4 and st[0]['demotions'] == 0, st; \
 	print('structure-check: structure summary ok:', st[0]['engines'])"
+
+# The autotuner gate (CI-callable): micro-sweep (2 points per axis)
+# through the real gauss-tune runner -> store written -> the tuned solve
+# must consult the store (obs events), verify at 1e-4, and factor
+# bit-identically to the explicit winning config -> serve warmup must pick
+# up the tuned panel with an UNCHANGED cache key -> a second process
+# sharing the persistent XLA compile cache must perform STRICTLY FEWER
+# backend compiles than the first (obs xla.cache_miss accounting; exit 2
+# on any assertion failure), gated against the regression history (exit 1
+# when the sweep's winner or win-ratio leaves the band), then the recorded
+# stream is asserted to carry a tuning summary with store consults.
+tune-check:
+	rm -rf $(TUNE_SMOKE) && mkdir -p $(TUNE_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.tune.check --n 96 \
+	  --seed 258458 --tmpdir $(TUNE_SMOKE) \
+	  --metrics-out $(TUNE_SMOKE)/tune.jsonl \
+	  --summary-json $(TUNE_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(TUNE_SMOKE)/tune.jsonl --json \
+	  | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	tn=[r['tuning'] for r in runs.values() if r.get('tuning')]; \
+	assert tn and tn[0]['store']['hits'] >= 1 and tn[0]['sweep']['points'] >= 1, tn; \
+	print('tune-check: tuning summary ok:', tn[0]['store'])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

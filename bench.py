@@ -57,18 +57,20 @@ def best_prior_headline() -> float | None:
     return best
 
 
-def main(metrics_out: str | None = None) -> dict:
+def main(metrics_out: str | None = None, tuned: bool = False,
+         tune_compare: bool = False) -> dict:
     from gauss_tpu import obs
 
     with obs.run(metrics_out=metrics_out, tool="bench", n=N) as rec:
-        return _bench(rec)
+        return _bench(rec, tuned=tuned, tune_compare=tune_compare)
 
 
-def _bench(rec) -> None:
+def _bench(rec, tuned: bool = False, tune_compare: bool = False) -> None:
     import jax.numpy as jnp
 
     from gauss_tpu import obs
     from gauss_tpu.io import synthetic
+    from gauss_tpu.tune import apply as tune_apply
     from gauss_tpu.utils.profiling import PhaseTimer
     from gauss_tpu.verify import checks
 
@@ -80,10 +82,31 @@ def _bench(rec) -> None:
         b = jnp.asarray(b64, jnp.float32)
     # panel=256 beats 128 since the transposed panel kernel (2 full-tile
     # passes/step): fewer XLA glue steps now outweigh the extra VPU work.
-    panel = 256
+    # This is the headline's SEED config; --tuned swaps in the offline
+    # sweep's winner for this hardware when a store exists (gauss_tpu.tune)
+    # and --tune-compare measures both side by side.
+    seed_panel = 256
+    tuned_panel = tune_apply.override("lu_factor", N, "panel")
+    tuned_panel = int(tuned_panel) if tuned_panel else None
+    panel = (tuned_panel if (tuned or tune_compare) and tuned_panel
+             else seed_panel)
 
     with pt.phase("headline_slope"):
         per_solve, k_small, k_large, is_slope = _measure_slope(a, b, panel)
+    compare = None
+    if tune_compare:
+        if tuned_panel is None:
+            compare = {"note": "no tuned store on disk — run gauss-tune "
+                               "first; headline measured at the seed "
+                               "config only"}
+        else:
+            with pt.phase("seed_slope"):
+                seed_s, _, _, _ = _measure_slope(a, b, seed_panel)
+            compare = {"seed_params": {"panel": seed_panel},
+                       "seed_s": round(seed_s, 6),
+                       "best_params": {"panel": tuned_panel},
+                       "best_s": round(per_solve, 6),
+                       "improvement": round(seed_s / per_solve, 4)}
     best_prior = best_prior_headline()
 
     # Correctness gate on EXACTLY the timed configuration (one f32 blocked
@@ -154,9 +177,29 @@ def _bench(rec) -> None:
         "regression_vs_best": (round(per_solve / best_prior, 3)
                                if best_prior else None),
         "best_prior_s": best_prior,
+        "panel": panel,
+        "tune_source": ("store" if panel == tuned_panel and tuned_panel
+                        else "seed"),
     }
+    if compare is not None:
+        record["tune_compare"] = compare
     print(json.dumps(record))
     return record
+
+
+def tune_sweep_doc(record: dict) -> dict | None:
+    """The regress-ingestable ``kind: tune_sweep`` doc from a
+    --tune-compare run's record (None when the compare had no store)."""
+    compare = record.get("tune_compare")
+    if not compare or "best_s" not in compare:
+        return None
+    point = {"op": "gauss_headline", "n": N, "n_bucket": N,
+             "dtype": "float32", "engine": "blocked",
+             "key": f"gauss_headline/n{N}/float32/blocked",
+             "candidates": 2, "pruned": 0, **compare}
+    return {"kind": "tune_sweep", "ops": ["gauss_headline"], "ns": [N],
+            "dtype": "float32", "engine": "blocked",
+            "run_id": record.get("run_id"), "points": [point]}
 
 
 if __name__ == "__main__":
@@ -168,6 +211,16 @@ if __name__ == "__main__":
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="append the run's telemetry (phase spans, health, "
                          "run id) as JSONL to PATH")
+    ap.add_argument("--tuned", action="store_true",
+                    help="measure the headline at the tuned store's "
+                         "winning config for this hardware (gauss-tune) "
+                         "instead of the hand-picked seed; no store -> "
+                         "seed config, unchanged")
+    ap.add_argument("--tune-compare", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="measure tuned AND seed configs side by side; "
+                         "optionally write the regress-ingestable "
+                         "kind=tune_sweep summary to PATH")
     ap.add_argument("--regress", action="store_true",
                     help="after the run, gate the fresh headline against "
                          "reports/history.jsonl (obs.regress median + "
@@ -176,14 +229,33 @@ if __name__ == "__main__":
                     help="history file for --regress (default: the "
                          "committed reports/history.jsonl)")
     cli = ap.parse_args()
+    kwargs = dict(metrics_out=cli.metrics_out, tuned=cli.tuned,
+                  tune_compare=cli.tune_compare is not None)
     try:
-        record = main(metrics_out=cli.metrics_out)
+        record = main(**kwargs)
     except Exception:
         # Transient tunnel/device failures have been observed; one retry
         # protects the driver's single once-per-round invocation.
         traceback.print_exc(file=sys.stderr)
         print("bench: transient failure, retrying once", file=sys.stderr)
-        record = main(metrics_out=cli.metrics_out)
+        record = main(**kwargs)
+    if cli.tune_compare is not None:
+        doc = tune_sweep_doc(record)
+        if doc is None:
+            print("bench: --tune-compare had no tuned store to compare "
+                  "against (run gauss-tune first)", file=sys.stderr)
+        else:
+            point = doc["points"][0]
+            print(f"bench: tune-compare seed {point['seed_params']} "
+                  f"{point['seed_s']:.6f} s vs tuned "
+                  f"{point['best_params']} {point['best_s']:.6f} s "
+                  f"({point['improvement']:.2f}x)", file=sys.stderr)
+            if cli.tune_compare:
+                with open(cli.tune_compare, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"bench: tune-compare summary -> {cli.tune_compare}",
+                      file=sys.stderr)
     if cli.regress:
         from gauss_tpu.obs import regress
 

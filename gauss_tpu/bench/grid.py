@@ -759,6 +759,13 @@ def main(argv=None) -> int:
     p.add_argument("--regress-history", metavar="PATH", default=None,
                    help="history file for --regress-check (default: the "
                         "committed reports/history.jsonl)")
+    p.add_argument("--tuned", action="store_true",
+                   help="report the tuned-store resolution for this sweep "
+                        "and stamp tune provenance into the JSON cells. "
+                        "The device cells' auto config ALWAYS consults the "
+                        "store when one exists (gauss_tpu.tune) — this "
+                        "flag makes which config actually ran visible in "
+                        "the artifacts")
     p.add_argument("--dist-device", choices=("cpu", "default"),
                    default="cpu",
                    help="gauss-dist mesh devices: 'cpu' = the forced "
@@ -802,6 +809,15 @@ def main(argv=None) -> int:
         if bad or not raw:
             p.error(f"--thread-sweep must be positive integers, got {bad or args.thread_sweep!r}")
         sweep = [int(x) for x in raw]
+    tune_status = None
+    if args.tuned:
+        from gauss_tpu.tune import apply as tune_apply
+
+        tune_status = tune_apply.store_status()
+        state = (f"usable, {tune_status['configs']} config(s)"
+                 if tune_status["usable"] else tune_status["reason"])
+        print(f"bench-grid: tuned store {tune_status['path']}: {state}",
+              file=sys.stderr)
     all_cells: List[Cell] = []
     with obs.run(metrics_out=args.metrics_out, tool="bench_grid") as rec:
         rc = _run_suites(p, args, suites, backends, sweep, all_cells)
@@ -816,7 +832,9 @@ def main(argv=None) -> int:
         # Every cell carries the sweep's telemetry run id, so a table row
         # links back to its full event stream in --metrics-out.
         payload = [dict(asdict(c), speedup=c.speedup, run_id=rec.run_id,
-                        error=c.error if np.isfinite(c.error) else None)
+                        error=c.error if np.isfinite(c.error) else None,
+                        **({"tune_store": tune_status}
+                           if tune_status is not None else {}))
                    for c in all_cells]
         with open(args.json_path, "w") as f:
             json.dump(payload, f, indent=1)

@@ -34,9 +34,13 @@ import numpy as np
 from jax import lax
 
 from gauss_tpu.resilience import inject as _inject
+from gauss_tpu.tune import space as _tspace
 
 DEFAULT_PANEL = 128  # one MXU tile wide; also the f32 lane count
-CHUNK_DEFAULT = 4    # panels per chunked group (sweep at n=8192: 4 < 2 < 8 < 16)
+# Panels per chunked group. The VALUE lives in tune.space (the autotuner's
+# seed default — single source, so tuner output and this code default
+# cannot drift); re-exported here under its historical name.
+CHUNK_DEFAULT = _tspace.CHUNK_SEED
 GROUP_UPDATE_STRIP = 2048  # rows per deferred-trailing-GEMM strip: bounds
 # the chunked form's group-end transients to O(strip * n) so the route
 # reaches the HBM ceiling (the unstripped form OOMed at n=32768)
@@ -63,7 +67,7 @@ GROUP_UPDATE_UNSTRIPPED_MAX_BYTES = 16 * 20480 * 20480  # ~6.7 GB: up to
 # 64 -> ~34.7k — in-kernel pivoting covers the single-chip HBM ceiling
 # (~34k), where the kernel measures 1.9-3.3x faster than the stock-JAX
 # panel it previously handed tall groups to (VERDICT r4 next #5).
-PANEL_VMEM_BUDGET = 15_500_000
+PANEL_VMEM_BUDGET = _tspace.PANEL_VMEM_BUDGET_SEED  # tune.space seed
 PANEL_VMEM_ROW_OVERHEAD = {64: 190, 128: 220, 256: 220}
 
 # The aliasing holds only when the kernel operand stays a standalone
@@ -91,15 +95,23 @@ def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
     # measured overhead; BELOW it the per-row overhead grows ~1/panel
     # (round-4 data), so narrow widths extrapolate conservatively instead of
     # false-approving a launch that dies with a raw Mosaic error (ADVICE r5).
+    # The narrow-width floor formula is single-sourced in tune.space.
     overhead = PANEL_VMEM_ROW_OVERHEAD.get(
-        panel, 220 if panel >= 64 else max(220, 55_000 // panel))
+        panel, 220 if panel >= 64 else _tspace.narrow_panel_overhead(panel))
     est = npad * (panel * itemsize + overhead)
-    fits = est <= PANEL_VMEM_BUDGET
+    # A tuned store can recalibrate the scoped budget per hardware epoch
+    # (v5p's usable scoped VMEM differs from the v5e-measured seed); the
+    # module global stays the seed so tests can monkeypatch it.
+    from gauss_tpu.tune import apply as _tune
+
+    budget = int(_tune.override("panel_kernel", n, "vmem_budget")
+                 or PANEL_VMEM_BUDGET)
+    fits = est <= budget
     from gauss_tpu.obs import compile as _obs_compile
 
     _obs_compile.record_vmem_estimate(
         "panel_kernel", n=n, panel=panel, itemsize=itemsize, bytes=est,
-        budget=PANEL_VMEM_BUDGET, fits=fits)
+        budget=budget, fits=fits)
     return fits
 
 
@@ -114,7 +126,17 @@ def auto_panel(n: int, itemsize: int = 4) -> int:
     all-in-kernel panel-64 route 0.79 vs 1.02 s (the narrower kernel's
     extra serial steps cost more than the few stock-JAX panels save).
     Every factorization entry point resolves panel=None through this.
+
+    A tuned store (gauss_tpu.tune) SHORT-CIRCUITS the heuristic: when an
+    offline sweep on this hardware recorded a winning panel width for this
+    n-bucket, that width wins — the rules below are the seed policy the
+    sweep measures against. Zero behavior change when no store exists.
     """
+    from gauss_tpu.tune import apply as _tune
+
+    tuned = _tune.override("lu_factor", n, "panel")
+    if tuned:
+        return int(tuned)
     if n < 1024:
         return DEFAULT_PANEL  # crossover heuristic; VMEM is never binding
     if panel_fits_vmem(n, 256, itemsize):
@@ -1089,14 +1111,22 @@ def resolve_factor(n: int, unroll):
     0.59 s chunked-8, memplus (17758) 1.91 s flat vs 0.82 s chunked-8.
     The flat fori_loop remains the route past chunk-16's reach and on CPU
     (compile time matters more than FLOPs there). True/False force
-    unrolled/fori; "chunked" forces the middle."""
+    unrolled/fori; "chunked" forces the middle.
+
+    A tuned store (gauss_tpu.tune) overrides the CHUNK starting point per
+    n-bucket — the escalation cap still applies on top (a tuned chunk can
+    never produce a group count the tunneled compiler is known to choke
+    on); panel tuning rides through auto_panel."""
     if unroll == "auto":
         if jax.default_backend() != "tpu":
             return lu_factor_blocked
         if n > UNROLL_MAX_N:
+            from gauss_tpu.tune import apply as _tune
+
             panel = auto_panel(n)
             nb = -(-n // panel)
-            chunk = CHUNK_DEFAULT
+            chunk = int(_tune.override("lu_factor", n, "chunk")
+                        or CHUNK_DEFAULT)
             while -(-nb // chunk) > MAX_CHUNK_GROUPS and chunk < MAX_CHUNK:
                 chunk *= 2
             if -(-nb // chunk) > MAX_CHUNK_GROUPS:

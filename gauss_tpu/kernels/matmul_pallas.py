@@ -153,13 +153,27 @@ def matmul_pallas(a: jax.Array, b: jax.Array, *, bm: int | None = None,
     m, k = a.shape
     _, n = b.shape
     # Explicit tiles are honored verbatim (a tile sweep must measure the
-    # config it names); dims left at their None defaults still route
-    # through the VMEM clamp — f32 defaults pass through at
-    # (512, 512, 1024), wider dtypes shrink (ADVICE r4).
+    # config it names); dims left at their None defaults resolve from the
+    # tuned store when one exists (keyed by the problem's largest extent),
+    # else the tune.space seed (512, 512, 1024), and still route through
+    # the VMEM clamp — f32 seeds pass through, wider dtypes shrink
+    # (ADVICE r4).
     frozen = (bm is not None, bn is not None, bk is not None)
-    bm_ = min(bm or 512, max(m, 8))
-    bn_ = min(bn or 512, max(n, 128))
-    bk_ = min(bk or 1024, max(k, 128))
+    if not all(frozen):
+        from gauss_tpu.tune import apply as _tune
+        from gauss_tpu.tune.space import MM_TILE_SEED
+
+        nmax = max(m, n, k)
+        dt = str(jnp.dtype(a.dtype))
+        bm = bm or _tune.override("matmul", nmax, "bm", dtype=dt) \
+            or MM_TILE_SEED[0]
+        bn = bn or _tune.override("matmul", nmax, "bn", dtype=dt) \
+            or MM_TILE_SEED[1]
+        bk = bk or _tune.override("matmul", nmax, "bk", dtype=dt) \
+            or MM_TILE_SEED[2]
+    bm_ = min(bm, max(m, 8))
+    bn_ = min(bn, max(n, 128))
+    bk_ = min(bk, max(k, 128))
     if not all(frozen):
         acc_itemsize = 8 if a.dtype == jnp.float64 else 4
         bm_, bn_, bk_ = _mm_blocks(bm_, bn_, bk_,

@@ -189,7 +189,10 @@ def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
             deferred_update(s0, s1)
 
 
-DEFAULT_SEG = 64  # sub-panel segment width; see _panel_kernel (64 best on v5e)
+# Sub-panel segment width; see _panel_kernel (64 best on v5e). The value
+# is the autotuner seed in tune.space (single source); a tuned store
+# overrides it per (h-bucket, dtype) in panel_factor_pallas.
+from gauss_tpu.tune.space import PANEL_SEG_SEED as DEFAULT_SEG
 
 
 DEFER_WORKSET_FACTOR = 5  # empirical VMEM multiple of the block bytes for
@@ -252,7 +255,15 @@ def panel_factor_pallas(p: jax.Array, kb: jax.Array,
                 seg = auto_seg
         else:
             defer = False
-    seg = DEFAULT_SEG if seg is None else seg
+    if seg is None:
+        # Tuned store override for the classic form's segment width (the
+        # deferred auto path above already picked its own seg); seed
+        # default otherwise — zero behavior change without a store.
+        from gauss_tpu.tune import apply as _tune
+
+        seg = int(_tune.override("panel_kernel", h, "seg",
+                                 dtype=str(jnp.dtype(p.dtype)))
+                  or DEFAULT_SEG)
     if seg < 1:
         raise ValueError(f"seg must be >= 1, got {seg}")
     seg = min(seg, panel)

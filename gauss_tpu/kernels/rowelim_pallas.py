@@ -41,6 +41,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from gauss_tpu.kernels.matmul_pallas import _auto_interpret
+# Elimination-kernel tile shape: seeded from the autotuner space (single
+# source — tune.space.ROWELIM_TILE_SEED), the measured v5e default.
+from gauss_tpu.tune.space import ROWELIM_TILE_SEED
+
+DEFAULT_BM, DEFAULT_BN = ROWELIM_TILE_SEED
 
 
 def _elim_kernel(i_ref, piv_ref, m_ref, prow_ref, pcol_ref, out_ref, *, bm, bn):
@@ -63,8 +68,8 @@ def _elim_kernel(i_ref, piv_ref, m_ref, prow_ref, pcol_ref, out_ref, *, bm, bn):
 
 
 @partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
-def eliminate_step_pallas(m: jax.Array, i: jax.Array, *, bm: int = 256,
-                          bn: int = 256, interpret: bool | None = None) -> jax.Array:
+def eliminate_step_pallas(m: jax.Array, i: jax.Array, *, bm: int = DEFAULT_BM,
+                          bn: int = DEFAULT_BN, interpret: bool | None = None) -> jax.Array:
     """One elimination step on the (already pivot-swapped) augmented matrix.
 
     m: (nrows, ncols) with nrows % bm == 0 == ncols % bn (caller pads).
@@ -107,8 +112,8 @@ def eliminate_step_pallas(m: jax.Array, i: jax.Array, *, bm: int = 256,
 
 
 @partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
-def gauss_solve_rowelim(a: jax.Array, b: jax.Array, *, bm: int = 256,
-                        bn: int = 256, interpret: bool | None = None) -> jax.Array:
+def gauss_solve_rowelim(a: jax.Array, b: jax.Array, *, bm: int = DEFAULT_BM,
+                        bn: int = DEFAULT_BN, interpret: bool | None = None) -> jax.Array:
     """Full solve with the per-step elimination kernel (partial pivoting).
 
     Pivot select + two-row swap in jnp per step; the O(n^2) elimination in the
@@ -153,7 +158,7 @@ def _rankk_kernel(m_ref, f_ref, u_ref, out_ref):
 
 @partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
 def rankk_update_pallas(m: jax.Array, f: jax.Array, u: jax.Array, *,
-                        bm: int = 256, bn: int = 256,
+                        bm: int = DEFAULT_BM, bn: int = DEFAULT_BN,
                         interpret: bool | None = None) -> jax.Array:
     """``m - f @ u`` tiled onto the MXU: m (R, C), f (R, k), u (k, C);
     R % bm == 0 == C % bn (caller pads)."""
@@ -218,7 +223,7 @@ def auto_rowelim_k(n: int) -> int:
 @partial(jax.jit, static_argnames=("k", "bm", "bn", "interpret", "panel_impl"))
 def gauss_solve_rowelim_batched(a: jax.Array, b: jax.Array, *,
                                 k: int | None = None,
-                                bm: int = 256, bn: int = 256,
+                                bm: int = DEFAULT_BM, bn: int = DEFAULT_BN,
                                 interpret: bool | None = None,
                                 panel_impl: str = "auto") -> jax.Array:
     """Full solve, k pivot steps per launch (VERDICT round 1 #5).

@@ -138,3 +138,46 @@ def record_vmem_estimate(label: str, **fields) -> None:
     clamped tile dims, ...) computed by kernel-sizing code. Call sites run
     at trace/resolution time, never inside compiled code."""
     _spans.emit("vmem_estimate", label=label, **fields)
+
+
+# -- XLA persistent-compile-cache accounting --------------------------------
+
+#: jax monitoring event suffix -> obs counter. A "cache miss" IS an actual
+#: backend compile (the executable was not in the persistent cache); a
+#: "cache hit" is a compile avoided — the pair is exactly the
+#: fewer-compiles evidence the tune-check warm-start gate asserts on.
+_XLA_CACHE_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "xla.cache_hits",
+    "/jax/compilation_cache/cache_misses": "xla.cache_misses",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "xla.compile_requests",
+}
+
+_xla_listener_registered = False
+
+
+def _xla_cache_listener(event: str, **kwargs) -> None:
+    name = _XLA_CACHE_COUNTERS.get(event)
+    if name is not None:
+        _spans.counter(name)
+
+
+def track_xla_cache() -> bool:
+    """Register a jax monitoring listener that folds persistent-compile-
+    cache hit/miss events into obs counters (``xla.cache_hits`` /
+    ``xla.cache_misses`` / ``xla.compile_requests``). Idempotent; returns
+    whether the listener is installed. Counters no-op without an active
+    recorder, so registration is safe process-wide. Uses jax's private
+    monitoring module — guarded, because accounting must never take down
+    a solve (and the events simply go uncounted on a jax that moved it)."""
+    global _xla_listener_registered
+    if _xla_listener_registered:
+        return True
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_xla_cache_listener)
+    except Exception:
+        return False
+    _xla_listener_registered = True
+    return True

@@ -159,6 +159,20 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "tune_sweep":
+        # A gauss-tune / tune-check summary: tuned seconds-per-solve and
+        # the tuned/seed win ratio per swept point enter history, so a
+        # sweep whose winner got slower — or whose tuning stopped paying —
+        # gates exactly like a perf regression. Derivation lives with the
+        # runner (single source); lazy import keeps jax out of this
+        # module.
+        from gauss_tpu.tune.runner import history_records as tune_hist
+
+        for metric, value, unit in tune_hist(doc):
+            rec = _record(metric, value, path, "tune", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "chaos_campaign":
         # A chaos-campaign summary (python -m gauss_tpu.resilience.chaos
         # --summary-json): recovery-depth and per-case cost enter history so

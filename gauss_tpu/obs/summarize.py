@@ -399,6 +399,63 @@ def _fleet_lines(fs: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def tuning_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the autotuner's events into one report: store consults with
+    their provenance (``tune`` events: source=store|seed, reason on
+    degraded fallbacks), sweep activity (``tune_sweep`` points/prunes),
+    and the persistent-XLA-cache counters (``xla.cache_*`` — a miss is a
+    real backend compile, a hit is a compile avoided). Empty dict when
+    the run touched none of it."""
+    tune = [ev for ev in events if ev.get("type") == "tune"]
+    sweep = [ev for ev in events if ev.get("type") == "tune_sweep"]
+    counters = {ev.get("name"): ev.get("value") for ev in events
+                if ev.get("type") == "metric"
+                and ev.get("kind") == "counter"}
+    hits = counters.get("tune.store_hits", 0)
+    misses = counters.get("tune.store_misses", 0)
+    xla = {k.split(".", 1)[1]: int(v) for k, v in counters.items()
+           if k and str(k).startswith("xla.")}
+    if not (tune or sweep or xla):
+        return {}
+    consults = [{k: ev.get(k) for k in ("key", "source", "params",
+                                        "reason", "sweep_run", "dir")
+                 if ev.get(k) is not None}
+                for ev in tune]
+    points = [ev for ev in sweep if ev.get("event") == "point"]
+    out: Dict[str, Any] = {
+        "store": {"hits": int(hits), "misses": int(misses)},
+        "consults": consults,
+    }
+    if xla:
+        out["xla_cache"] = xla
+    if sweep:
+        out["sweep"] = {
+            "points": len(points),
+            "pruned": sum(1 for ev in sweep if ev.get("event") == "pruned"),
+            "keys": [ev.get("key") for ev in points],
+        }
+    return out
+
+
+def _tuning_lines(tn: Dict[str, Any]) -> List[str]:
+    st = tn["store"]
+    lines = [f"  store: {st['hits']} hit(s) / {st['misses']} miss(es)"]
+    for c in tn["consults"]:
+        kv = " ".join(f"{k}={_fmt(v)}" for k, v in c.items() if k != "key")
+        lines.append(f"    {c.get('key', '?')}: {kv}")
+    xla = tn.get("xla_cache")
+    if xla:
+        lines.append(f"  xla compile cache: "
+                     f"{xla.get('cache_hits', 0)} hit(s) / "
+                     f"{xla.get('cache_misses', 0)} compile(s)")
+    sw = tn.get("sweep")
+    if sw:
+        lines.append(f"  sweep: {sw['points']} point(s) "
+                     f"({', '.join(str(k) for k in sw['keys'] if k)}), "
+                     f"{sw['pruned']} candidate(s) pruned early")
+    return lines
+
+
 def _human_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -456,6 +513,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "structure": structure_summary(evs),
         "resilience": resilience_summary(evs),
         "fleet": fleet_summary(evs),
+        "tuning": tuning_summary(evs),
         "comms": comms_summary(evs),
         "compile": [_strip(ev) for ev in evs
                     if ev.get("type") in ("compile", "cost")],
@@ -524,6 +582,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("fleet:")
         out.extend(_fleet_lines(fleet))
+
+    tuning = tuning_summary(evs)
+    if tuning:
+        out.append("")
+        out.append("tuning:")
+        out.extend(_tuning_lines(tuning))
 
     comms = comms_summary(evs)
     if comms:

@@ -148,6 +148,13 @@ class FleetConfig:
     inject: Optional[str] = None     # GAUSS_FAULTS plan for first spawns
     inject_worker: Optional[int] = None  # target worker (None = all)
     keep: bool = False               # keep the job directory
+    #: persistent XLA compile-cache dir, passed to every worker through the
+    #: GAUSS_COMPILE_CACHE env channel (same pattern as GAUSS_FAULTS): a
+    #: RESTARTED worker then resumes from cached executables instead of
+    #: re-jitting its whole factorization — the dominant term of the
+    #: detect->first-beat resume latency this module measures. None
+    #: inherits whatever the supervisor's environment already carries.
+    compile_cache_dir: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -192,6 +199,14 @@ def _spawn_worker(jobdir: str, cfg: FleetConfig, wid: int, world: int,
     env[ENV_LEASE] = lease_path(jobdir, wid)
     env["GAUSS_OBS_RUN_ID"] = run_id
     env["GAUSS_WATCHDOG_S"] = str(cfg.barrier_deadline_s)
+    if cfg.compile_cache_dir:
+        # The warm-restart channel: workers (and their REPLACEMENTS) share
+        # one persistent XLA compile cache, so a respawn resumes from
+        # cached executables (gauss_tpu.tune.compilecache). Inherited from
+        # os.environ above when the supervisor already runs with one.
+        from gauss_tpu.tune import compilecache as _cc
+
+        env[_cc.ENV_CACHE_DIR] = os.path.abspath(cfg.compile_cache_dir)
     if faults:
         env[_inject.ENV_VAR] = faults
     cmd = [sys.executable, "-m", "gauss_tpu.resilience.fleet", "--worker",
@@ -521,6 +536,11 @@ def _worker_main(args) -> int:
     from gauss_tpu.utils.env import honor_jax_platforms
 
     honor_jax_platforms()
+    from gauss_tpu.tune import compilecache as _cc
+
+    # Join the supervisor's persistent compile cache when the env channel
+    # names one (no-op — and no extra jax config — otherwise).
+    _cc.enable_from_env()
     jobdir = os.fspath(args.jobdir)
     wid, world = args.worker_id, args.num_workers
     a64 = np.load(os.path.join(jobdir, "a.npy"))
@@ -607,6 +627,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject-worker", type=int, default=None,
                    help="restrict --inject to this worker id (default all)")
     p.add_argument("--jobdir", default=None)
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile-cache dir shared by the "
+                        "supervisor and every (re)spawned worker via the "
+                        "GAUSS_COMPILE_CACHE env channel — restarted "
+                        "workers resume with a warm cache; compare the "
+                        "summary's resume_latency_s across a cold and a "
+                        "warm run (also honored from the env)")
     p.add_argument("--keep", action="store_true",
                    help="keep the job directory (checkpoints, logs, leases)")
     p.add_argument("--metrics-out", default=None, metavar="PATH")
@@ -648,13 +675,19 @@ def main(argv=None) -> int:
         a[np.arange(n), np.arange(n)] += float(n)
         b = rng.standard_normal(n)
 
+    from gauss_tpu.tune import compilecache as _cc
+
+    # Enable on the supervisor too (the local_finish rung compiles here),
+    # and export the env channel so workers inherit it.
+    cache_dir = _cc.enable(args.compile_cache)
     cfg = FleetConfig(workers=args.workers, panel=args.panel,
                       chunk=args.chunk, stall_after_s=args.stall_after,
                       barrier_deadline_s=args.barrier_deadline,
                       max_restarts=args.max_restarts,
                       min_workers=args.min_workers,
                       job_timeout_s=args.job_timeout, inject=args.inject,
-                      inject_worker=args.inject_worker, keep=args.keep)
+                      inject_worker=args.inject_worker, keep=args.keep,
+                      compile_cache_dir=cache_dir)
     t0 = time.monotonic()
     error = None
     with obs.run(metrics_out=args.metrics_out, tool="gauss_fleet",
@@ -674,7 +707,10 @@ def main(argv=None) -> int:
           f"shrinks={res.shrinks} rel_residual={res.rel_residual:.3e} "
           f"({res.wall_s:.2f} s)")
     if res.resume_latency_s is not None:
-        print(f"  worst resume latency: {res.resume_latency_s:.3f} s")
+        cache_note = (f"warm compile cache: {cache_dir}" if cache_dir
+                      else "cold: no compile cache")
+        print(f"  worst resume latency: {res.resume_latency_s:.3f} s "
+              f"({cache_note})")
 
     summary = {"kind": "fleet_solve", "n": int(a.shape[0]),
                "workers": args.workers, "seed": args.seed,
@@ -685,7 +721,11 @@ def main(argv=None) -> int:
                "resume_latency_s": res.resume_latency_s,
                "rel_residual": res.rel_residual, "verified": True,
                "wall_s": round(time.monotonic() - t0, 3),
-               "inject": args.inject}
+               "inject": args.inject,
+               # the resume-latency decode key: a cold run (None) vs a
+               # warm-cache run (dir) — compare resume_latency_s across
+               # the pair to see what the persistent cache buys a restart
+               "compile_cache": cache_dir}
     if args.summary_json:
         parent = os.path.dirname(args.summary_json)
         if parent:

@@ -61,8 +61,20 @@ class BatchedExecutable:
         import jax
 
         from gauss_tpu.core import blocked
+        from gauss_tpu.tune import apply as _tune
 
         self.key = key
+        if panel is None:
+            # Serve warmup consults the tuned store (gauss_tpu.tune): a
+            # per-hardware winning panel width for this bucket replaces the
+            # auto heuristic. The CACHE KEY is unchanged — tuning changes
+            # how an executable is built, never which entry it is — and
+            # with no store this resolves to None (the pre-existing auto
+            # path). The consult emits the obs ``tune`` provenance event
+            # the tune-check gate asserts on.
+            panel = _tune.override("lu_factor", key.bucket_n, "panel",
+                                   dtype=key.dtype, engine=key.engine)
+            panel = int(panel) if panel else None
         self.panel = panel
         dtype = np.dtype(key.dtype)
 

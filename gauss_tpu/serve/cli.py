@@ -66,7 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batching linger: wait this long for same-bucket "
                         "company before dispatching (default 0)")
     p.add_argument("--panel", type=int, default=None,
-                   help="blocked-solver panel width (default: auto)")
+                   help="blocked-solver panel width (default: auto, "
+                        "consulting the tuned store when one exists)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="enable JAX's persistent compilation cache at DIR "
+                        "(gauss_tpu.tune.compilecache; also honored from "
+                        "the GAUSS_COMPILE_CACHE env). A second process "
+                        "sharing DIR warms up from cached executables — "
+                        "the report's warmup_s shows the delta")
     # -- outputs ----------------------------------------------------------
     p.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="append the run's obs JSONL event stream here "
@@ -88,6 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     honor_jax_platforms()
+
+    from gauss_tpu.tune import compilecache
+
+    cache_dir = compilecache.enable(args.compile_cache)
+    if cache_dir:
+        print(f"compile cache: {cache_dir}")
 
     from gauss_tpu import obs
     from gauss_tpu.obs import regress

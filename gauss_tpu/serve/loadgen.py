@@ -57,6 +57,14 @@ from gauss_tpu.verify import checks
 VERIFY_GATE = 1e-4  # relative-residual bar, the reference EPSILON
 
 
+def _compilecache_dir() -> Optional[str]:
+    """The persistent compile-cache dir this run used, for the report
+    (None when the cache is off — the report's cold/warm decode key)."""
+    from gauss_tpu.tune import compilecache
+
+    return compilecache.cache_dir()
+
+
 @dataclass(frozen=True)
 class WorkloadSpec:
     """One sampled request template."""
@@ -204,6 +212,7 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
     warm_plan = sample_plan(cfg, cfg.warmup, rng)
     plan = sample_plan(cfg, cfg.requests, rng)
 
+    t_warm = time.perf_counter()
     with obs.span("loadgen_warmup", requests=len(warm_plan)):
         # Submitted as a burst, not serially: warmup must compile the
         # BATCHED executable shapes too (a serial warmup only ever forms
@@ -213,6 +222,11 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
                         for spec in warm_plan]
         for h in warm_handles:
             h.result(cfg.timeout_s)
+    # Warmup wall-clock is the COLD-START number the persistent compile
+    # cache (gauss_tpu.tune.compilecache) exists to kill: a second process
+    # sharing the cache dir reruns this same warmup mostly from cached
+    # executables — the before/after pair in the report.
+    warmup_s = time.perf_counter() - t_warm
     hits0, misses0 = server.cache.hits, server.cache.misses
     batches0 = server.batches
     rec = obs.active()
@@ -299,6 +313,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
         "mode": cfg.mode,
         "requests": len(plan),
         "warmup": len(warm_plan),
+        "warmup_s": round(warmup_s, 6),
+        "compile_cache": _compilecache_dir(),
         "counts": counts,
         "incorrect": incorrect,
         "lanes": lanes,
@@ -367,6 +383,9 @@ def format_summary(summary: Dict) -> str:
 
     lines = [
         f"serve loadgen [{summary['mode']}] mix={summary['mix']}",
+        f"  warmup: {_s(summary.get('warmup_s'))} s"
+        + (f" (compile cache: {summary['compile_cache']})"
+           if summary.get("compile_cache") else " (no compile cache)"),
         f"  requests {summary['requests']} (+{summary['warmup']} warmup): "
         f"{c.get('ok', 0)} ok, {c.get('rejected', 0)} rejected, "
         f"{c.get('expired', 0)} expired, {c.get('failed', 0)} failed, "

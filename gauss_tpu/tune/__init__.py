@@ -1,0 +1,38 @@
+"""gauss_tpu.tune — offline autotuner + persistent compile cache.
+
+The repo's hot-path constants (panel width, chunk group size, kernel tile
+shapes, VMEM sizing floors, refine depth) were each hand-picked from one
+sweep on one machine; ROADMAP's "[perf+scale] Autotuner + persistent
+compile cache" item exists because those numbers cannot be right across
+CPU, v5e, and v5p at every (n, dtype, engine) point. This package closes
+the loop:
+
+- :mod:`gauss_tpu.tune.space` — the declared tunable space per operation,
+  with the historical hand constants as SEED DEFAULTS (single-sourced: the
+  code imports its defaults from here, so tuner output and code defaults
+  cannot drift).
+- :mod:`gauss_tpu.tune.runner` — the offline sweep (``gauss-tune``):
+  per (op, n-bucket, dtype, engine) it measures every candidate with the
+  existing bench timers, prunes losers early, and records the winner.
+- :mod:`gauss_tpu.tune.store` — the versioned on-disk JSON store of
+  winning configs, keyed by an environment fingerprint; corrupt / stale /
+  foreign stores fall back to the seeds with a typed
+  :class:`~gauss_tpu.tune.store.TuneStoreError` available to strict
+  callers.
+- :mod:`gauss_tpu.tune.apply` — the read side every entry point consults
+  (core.blocked auto-resolution, kernels, serve warmup, fleet workers,
+  bench): one stat + dict hit per lookup, zero behavior change when no
+  store exists.
+- :mod:`gauss_tpu.tune.compilecache` — JAX's persistent compilation cache
+  behind one helper + the ``GAUSS_COMPILE_CACHE`` env channel, so serve
+  restarts and fleet worker respawns resume with a warm cache instead of
+  re-jitting their whole bucket ladder.
+- :mod:`gauss_tpu.tune.check` — the ``make tune-check`` CI gate:
+  micro-sweep -> store -> tuned solve verified at 1e-4 -> second-process
+  warm-cache rerun asserted to perform strictly fewer XLA compiles.
+
+Nothing here imports jax at module load; device-touching helpers import
+it lazily (same rule as gauss_tpu.obs).
+"""
+
+from gauss_tpu.tune.store import TuneStore, TuneStoreError  # noqa: F401

@@ -1,0 +1,194 @@
+"""The consult path: every entry point asks here for its tuned config.
+
+Contract (the tentpole's integration rule):
+
+- **Zero behavior change without a store.** When no store file exists,
+  :func:`param` returns the seed (or the caller's own default) after one
+  cached ``os.stat`` — the hot paths pay a dict hit, nothing else.
+- **Typed fallback.** A corrupt/stale/foreign store is a
+  :class:`~gauss_tpu.tune.store.TuneStoreError` internally; here it
+  degrades to seeds with an obs ``tune`` event naming the reason —
+  a broken store file must never break a solve.
+- **Process-stable.** The store is read ONCE per process (first consult)
+  and the resolution is frozen: jitted entry points bake the resolved
+  values into compiled programs at trace time, so re-reading a changed
+  file mid-process would make the lookup disagree with the executables
+  already compiled from it. Tests use :func:`reset_cache`.
+- **Observable.** Each distinct (run, key, outcome) consult emits one obs
+  ``tune`` event (source=store|seed, reason on fallbacks) plus
+  ``tune.store_hits`` / ``tune.store_misses`` counters — the summarizer's
+  "tuning" section and the tune-check gate read these.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from gauss_tpu import obs
+from gauss_tpu.tune import space as _space
+from gauss_tpu.tune import store as _store
+
+_lock = threading.Lock()
+#: (path, store-or-None, reason) — resolved once per process.
+_resolved: Optional[Tuple[str, Optional[_store.TuneStore], str]] = None
+#: (run_id, key, outcome) tuples already announced, so per-solve consults
+#: do not flood a long-running recorder stream.
+_announced: set = set()
+
+
+def reset_cache() -> None:
+    """Forget the cached store resolution (tests; or after writing a new
+    store in-process, e.g. the tune-check gate)."""
+    global _resolved
+    with _lock:
+        _resolved = None
+        _announced.clear()
+
+
+_suspended = False
+
+
+@contextlib.contextmanager
+def suspended():
+    """Temporarily behave as if no store exists. The sweep runner wraps
+    its measurements in this so a PRE-EXISTING store can never leak into
+    the seed-config baseline it measures candidates against (re-sweeps
+    must be deterministic in the store's content)."""
+    global _suspended
+    prev = _suspended
+    _suspended = True
+    try:
+        yield
+    finally:
+        _suspended = prev
+
+
+def _resolve() -> Tuple[str, Optional[_store.TuneStore], str]:
+    """(path, usable store or None, reason). Cached for process lifetime —
+    with one exception: a store whose fingerprint cannot be judged yet
+    because no jax backend is initialized (the current fingerprint is
+    missing the fields the store is stamped with) is NOT cached; the next
+    consult — by which point the surrounding solve has initialized the
+    backend — retries. A confirmed hardware CONFLICT is cached: it cannot
+    heal within this process."""
+    global _resolved
+    with _lock:
+        if _resolved is not None:
+            return _resolved
+        path = _store.default_store_path()
+        st: Optional[_store.TuneStore] = None
+        cache = True
+        if not os.path.exists(path):
+            reason = "absent"
+        else:
+            try:
+                st = _store.TuneStore.load(path)
+            except _store.TuneStoreError as e:
+                st, reason = None, f"store_error: {e}"
+            else:
+                current = _store.store_fingerprint()
+                stamped = st.fingerprint
+                conflict = any(k in stamped and k in current
+                               and stamped[k] != current[k]
+                               for k in _store.FINGERPRINT_KEYS)
+                unknown = any(k in stamped and k not in current
+                              for k in _store.FINGERPRINT_KEYS)
+                if conflict:
+                    st, reason = None, "fingerprint_mismatch"
+                elif unknown:
+                    st, reason = None, "backend_uninitialized"
+                    cache = False
+                else:
+                    reason = "ok"
+        resolved = (path, st, reason)
+        if cache:
+            _resolved = resolved
+        return resolved
+
+
+def store_status() -> Dict[str, Any]:
+    """The resolved store state (path / usable / reason) — diagnostics and
+    the bench/grid ``--tuned`` banners."""
+    path, st, reason = _resolve()
+    return {"path": path, "usable": st is not None, "reason": reason,
+            "configs": len(st.configs) if st is not None else 0}
+
+
+def _announce(key: str, outcome: str, **fields) -> None:
+    rec = obs.active()
+    run_id = rec.run_id if rec is not None else None
+    tag = (run_id, key, outcome)
+    with _lock:
+        if tag in _announced:
+            return
+        _announced.add(tag)
+    obs.counter("tune.store_hits" if outcome == "store"
+                else "tune.store_misses")
+    obs.emit("tune", key=key, source=outcome, **fields)
+
+
+def params_for(op: str, n: int, dtype: str = "float32",
+               engine: str = "blocked") -> Dict[str, Any]:
+    """Seed defaults overlaid with this hardware's stored winners for the
+    (op, n-bucket, dtype, engine) point. Never raises; never returns None.
+    """
+    key = _space.config_key(op, n, dtype, engine)
+    seeds = _space.seed_params(op)
+    if _suspended:
+        return seeds
+    path, st, reason = _resolve()
+    if st is None:
+        # "absent" is the permanent steady state of an untuned checkout —
+        # not worth an event per run; real degradations are.
+        if reason != "absent":
+            _announce(key, "seed", reason=reason)
+        return seeds
+    entry = st.configs.get(key)
+    if not entry:
+        _announce(key, "seed", reason="no_entry")
+        return seeds
+    seeds.update(entry["params"])
+    _announce(key, "store", params=entry["params"],
+              swept=entry.get("swept_unix"),
+              sweep_run=entry.get("source"))
+    return seeds
+
+
+def param(op: str, n: int, name: str, default: Any = None,
+          dtype: str = "float32", engine: str = "blocked") -> Any:
+    """One tuned parameter for the (op, n) point; ``default`` (then the
+    declared seed) when the store has nothing to say. The single-value
+    form the auto-resolvers use (core.blocked.auto_panel / resolve_factor,
+    kernel tile pickers, serve warmup)."""
+    value = params_for(op, n, dtype, engine).get(name)
+    return default if value is None else value
+
+
+def override(op: str, n: int, name: str, dtype: str = "float32",
+             engine: str = "blocked") -> Any:
+    """STORE-provided value only — None unless a usable store carries an
+    explicit winner for this (op, n-bucket, dtype, engine, param) point.
+    For code whose fallback is its own live module constant (e.g.
+    ``core.blocked.PANEL_VMEM_BUDGET``, which tests monkeypatch): the
+    declared seed must not shadow the caller's default there."""
+    if _suspended:
+        return None
+    path, st, reason = _resolve()
+    if st is None:
+        # Degradations are data (summarize "tuning" section); the absent /
+        # not-yet-judgeable states are steady noise, not degradations.
+        if reason not in ("absent", "backend_uninitialized"):
+            _announce(_space.config_key(op, n, dtype, engine), "seed",
+                      reason=reason)
+        return None
+    key = _space.config_key(op, n, dtype, engine)
+    entry = st.configs.get(key)
+    if not entry or name not in entry["params"]:
+        return None
+    value = entry["params"][name]
+    _announce(key, "store", params=entry["params"],
+              swept=entry.get("swept_unix"), sweep_run=entry.get("source"))
+    return value

@@ -1,0 +1,287 @@
+"""``make tune-check`` — the autotuner + compile-cache CI gate.
+
+One bounded CPU smoke proving the whole tune loop end to end:
+
+1. **Micro-sweep** (2 points per axis) through the real runner -> a store
+   file written with this environment's fingerprint.
+2. **Tuned solve**: with the store installed (``GAUSS_TUNE_STORE``), the
+   auto-resolving entry points must consult it (asserted via obs ``tune``
+   events), produce a solution inside the 1e-4 relative-residual gate, and
+   factor BIT-IDENTICALLY to an explicit call with the winning params —
+   tuning picks among configs, it must never change the math of any one.
+3. **Serve warmup**: a batched executable built with ``panel=None`` must
+   pick up the tuned panel (same cache key as untuned — tuning changes how
+   an entry is built, not which entry it is).
+4. **Warm-start**: two child processes run the same workload against one
+   persistent compile-cache dir; the second must perform STRICTLY FEWER
+   XLA compiles (obs ``xla.cache_misses`` accounting — a miss IS a real
+   backend compile) and report its warmup accordingly.
+
+Exit codes: 2 on any correctness/consult/warm-start assertion failure,
+1 when ``--regress-check`` finds the sweep out of the history band, 0
+green. The summary is the runner's regress-ingestable ``tune_sweep`` doc
+extended with a ``warm_start`` section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fail(msg: str) -> int:
+    print(f"tune-check: FAILED: {msg}", file=sys.stderr)
+    return 2
+
+
+def _counter(events: List[dict], name: str) -> float:
+    for ev in events:
+        if (ev.get("type") == "metric" and ev.get("kind") == "counter"
+                and ev.get("name") == name):
+            return float(ev.get("value") or 0)
+    return 0.0
+
+
+def _child_main(args) -> int:
+    """One warm-start probe process: enable the compile cache from the env
+    channel, run the seeded solve + serve-executable build, record the
+    stream. Spawned twice against one cache dir; the streams' XLA cache
+    counters are the gate's evidence."""
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()
+    from gauss_tpu import obs
+    from gauss_tpu.tune import compilecache, runner
+
+    compilecache.enable_from_env()
+    t0 = time.perf_counter()
+    with obs.run(metrics_out=args.metrics_out, tool="tune_check_child"):
+        from gauss_tpu.core import blocked
+        from gauss_tpu.serve.cache import CacheKey, ExecutableCache
+
+        a64, b64 = runner._seeded_system(args.n, args.seed)
+        x, _ = blocked.solve_refined(a64, b64)
+        rel = (np.linalg.norm(a64 @ x - b64)
+               / max(np.linalg.norm(b64), 1e-30))
+        # The serve warmup shapes join the cache too (they dominate a real
+        # cold start).
+        cache = ExecutableCache(capacity=4)
+        bucket = 1 << (args.n - 1).bit_length()
+        cache.get(CacheKey(bucket_n=bucket, nrhs=1, batch=2,
+                           dtype="float32", engine="blocked",
+                           refine_steps=1))
+        obs.emit("tune_check", child=True, rel_residual=float(rel),
+                 wall_s=round(time.perf_counter() - t0, 4))
+    return 0 if rel <= 1e-4 else 2
+
+
+def run_check(args) -> int:
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()
+    from gauss_tpu import obs
+    from gauss_tpu.core import blocked
+    from gauss_tpu.serve.cache import CacheKey, ExecutableCache
+    from gauss_tpu.tune import apply as _apply
+    from gauss_tpu.tune import runner
+    from gauss_tpu.tune import store as _tstore
+
+    own_tmp = args.tmpdir is None
+    tmpdir = args.tmpdir or tempfile.mkdtemp(prefix="gauss_tune_check_")
+    os.makedirs(tmpdir, exist_ok=True)
+    store_path = os.path.join(tmpdir, "tune_store.json")
+    cache_dir = os.path.join(tmpdir, "xla_cache")
+    summary: Dict = {}
+    rc = 0
+    try:
+        with obs.run(metrics_out=args.metrics_out,
+                     tool="tune_check", n=args.n) as rec:
+            # -- 1. micro-sweep: 2 points per swept axis ------------------
+            axes = {"panel": [64, 128], "chunk": [1, 2]}
+            summary = runner.run_sweep(["lu_factor"], [args.n],
+                                       seed=args.seed, reps=args.reps,
+                                       axes=axes, run_id=rec.run_id)
+            runner.write_store(summary, store_path)
+            print(runner.format_summary(summary))
+            point = summary["points"][0]
+            winner = {k: v for k, v in point["best_params"].items()
+                      if v is not None}
+
+            # -- 2. tuned solve: consulted + verified + bit-identical -----
+            os.environ[_tstore.ENV_STORE] = store_path
+            _apply.reset_cache()
+            import jax
+
+            # The sweep already traced these shapes with the seed configs;
+            # the jit cache would replay those programs and the store
+            # consult (trace-time) would never run. A fresh process has no
+            # such cache — clearing reproduces that state.
+            jax.clear_caches()
+            a64, b64 = runner._seeded_system(args.n, args.seed)
+            x, _ = blocked.solve_refined(a64, b64)
+            rel = (np.linalg.norm(a64 @ x - b64)
+                   / max(np.linalg.norm(b64), 1e-30))
+            if not rel <= 1e-4:
+                return _fail(f"tuned solve missed the 1e-4 gate "
+                             f"(rel residual {rel:.3e})")
+            consults = [ev for ev in rec.events if ev.get("type") == "tune"
+                        and ev.get("source") == "store"]
+            if not consults:
+                return _fail("tuned solve emitted no store-consult event "
+                             "(the store was not consulted)")
+            if "panel" in winner:
+                import jax.numpy as jnp
+
+                a32 = jnp.asarray(a64, jnp.float32)
+                fac_auto = blocked.lu_factor_blocked(a32, panel=None)
+                fac_explicit = blocked.lu_factor_blocked(
+                    a32, panel=int(winner["panel"]))
+                if not np.array_equal(np.asarray(fac_auto.m),
+                                      np.asarray(fac_explicit.m)):
+                    return _fail("store-resolved factorization is not "
+                                 "bit-identical to the explicit winning "
+                                 "config")
+                print(f"tune-check: tuned solve ok (rel {rel:.3e}, "
+                      f"bit-identical to explicit {winner})")
+
+            # -- 3. serve warmup picks up the tuned panel -----------------
+            cache = ExecutableCache(capacity=4)
+            bucket = 1 << (args.n - 1).bit_length()
+            key = CacheKey(bucket_n=bucket, nrhs=1, batch=1,
+                           dtype="float32", engine="blocked",
+                           refine_steps=1)
+            exe = cache.get(key)
+            want_panel = winner.get("panel")
+            if want_panel is not None and exe.panel != int(want_panel):
+                return _fail(f"serve warmup built with panel={exe.panel}, "
+                             f"store says {want_panel}")
+            if exe.key != key:
+                return _fail("tuning changed the executable cache key")
+            print(f"tune-check: serve warmup consulted the store "
+                  f"(panel={exe.panel}, cache key unchanged)")
+
+        # -- 4. warm-start: strictly fewer XLA compiles in process 2 ------
+        env = dict(os.environ)
+        env["GAUSS_COMPILE_CACHE"] = cache_dir
+        env[_tstore.ENV_STORE] = store_path
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        streams, walls = [], []
+        for tag in ("cold", "warm"):
+            stream = os.path.join(tmpdir, f"child_{tag}.jsonl")
+            cmd = [sys.executable, "-m", "gauss_tpu.tune.check", "--child",
+                   "--n", str(args.n), "--seed", str(args.seed),
+                   "--metrics-out", stream]
+            t0 = time.perf_counter()
+            proc = subprocess.run(cmd, cwd=_REPO, env=env,
+                                  capture_output=True, text=True,
+                                  timeout=args.child_timeout)
+            walls.append(round(time.perf_counter() - t0, 3))
+            if proc.returncode != 0:
+                return _fail(f"{tag} child exited {proc.returncode}:\n"
+                             f"{proc.stdout}\n{proc.stderr}")
+            streams.append(stream)
+        from gauss_tpu.obs.registry import read_events
+
+        cold_ev, warm_ev = (read_events(s) for s in streams)
+        cold_misses = _counter(cold_ev, "xla.cache_misses")
+        warm_misses = _counter(warm_ev, "xla.cache_misses")
+        warm_hits = _counter(warm_ev, "xla.cache_hits")
+        if not cold_misses > 0:
+            return _fail("cold child recorded no XLA compiles — the "
+                         "persistent-cache accounting is broken")
+        if not warm_misses < cold_misses:
+            return _fail(f"warm-start did not reduce XLA compiles "
+                         f"(cold {cold_misses:.0f} vs warm "
+                         f"{warm_misses:.0f} misses)")
+        summary["warm_start"] = {
+            "cache_dir": cache_dir, "cold_compiles": int(cold_misses),
+            "warm_compiles": int(warm_misses),
+            "warm_cache_hits": int(warm_hits),
+            "cold_wall_s": walls[0], "warm_wall_s": walls[1]}
+        print(f"tune-check: warm start ok — XLA compiles "
+              f"{int(cold_misses)} cold -> {int(warm_misses)} warm "
+              f"({int(warm_hits)} cache hits; wall {walls[0]:.1f} s -> "
+              f"{walls[1]:.1f} s)")
+
+        # -- outputs / gates ---------------------------------------------
+        if args.summary_json:
+            parent = os.path.dirname(args.summary_json)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(args.summary_json, "w") as f:
+                json.dump(summary, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"summary: {args.summary_json}")
+
+        from gauss_tpu.obs import regress
+
+        records = [{"metric": m, "value": v, "unit": u,
+                    "source": f"tune:{summary.get('run_id')}",
+                    "kind": "tune"}
+                   for m, v, u in runner.history_records(summary)]
+        if args.regress_check and records:
+            history_path = args.history or regress.default_history_path()
+            verdicts = regress.check_records(
+                records, regress.load_history(history_path))
+            print(regress.format_verdicts(verdicts))
+            if any(v["status"] == "out-of-band" for v in verdicts):
+                rc = 1
+        if args.history is not None and records and rc == 0:
+            history_path = args.history or regress.default_history_path()
+            added = regress.append_history(records, history_path)
+            print(f"history: {added} record(s) appended to {history_path}")
+    finally:
+        if own_tmp:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return rc
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.tune.check",
+        description="Autotuner + compile-cache smoke gate: micro-sweep -> "
+                    "store -> tuned solve (verified, bit-identical, "
+                    "consult-asserted) -> serve warmup consult -> "
+                    "second-process warm start with strictly fewer XLA "
+                    "compiles.")
+    p.add_argument("--n", type=int, default=96,
+                   help="system size for the micro-sweep (default 96)")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--reps", type=int, default=2,
+                   help="timed reps per candidate (default 2)")
+    p.add_argument("--tmpdir", default=None,
+                   help="working dir (store, cache, child streams); a "
+                        "temp dir removed at exit by default")
+    p.add_argument("--child-timeout", type=float, default=180.0)
+    p.add_argument("--metrics-out", default=None, metavar="PATH")
+    p.add_argument("--summary-json", default=None, metavar="PATH")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append the sweep's records to the regression "
+                        "history (default reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true")
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.child:
+        return _child_main(args)
+    return run_check(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""JAX persistent compilation cache behind one helper + one env channel.
+
+Every serve process and every fleet worker used to re-jit its whole bucket
+ladder from scratch on start — the dominant term in serve cold-start p99
+and in the fleet-restart resume latency PR 5 measures. XLA can already
+persist compiled executables across processes (``jax_compilation_cache_dir``);
+this module is the single switch that turns it on consistently:
+
+- :func:`enable` points jax at an on-disk cache directory and drops the
+  default minimum-compile-time/entry-size thresholds (our executables are
+  many and individually small — the default 1 s floor would cache almost
+  none of them), then registers the obs XLA-cache accounting listener so
+  hits/misses are data in the run stream.
+- ``GAUSS_COMPILE_CACHE`` is the env channel (same pattern as
+  ``GAUSS_FAULTS``): :func:`enable` exports it, so worker subprocesses a
+  supervisor spawns (resilience.fleet) and any child driver inherit the
+  warm cache automatically; :func:`enable_from_env` is the receiving end.
+
+Config consistency matters: the cache key covers the compile options, so
+every participating process must enable the cache the same way (this
+helper IS that way). Processes that never call :func:`enable` are
+untouched — the cache is strictly opt-in.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from gauss_tpu import obs
+
+ENV_CACHE_DIR = "GAUSS_COMPILE_CACHE"
+
+_enabled_dir: Optional[str] = None
+
+
+def cache_dir() -> Optional[str]:
+    """The directory this process's persistent cache writes to (None when
+    not enabled)."""
+    return _enabled_dir
+
+
+def enabled() -> bool:
+    return _enabled_dir is not None
+
+
+def enable(path: Optional[str] = None, export_env: bool = True,
+           ) -> Optional[str]:
+    """Enable the persistent compilation cache at ``path`` (or the
+    ``GAUSS_COMPILE_CACHE`` env value when ``path`` is None). Returns the
+    directory in effect, or None when there is nothing to enable.
+    Idempotent; re-enabling with a different path re-points the cache.
+
+    ``export_env``: also export the dir into this process's environment so
+    spawned subprocesses (fleet workers, loadgen children) join the same
+    cache — the GAUSS_* env channel.
+    """
+    global _enabled_dir
+    path = path or os.environ.get(ENV_CACHE_DIR)
+    if not path:
+        return None
+    path = os.path.abspath(os.fspath(path))
+    os.makedirs(path, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache EVERYTHING: the serve/fleet workload is dozens of small
+    # executables, each well under the default 1 s / min-entry-size
+    # thresholds that were designed for giant training steps.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+    from gauss_tpu.obs import compile as _obs_compile
+
+    _obs_compile.track_xla_cache()
+    if export_env:
+        os.environ[ENV_CACHE_DIR] = path
+    _enabled_dir = path
+    obs.emit("tune", key="compile_cache", source="enabled", dir=path)
+    return path
+
+
+def enable_from_env() -> Optional[str]:
+    """The subprocess receiving end: enable the cache iff the env channel
+    names a directory (fleet workers call this right after
+    honor_jax_platforms). No-op — and no jax import — otherwise."""
+    if not os.environ.get(ENV_CACHE_DIR):
+        return None
+    return enable()

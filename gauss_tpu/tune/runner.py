@@ -1,0 +1,451 @@
+"""``gauss-tune`` — the offline sweep that fills the store.
+
+Per (op, n-bucket, dtype, engine) point the runner measures every candidate
+config in the declared space (:mod:`gauss_tpu.tune.space`) on a seeded
+synthetic system, using the same device-completion timing discipline the
+bench stack uses (warmup excluded via ``obs.compile_span``, spans bounded
+by ``block_until_ready``), and records the WINNER — plus the seed config's
+own time, so every store entry carries its measured improvement and the
+``tune_sweep`` summary is regress-ingestable (a later sweep whose winner
+is slower than history's is a tuning regression, gated like any other).
+
+Determinism: operands come from the seeded generators
+(:mod:`gauss_tpu.io.synthetic`-style diagonally-dominant systems), the
+candidate order is the declared order, and timing noise is bounded by
+taking the best of ``reps`` repetitions. Early pruning: a candidate whose
+FIRST repetition already exceeds ``prune_ratio`` x the best-so-far is
+abandoned without spending its remaining reps (the sweep's cost is
+dominated by losers — most of the grid — so this is where the time goes).
+
+The sweep never runs inside a serving process: it is offline by design
+(compiles dozens of programs); processes CONSULT its output through
+:mod:`gauss_tpu.tune.apply`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.tune import space as _space
+from gauss_tpu.tune import store as _store
+
+DEFAULT_REPS = 3
+DEFAULT_PRUNE_RATIO = 1.5
+
+
+def _seeded_system(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic well-conditioned dense system (diagonally dominant —
+    the same shape the fleet/chaos smokes use, so a sweep measures the
+    factorization, not recovery ladders)."""
+    rng = np.random.default_rng(seed + n)
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _candidate_grid(op: str, axes: Optional[Dict[str, Iterable]] = None,
+                    sweep_all: bool = False) -> List[Dict[str, Any]]:
+    """The cross product of candidate values over the op's swept axes,
+    seed config first. ``axes`` overrides candidate lists per axis
+    (the CLI's ``--axes panel=64,128``); non-default axes join only when
+    explicitly overridden or with ``sweep_all``."""
+    space = _space.space_for(op)
+    names, values = [], []
+    for ax in space:
+        if axes and ax.name in axes:
+            vals = tuple(axes[ax.name])
+        elif ax.sweep_default or sweep_all:
+            vals = ax.values()
+        else:
+            continue
+        names.append(ax.name)
+        values.append(vals)
+    grid: List[Dict[str, Any]] = [{}]
+    for name, vals in zip(names, values):
+        grid = [{**g, name: v} for g in grid for v in vals]
+    seeds = {ax.name: ax.seed for ax in space}
+    seed_pt = {n: seeds[n] for n in names}
+    # Seed first (it is the baseline every candidate is judged against);
+    # preserve declared order for the rest, minus the seed duplicate.
+    return [seed_pt] + [g for g in grid if g != seed_pt]
+
+
+def _measure_lu_factor(n: int, dtype: str, params: Dict[str, Any],
+                       seed: int, reps: int,
+                       prune_s: Optional[float]) -> Optional[float]:
+    """Best-of-``reps`` seconds for one blocked factor+solve at ``params``
+    (panel/chunk; refine_steps rides through the solve). None = pruned."""
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.utils.timing import timed
+
+    a64, b64 = _seeded_system(n, seed)
+    a = jnp.asarray(a64, dtype=jnp.dtype(dtype))
+    b = jnp.asarray(b64, dtype=jnp.dtype(dtype))
+    panel = params.get("panel")
+    chunk = params.get("chunk")
+    use_chunked = (chunk is not None and chunk != 1
+                   and n > (panel or blocked.DEFAULT_PANEL))
+
+    def run_once():
+        if use_chunked:
+            fac = blocked.lu_factor_blocked_chunked(a, panel=panel,
+                                                    chunk=int(chunk))
+        else:
+            fac = blocked.lu_factor_blocked(a, panel=panel)
+        return blocked.lu_solve(fac, b)
+
+    with obs.compile_span("tune_candidate", op="lu_factor", n=n,
+                          **{k: v for k, v in params.items()
+                             if v is not None}):
+        jax.block_until_ready(run_once())  # compile outside the timing
+    best = None
+    for r in range(max(1, reps)):
+        t, _ = timed(run_once, warmup=0, reps=1)
+        best = t if best is None else min(best, t)
+        if r == 0 and prune_s is not None and t > prune_s:
+            obs.emit("tune_sweep", event="pruned", op="lu_factor", n=n,
+                     params=params, first_rep_s=round(t, 6),
+                     prune_s=round(prune_s, 6))
+            return None
+    return best
+
+
+def _measure_matmul(n: int, dtype: str, params: Dict[str, Any], seed: int,
+                    reps: int, prune_s: Optional[float]) -> Optional[float]:
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.kernels.matmul_pallas import matmul_pallas
+    from gauss_tpu.utils.timing import timed
+
+    rng = np.random.default_rng(seed + n)
+    a = jnp.asarray(rng.standard_normal((n, n)), dtype=jnp.dtype(dtype))
+    b = jnp.asarray(rng.standard_normal((n, n)), dtype=jnp.dtype(dtype))
+    kw = {k: int(v) for k, v in params.items()
+          if k in ("bm", "bn", "bk") and v is not None}
+
+    def run_once():
+        return matmul_pallas(a, b, **kw)
+
+    with obs.compile_span("tune_candidate", op="matmul", n=n, **kw):
+        jax.block_until_ready(run_once())
+    best = None
+    for r in range(max(1, reps)):
+        t, _ = timed(run_once, warmup=0, reps=1)
+        best = t if best is None else min(best, t)
+        if r == 0 and prune_s is not None and t > prune_s:
+            obs.emit("tune_sweep", event="pruned", op="matmul", n=n,
+                     params=params, first_rep_s=round(t, 6),
+                     prune_s=round(prune_s, 6))
+            return None
+    return best
+
+
+_MEASURERS = {"lu_factor": _measure_lu_factor, "matmul": _measure_matmul}
+
+
+def _concrete_lu_factor(n: int, dtype: str,
+                        params: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve a winning lu_factor config's auto values to what they
+    concretely resolved to DURING the measurement, so every store entry
+    pins concrete params (a store entry exists to short-circuit the auto
+    heuristics; recording "auto" would pin nothing)."""
+    out = dict(params)
+    if "panel" in out and out["panel"] is None:
+        from gauss_tpu.core import blocked
+
+        out["panel"] = blocked.auto_panel(n, np.dtype(dtype).itemsize)
+    if "chunk" in out and out["chunk"] is None:
+        out["chunk"] = _space.CHUNK_SEED
+    return out
+
+
+_CONCRETIZERS = {"lu_factor": _concrete_lu_factor}
+
+
+def sweep_point(op: str, n: int, dtype: str = "float32",
+                engine: str = "blocked", seed: int = 258458,
+                reps: int = DEFAULT_REPS,
+                prune_ratio: float = DEFAULT_PRUNE_RATIO,
+                axes: Optional[Dict[str, Iterable]] = None,
+                sweep_all: bool = False) -> Dict[str, Any]:
+    """Sweep one (op, n, dtype, engine) point; returns the point record
+    (seed/best params + seconds, candidates tried/pruned). The declared
+    seed config is always measured fully (it is the fallback the store
+    must never be worse than)."""
+    measure = _MEASURERS.get(op)
+    if measure is None:
+        raise ValueError(f"op {op!r} has no sweep measurer; options: "
+                         f"{sorted(_MEASURERS)}")
+    from gauss_tpu.tune import apply as _apply
+
+    grid = _candidate_grid(op, axes=axes, sweep_all=sweep_all)
+    results: List[Tuple[Dict[str, Any], Optional[float]]] = []
+    best_s: Optional[float] = None
+    with _apply.suspended(), obs.span("tune_sweep_point", op=op, n=n,
+                                      dtype=dtype, candidates=len(grid)):
+        for i, params in enumerate(grid):
+            prune_s = (None if best_s is None or i == 0
+                       else prune_ratio * best_s)
+            t = measure(n, dtype, params, seed, reps, prune_s)
+            results.append((params, t))
+            if t is not None and (best_s is None or t < best_s):
+                best_s = t
+        seed_params, seed_s = results[0]
+        best_params, best_sec = min(
+            ((p, t) for p, t in results if t is not None),
+            key=lambda pt: pt[1])
+        concretize = _CONCRETIZERS.get(op)
+        if concretize is not None:
+            best_params = concretize(n, dtype, best_params)
+    point = {
+        "op": op, "n": n, "n_bucket": _space.n_bucket(n), "dtype": dtype,
+        "engine": engine, "key": _space.config_key(op, n, dtype, engine),
+        "seed_params": seed_params,
+        "seed_s": round(seed_s, 6) if seed_s is not None else None,
+        "best_params": best_params, "best_s": round(best_sec, 6),
+        "improvement": (round(seed_s / best_sec, 4)
+                        if seed_s and best_sec else None),
+        "candidates": len(grid),
+        "pruned": sum(1 for _, t in results if t is None),
+    }
+    obs.emit("tune_sweep", event="point", **point)
+    return point
+
+
+def run_sweep(ops: List[str], ns: List[int], dtype: str = "float32",
+              engine: str = "blocked", seed: int = 258458,
+              reps: int = DEFAULT_REPS,
+              prune_ratio: float = DEFAULT_PRUNE_RATIO,
+              axes: Optional[Dict[str, Iterable]] = None,
+              sweep_all: bool = False,
+              run_id: Optional[str] = None) -> Dict[str, Any]:
+    """Sweep the (ops x ns) grid; returns the ``tune_sweep`` summary."""
+    t0 = time.monotonic()
+    from gauss_tpu.tune import apply as _apply
+
+    # A pre-existing store must not leak into the measurements (the seed
+    # baseline would silently become "previously tuned"): the sweep runs
+    # with consults suspended — deterministic in the store's content.
+    with _apply.suspended():
+        points = [sweep_point(op, n, dtype=dtype, engine=engine, seed=seed,
+                              reps=reps, prune_ratio=prune_ratio, axes=axes,
+                              sweep_all=sweep_all)
+                  for op in ops for n in ns]
+    return {"kind": "tune_sweep", "ops": ops, "ns": ns, "dtype": dtype,
+            "engine": engine, "seed": seed, "reps": reps,
+            "prune_ratio": prune_ratio, "points": points,
+            "fingerprint": _store.store_fingerprint(),
+            "run_id": run_id, "wall_s": round(time.monotonic() - t0, 3)}
+
+
+def write_store(summary: Dict[str, Any], path,
+                keep_seed_winners: bool = True) -> str:
+    """Persist a sweep summary's winners as a store at ``path``. An
+    existing same-fingerprint store is UPDATED (other points survive); a
+    foreign or unusable one is replaced wholesale. ``keep_seed_winners``:
+    also record points whose winner IS the seed config — the entry then
+    documents "swept, seed confirmed" and pins the auto heuristics to the
+    measured value."""
+    st: Optional[_store.TuneStore] = None
+    if os.path.exists(os.fspath(path)):
+        try:
+            prev = _store.TuneStore.load(path)
+            if _store.fingerprint_matches(prev.fingerprint,
+                                          summary["fingerprint"]):
+                st = prev
+        except _store.TuneStoreError:
+            st = None
+    if st is None:
+        st = _store.TuneStore(fingerprint=summary["fingerprint"])
+    for point in summary["points"]:
+        if not keep_seed_winners \
+                and point["best_params"] == point["seed_params"]:
+            continue
+        st.put(point["op"], point["n"],
+               {k: v for k, v in point["best_params"].items()
+                if v is not None},
+               dtype=point["dtype"], engine=point["engine"],
+               seconds=point["best_s"], seed_seconds=point["seed_s"],
+               source=summary.get("run_id"))
+    return st.save(path)
+
+
+def history_records(summary: Dict[str, Any]) -> List[Tuple[str, float, str]]:
+    """(metric, value, unit) records a sweep contributes to the regression
+    history — both slow-side gated: tuned seconds growing means the hot
+    path got slower; win_ratio (tuned/seed) drifting toward 1+ means
+    tuning stopped paying."""
+    out = []
+    for p in summary.get("points", []):
+        stem = f"tune:{p['op']}/n{p['n_bucket']}/{p['dtype']}"
+        if isinstance(p.get("best_s"), (int, float)) and p["best_s"] > 0:
+            out.append((f"{stem}:s_per_solve", p["best_s"], "s"))
+        if p.get("seed_s") and p.get("best_s"):
+            out.append((f"{stem}:win_ratio",
+                        round(p["best_s"] / p["seed_s"], 4), "ratio"))
+    return out
+
+
+def format_summary(summary: Dict[str, Any]) -> str:
+    lines = [f"gauss-tune sweep [{summary['dtype']}/{summary['engine']}] "
+             f"ops={','.join(summary['ops'])} "
+             f"ns={','.join(str(n) for n in summary['ns'])} "
+             f"({summary['wall_s']:.1f} s)"]
+    for p in summary["points"]:
+        imp = (f"{p['improvement']:.2f}x vs seed" if p["improvement"]
+               else "no seed time")
+        lines.append(
+            f"  {p['key']}: best={p['best_params']} "
+            f"{p['best_s'] * 1e3:.3f} ms ({imp}; seed={p['seed_params']} "
+            f"{(p['seed_s'] or 0) * 1e3:.3f} ms; "
+            f"{p['candidates']} candidates, {p['pruned']} pruned)")
+    return "\n".join(lines)
+
+
+def _parse_axes(specs: List[str]) -> Dict[str, List[Any]]:
+    """``panel=64,128 chunk=1,2`` -> {"panel": [64, 128], "chunk": [1, 2]}
+    (values parse as int, then float, then bare string)."""
+    def _val(s: str):
+        for cast in (int, float):
+            try:
+                return cast(s)
+            except ValueError:
+                continue
+        return None if s in ("none", "None", "auto") else s
+
+    out: Dict[str, List[Any]] = {}
+    for spec in specs:
+        if "=" not in spec:
+            raise ValueError(f"bad --axes spec {spec!r} (want name=v1,v2)")
+        name, _, vals = spec.partition("=")
+        out[name.strip()] = [_val(v) for v in vals.split(",") if v != ""]
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gauss-tune",
+        description="Offline autotuner: sweep the declared config space "
+                    "per (op, n-bucket, dtype, engine) on THIS hardware "
+                    "and persist the winners to the tuned store that "
+                    "bench, serve warmup, and the fleet consult.")
+    p.add_argument("--ops", default="lu_factor",
+                   help="comma-separated ops to sweep (default lu_factor; "
+                        f"known: {','.join(sorted(_MEASURERS))})")
+    p.add_argument("--ns", default="512,2048",
+                   help="comma-separated sizes (one store point per "
+                        "n-bucket; default 512,2048)")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--engine", default="blocked")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--reps", type=int, default=DEFAULT_REPS,
+                   help=f"timed repetitions per candidate (best-of; "
+                        f"default {DEFAULT_REPS})")
+    p.add_argument("--prune-ratio", type=float, default=DEFAULT_PRUNE_RATIO,
+                   help="abandon a candidate whose first rep exceeds this "
+                        "x the best-so-far (default "
+                        f"{DEFAULT_PRUNE_RATIO})")
+    p.add_argument("--axes", nargs="*", default=None, metavar="NAME=V1,V2",
+                   help="override candidate values per axis (e.g. "
+                        "panel=64,128 chunk=1,2); also admits axes that "
+                        "are declared but not swept by default")
+    p.add_argument("--sweep-all", action="store_true",
+                   help="include non-default axes (refine depth, vmem "
+                        "budget) in the grid")
+    p.add_argument("--store", default=None, metavar="PATH",
+                   help="store file to write (default: "
+                        "$GAUSS_TUNE_STORE or ~/.cache/gauss_tpu/"
+                        "tune_store.json)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="sweep and report, write nothing")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile cache for the sweep's own "
+                        "compiles (gauss_tpu.tune.compilecache)")
+    p.add_argument("--metrics-out", default=None, metavar="PATH")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the sweep summary (regress-ingestable: "
+                        "kind=tune_sweep)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append tuned s_per_solve / win_ratio records to "
+                        "the regression history (default "
+                        "reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate the sweep against the history baselines "
+                        "(exit 1 when out of band)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()
+    if args.compile_cache:
+        from gauss_tpu.tune import compilecache
+
+        compilecache.enable(args.compile_cache, export_env=False)
+    ops = [o for o in args.ops.split(",") if o]
+    ns = [int(n) for n in args.ns.split(",") if n]
+    axes = _parse_axes(args.axes) if args.axes else None
+
+    with obs.run(metrics_out=args.metrics_out, tool="gauss_tune",
+                 ops=args.ops, ns=args.ns) as rec:
+        summary = run_sweep(ops, ns, dtype=args.dtype, engine=args.engine,
+                            seed=args.seed, reps=args.reps,
+                            prune_ratio=args.prune_ratio, axes=axes,
+                            sweep_all=args.sweep_all, run_id=rec.run_id)
+    print(format_summary(summary))
+
+    if not args.dry_run:
+        store_path = args.store or _store.default_store_path()
+        write_store(summary, store_path)
+        print(f"store: {store_path} "
+              f"({len(summary['points'])} point(s) recorded)")
+        from gauss_tpu.tune import apply as _apply
+
+        _apply.reset_cache()  # this process may consult what it just wrote
+
+    if args.summary_json:
+        parent = os.path.dirname(args.summary_json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.summary_json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    from gauss_tpu.obs import regress
+
+    records = [{"metric": m, "value": v, "unit": u,
+                "source": f"tune:{summary.get('run_id')}", "kind": "tune"}
+               for m, v, u in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(records,
+                                         regress.load_history(history_path))
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = 1
+    if args.history is not None and records and rc == 0:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

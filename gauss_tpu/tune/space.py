@@ -1,0 +1,164 @@
+"""The tunable parameter space, with the hand constants as seed defaults.
+
+Every number here used to be a frozen constant somewhere else in the tree,
+each picked by ONE sweep on ONE machine (the reference repo does the same:
+CUDA ``BLOCK_SIZE``, Pthreads ``block_size=16`` cache tiling). This module
+is now their single source: the code imports its defaults FROM here, the
+tuner sweeps candidate values AROUND them, and the store persists per-
+hardware winners — so the seed defaults and the tuner's search space can
+never drift apart.
+
+Structure:
+
+- **Seed constants** — the historical hand-picked values, re-exported by
+  their original homes (``core.blocked.CHUNK_DEFAULT`` is now this
+  module's :data:`CHUNK_SEED`, etc.). Changing a seed here changes the
+  code default everywhere, which is the point.
+- **Axes** — per operation, the named tunable parameters with their seed
+  and the candidate values an offline sweep tries. Candidates are small
+  curated sets (the measured-plausible region), not open ranges: the
+  sweep's job is picking per-hardware among known-sane configs, not
+  exploring configs that are known to OOM or miscompile.
+
+This module is stdlib-only (no jax, no numpy) so it can be imported by
+anything, including kernel modules at load time and the CLI before the
+platform is pinned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+# -- seed constants (single source; original homes re-export) ---------------
+
+#: panels per chunked group (core.blocked.CHUNK_DEFAULT; picked by a single
+#: n=8192 sweep on v5e: 4 < 2 < 8 < 16).
+CHUNK_SEED = 4
+
+#: Pallas panel-kernel scoped-VMEM budget in bytes (core.blocked
+#: .PANEL_VMEM_BUDGET; calibrated from round-5 compile probes on v5e —
+#: a different chip generation gets a different usable scoped limit, which
+#: is exactly why it is a declared axis).
+PANEL_VMEM_BUDGET_SEED = 15_500_000
+
+#: narrow-panel per-row VMEM overhead floor: widths below the narrowest
+#: measured rung extrapolate conservatively as ``max(FLOOR, SCALE//panel)``
+#: (core.blocked.panel_fits_vmem; ADVICE r5 — the ~1/panel growth seen in
+#: the round-4 data).
+NARROW_PANEL_OVERHEAD_FLOOR = 220
+NARROW_PANEL_OVERHEAD_SCALE = 55_000
+
+#: panel sub-segment width for the Pallas panel kernel
+#: (kernels.panel_pallas.DEFAULT_SEG; 64 measured best on v5e).
+PANEL_SEG_SEED = 64
+
+#: Pallas matmul tile grid (bm, bn, bk)
+#: (kernels.matmul_pallas defaults; sweep_mm_tiles r4 on v5e).
+MM_TILE_SEED = (512, 512, 1024)
+
+#: row-elimination kernel tile (bm, bn) (kernels.rowelim_pallas defaults).
+ROWELIM_TILE_SEED = (256, 256)
+
+#: host-f64 refinement rounds per batched serve dispatch
+#: (serve.admission.ServeConfig.refine_steps).
+SERVE_REFINE_SEED = 1
+
+#: bucket ladder growth factor (serve.buckets pads to the power-of-two
+#: ladder; declared here so a future sweep can trade padding waste against
+#: executable count — growth 2.0 IS the pow2 policy).
+BUCKET_GROWTH_SEED = 2.0
+
+
+def narrow_panel_overhead(panel: int) -> int:
+    """Conservative per-row VMEM overhead for unmeasured narrow panel
+    widths (single source of the ``max(220, 55000//panel)`` floor)."""
+    return max(NARROW_PANEL_OVERHEAD_FLOOR,
+               NARROW_PANEL_OVERHEAD_SCALE // max(1, panel))
+
+
+# -- the declared space ------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Axis:
+    """One tunable parameter: its name, hand-picked seed, and the candidate
+    values an offline sweep tries (seed always included, tried first)."""
+
+    name: str
+    seed: Any
+    candidates: Tuple[Any, ...] = ()
+    #: swept by default by ``gauss-tune``? Axes that change numerics
+    #: (refine depth) or that encode hardware limits (vmem budget) are
+    #: declared — so the store can carry operator-set overrides — but only
+    #: swept when asked for explicitly.
+    sweep_default: bool = True
+
+    def values(self) -> Tuple[Any, ...]:
+        vals = [self.seed]
+        for c in self.candidates:
+            if c not in vals:
+                vals.append(c)
+        return tuple(vals)
+
+
+#: op name -> axes. ``None`` seeds mean "auto-resolved by the code"
+#: (e.g. panel=None routes through core.blocked.auto_panel); the sweep
+#: still tries the concrete candidates and the store records a concrete
+#: winner, which then SHORT-CIRCUITS the auto resolution.
+SPACES: Dict[str, Tuple[Axis, ...]] = {
+    # the blocked LU factorization — the headline hot path
+    "lu_factor": (
+        Axis("panel", None, (128, 256, 64)),
+        Axis("chunk", CHUNK_SEED, (2, 8, 16)),
+        Axis("refine_steps", 2, (1, 3), sweep_default=False),
+    ),
+    # the VMEM-resident panel kernel (TPU-only; CPU sweeps skip it)
+    "panel_kernel": (
+        Axis("seg", PANEL_SEG_SEED, (32, 128)),
+        Axis("vmem_budget", PANEL_VMEM_BUDGET_SEED, (), sweep_default=False),
+    ),
+    # the Pallas matmul tile grid
+    "matmul": (
+        Axis("bm", MM_TILE_SEED[0], (256, 1024)),
+        Axis("bn", MM_TILE_SEED[1], (256, 1024)),
+        Axis("bk", MM_TILE_SEED[2], (512, 2048)),
+    ),
+    # serve-layer knobs consulted at warmup (bucket growth is declared for
+    # operators; the pow2 ladder stays the only implemented policy)
+    "serve": (
+        Axis("refine_steps", SERVE_REFINE_SEED, (), sweep_default=False),
+        Axis("bucket_growth", BUCKET_GROWTH_SEED, (), sweep_default=False),
+    ),
+}
+
+
+def space_for(op: str) -> Tuple[Axis, ...]:
+    try:
+        return SPACES[op]
+    except KeyError:
+        raise KeyError(f"unknown tunable op {op!r}; options: "
+                       f"{sorted(SPACES)}") from None
+
+
+def seed_params(op: str) -> Dict[str, Any]:
+    """The hand-tuned defaults for ``op`` — what runs when no store
+    exists, and the reference point every sweep measures against."""
+    return {ax.name: ax.seed for ax in space_for(op)}
+
+
+def n_bucket(n: int) -> int:
+    """The size bucket a tuned config is keyed by: the next power of two
+    at or above ``n`` (mirrors serve.buckets so a tuned config and the
+    serving bucket that consults it agree on the boundary)."""
+    b = 1
+    while b < max(1, int(n)):
+        b <<= 1
+    return b
+
+
+def config_key(op: str, n: int, dtype: str = "float32",
+               engine: str = "blocked") -> str:
+    """The store key for (op, n-bucket, dtype, engine). Device kind is NOT
+    in the key — it lives in the store's environment fingerprint: one
+    store file describes one hardware epoch."""
+    return f"{op}/n{n_bucket(n)}/{dtype}/{engine}"

@@ -1,0 +1,206 @@
+"""Versioned on-disk store of tuned configs, keyed by hardware fingerprint.
+
+One JSON file holds the winning configs an offline sweep (``gauss-tune``)
+measured on THIS hardware::
+
+    {"version": 1,
+     "fingerprint": {"backend": "tpu", "device_kind": "TPU v5e",
+                     "device_count": 8, "jax": "0.4.37"},
+     "created_unix": 1754300000.0,
+     "configs": {
+        "lu_factor/n2048/float32/blocked": {
+            "params": {"panel": 256, "chunk": 4},
+            "seconds": 0.00148, "seed_seconds": 0.00165,
+            "source": "3f9a2c...", "swept_unix": 1754300000.0}}}
+
+Failure policy (the satellite contract): a corrupt / truncated / wrong-
+version / foreign-fingerprint store NEVER changes behavior — readers fall
+back to the seed defaults in :mod:`gauss_tpu.tune.space`. The typed
+:class:`TuneStoreError` is raised by the strict loader (:meth:`TuneStore
+.load`); the consult path (:mod:`gauss_tpu.tune.apply`) catches it, emits
+an obs ``tune`` event naming the reason, and proceeds on seeds.
+
+The fingerprint reuses the obs ``run_start`` environment fingerprint from
+PR 2 (:func:`gauss_tpu.obs.registry.environment_fingerprint`), reduced to
+the fields that change which config wins: backend, device kind/count, and
+the jax version (a jax upgrade can move compile behavior enough to retune).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from gauss_tpu.tune import space as _space
+
+STORE_VERSION = 1
+
+#: env channel naming the store file (same GAUSS_* pattern as GAUSS_FAULTS /
+#: GAUSS_COMPILE_CACHE — how serve processes and fleet worker subprocesses
+#: inherit a store they cannot be handed through an API).
+ENV_STORE = "GAUSS_TUNE_STORE"
+
+#: fingerprint fields that key a store to a hardware epoch.
+FINGERPRINT_KEYS = ("backend", "device_kind", "device_count", "jax")
+
+
+class TuneStoreError(RuntimeError):
+    """The store file on disk cannot be used: unreadable, corrupt JSON,
+    missing required fields, a future/unknown schema version, or a
+    fingerprint from different hardware. Consult paths catch this and
+    fall back to the seed defaults; strict tools (``gauss-tune ...``
+    operating ON a store) let it propagate."""
+
+
+def default_store_path() -> str:
+    """The store location: ``$GAUSS_TUNE_STORE`` when set, else a per-user
+    cache path (NOT inside the repo — a checkout must behave identically
+    on every machine until a sweep is run on it)."""
+    env = os.environ.get(ENV_STORE)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "gauss_tpu",
+                        "tune_store.json")
+
+
+def store_fingerprint() -> Dict[str, Any]:
+    """The reduced hardware fingerprint for store stamping/matching.
+    Reuses the obs environment fingerprint (never initializes a backend);
+    fields the current process cannot know yet are simply absent."""
+    from gauss_tpu.obs.registry import environment_fingerprint
+
+    fp = environment_fingerprint()
+    return {k: fp[k] for k in FINGERPRINT_KEYS if fp.get(k) is not None}
+
+
+def fingerprint_matches(stamped: Dict[str, Any],
+                        current: Optional[Dict[str, Any]] = None) -> bool:
+    """Does a store stamped with ``stamped`` apply to this process?
+    Strict on the fields BOTH sides know; a reader that has not
+    initialized a backend yet (no ``backend`` key) cannot prove a match,
+    so a backend-stamped store conservatively mismatches there."""
+    current = store_fingerprint() if current is None else current
+    for k in FINGERPRINT_KEYS:
+        if k in stamped and stamped[k] != current.get(k):
+            return False
+    return True
+
+
+class TuneStore:
+    """In-memory image of one store file (load -> mutate -> save)."""
+
+    def __init__(self, fingerprint: Optional[Dict[str, Any]] = None,
+                 configs: Optional[Dict[str, Dict[str, Any]]] = None,
+                 created_unix: Optional[float] = None):
+        self.version = STORE_VERSION
+        self.fingerprint = dict(fingerprint or {})
+        self.configs: Dict[str, Dict[str, Any]] = dict(configs or {})
+        self.created_unix = (time.time() if created_unix is None
+                             else created_unix)
+
+    # -- (de)serialization -------------------------------------------------
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {"version": self.version, "fingerprint": self.fingerprint,
+                "created_unix": self.created_unix, "configs": self.configs}
+
+    @classmethod
+    def from_doc(cls, doc: Any, path: str = "<doc>") -> "TuneStore":
+        if not isinstance(doc, dict):
+            raise TuneStoreError(f"tune store {path!r}: expected a JSON "
+                                 f"object, got {type(doc).__name__}")
+        version = doc.get("version")
+        if version != STORE_VERSION:
+            raise TuneStoreError(
+                f"tune store {path!r}: schema version {version!r} is not "
+                f"the supported version {STORE_VERSION} — re-run the sweep "
+                f"(gauss-tune) to regenerate it")
+        configs = doc.get("configs")
+        fingerprint = doc.get("fingerprint")
+        if not isinstance(configs, dict) or not isinstance(fingerprint,
+                                                           dict):
+            raise TuneStoreError(
+                f"tune store {path!r}: missing/invalid 'configs' or "
+                f"'fingerprint' field")
+        for key, entry in configs.items():
+            if (not isinstance(entry, dict)
+                    or not isinstance(entry.get("params"), dict)):
+                raise TuneStoreError(
+                    f"tune store {path!r}: config {key!r} has no valid "
+                    f"'params' dict")
+        store = cls(fingerprint=fingerprint, configs=configs,
+                    created_unix=doc.get("created_unix"))
+        return store
+
+    @classmethod
+    def load(cls, path) -> "TuneStore":
+        """Strict load: every failure shape is the typed
+        :class:`TuneStoreError` (original error chained), so callers hold
+        one except clause instead of OSError/ValueError/KeyError soup."""
+        path = os.fspath(path)
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            raise TuneStoreError(f"tune store {path!r}: cannot read: "
+                                 f"{e}") from e
+        try:
+            doc = json.loads(text)
+        except ValueError as e:
+            raise TuneStoreError(
+                f"tune store {path!r}: corrupt/truncated JSON ({e}) — "
+                f"falling back to seed defaults is safe; re-run "
+                f"gauss-tune to regenerate") from e
+        return cls.from_doc(doc, path)
+
+    def save(self, path) -> str:
+        """Atomic write (tmp + rename), stable key order — byte-identical
+        for identical content, so a re-run that finds the same winners
+        produces the same file (roundtrip determinism, tested)."""
+        path = os.fspath(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_doc(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    # -- config access -----------------------------------------------------
+
+    def put(self, op: str, n: int, params: Dict[str, Any],
+            dtype: str = "float32", engine: str = "blocked",
+            seconds: Optional[float] = None,
+            seed_seconds: Optional[float] = None,
+            source: Optional[str] = None) -> str:
+        key = _space.config_key(op, n, dtype, engine)
+        entry: Dict[str, Any] = {"params": dict(params),
+                                 "swept_unix": time.time()}
+        if seconds is not None:
+            entry["seconds"] = float(seconds)
+        if seed_seconds is not None:
+            entry["seed_seconds"] = float(seed_seconds)
+        if source:
+            entry["source"] = source
+        self.configs[key] = entry
+        return key
+
+    def get(self, op: str, n: int, dtype: str = "float32",
+            engine: str = "blocked") -> Optional[Dict[str, Any]]:
+        """The stored entry for the (op, n-bucket, dtype, engine) point,
+        or None."""
+        return self.configs.get(_space.config_key(op, n, dtype, engine))
+
+    def params(self, op: str, n: int, dtype: str = "float32",
+               engine: str = "blocked") -> Dict[str, Any]:
+        """Seed defaults overlaid with the stored winners for this point
+        (missing point -> pure seeds)."""
+        out = _space.seed_params(op)
+        entry = self.get(op, n, dtype, engine)
+        if entry:
+            out.update(entry["params"])
+        return out

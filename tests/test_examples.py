@@ -55,3 +55,11 @@ def test_structured_solve_runs():
     assert "engine=banded" in r.stdout
     assert "engine=blockdiag" in r.stdout
     assert "verified, not silently wrong" in r.stdout
+
+
+def test_tuned_serve_runs():
+    r = _run(["examples/tuned_serve.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sweep winner for lu_factor/n64/float32/blocked" in r.stdout
+    assert "served 6/6 ok, 0 incorrect" in r.stdout
+    assert "store consults during serve warmup: 1" in r.stdout

@@ -1,0 +1,347 @@
+"""gauss_tpu.tune: store semantics, consult fallbacks, sweep, compile cache.
+
+The store's failure contract is the heart of the suite: a corrupt, stale,
+or foreign store must NEVER change solver behavior — every degradation is
+a typed TuneStoreError internally and a seed-default fallback at the
+consult sites, with the reason visible as data.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.tune import apply, space, store
+from gauss_tpu.tune.store import TuneStore, TuneStoreError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def store_env(tmp_path, monkeypatch):
+    """Point the consult path at a per-test store location and isolate its
+    process-lifetime caches (including the jit caches, which bake tuned
+    trace-time resolutions into compiled programs)."""
+    path = tmp_path / "tune_store.json"
+    monkeypatch.setenv(store.ENV_STORE, str(path))
+    apply.reset_cache()
+    yield path
+    apply.reset_cache()
+    jax.clear_caches()
+
+
+def _current_store(configs=None) -> TuneStore:
+    jax.devices()  # make the backend fingerprint knowable
+    return TuneStore(fingerprint=store.store_fingerprint(),
+                     configs=configs or {})
+
+
+# -- store file semantics ----------------------------------------------------
+
+def test_store_roundtrip_determinism(tmp_path):
+    st = _current_store()
+    st.put("lu_factor", 2048, {"panel": 256, "chunk": 8},
+           seconds=0.0015, seed_seconds=0.0017, source="testrun")
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    st.save(p1)
+    loaded = TuneStore.load(p1)
+    assert loaded.to_doc() == st.to_doc()
+    loaded.save(p2)
+    assert p1.read_bytes() == p2.read_bytes()
+    assert loaded.params("lu_factor", 2000) == {
+        "panel": 256, "chunk": 8, "refine_steps": 2}
+    # a different n-bucket sees pure seeds
+    assert loaded.params("lu_factor", 4096) == space.seed_params("lu_factor")
+
+
+@pytest.mark.parametrize("payload", [
+    "{ not json at all",                      # corrupt
+    '{"version": 1, "configs": {"k": ',       # truncated mid-write
+    '{"version": 99, "configs": {}, "fingerprint": {}}',   # future schema
+    '{"version": 1, "fingerprint": {}}',      # missing configs
+    '{"version": 1, "configs": {"k": {"no_params": 1}}, '
+    '"fingerprint": {}}',                     # entry without params
+    "[1, 2, 3]",                              # wrong top-level type
+])
+def test_bad_store_raises_typed_and_falls_back(store_env, payload):
+    store_env.write_text(payload)
+    with pytest.raises(TuneStoreError):
+        TuneStore.load(store_env)
+    # The consult path degrades to seeds instead of raising...
+    assert apply.params_for("lu_factor", 2048) == \
+        space.seed_params("lu_factor")
+    assert apply.override("lu_factor", 2048, "panel") is None
+    # ...and names the reason.
+    status = apply.store_status()
+    assert not status["usable"]
+    assert status["reason"].startswith("store_error")
+
+
+def test_fingerprint_mismatch_falls_back(store_env):
+    jax.devices()
+    foreign = TuneStore(fingerprint={"backend": "tpu",
+                                     "device_kind": "TPU v99",
+                                     "device_count": 4096})
+    foreign.put("lu_factor", 2048, {"panel": 64})
+    foreign.save(store_env)
+    assert apply.override("lu_factor", 2048, "panel") is None
+    assert apply.store_status()["reason"] == "fingerprint_mismatch"
+    # The same entry under THIS environment's fingerprint is honored.
+    mine = _current_store(foreign.configs)
+    mine.save(store_env)
+    apply.reset_cache()
+    assert apply.override("lu_factor", 2048, "panel") == 64
+
+
+def test_absent_store_is_zero_change(store_env):
+    from gauss_tpu.core import blocked
+
+    assert not store_env.exists()
+    assert apply.store_status() == {"path": str(store_env),
+                                    "usable": False, "reason": "absent",
+                                    "configs": 0}
+    # the auto heuristics resolve exactly as before the tune subsystem
+    assert blocked.auto_panel(512) == blocked.DEFAULT_PANEL
+    assert blocked.auto_panel(2048) in (128, 256)
+    assert apply.params_for("lu_factor", 2048) == \
+        space.seed_params("lu_factor")
+
+
+def test_suspended_hides_a_good_store(store_env):
+    st = _current_store()
+    st.put("lu_factor", 1024, {"panel": 64})
+    st.save(store_env)
+    apply.reset_cache()
+    assert apply.override("lu_factor", 1024, "panel") == 64
+    with apply.suspended():
+        assert apply.override("lu_factor", 1024, "panel") is None
+        assert apply.params_for("lu_factor", 1024) == \
+            space.seed_params("lu_factor")
+    assert apply.override("lu_factor", 1024, "panel") == 64
+
+
+# -- consult integration -----------------------------------------------------
+
+def test_auto_panel_consults_store_and_announces(store_env):
+    from gauss_tpu.core import blocked
+
+    st = _current_store()
+    st.put("lu_factor", 2048, {"panel": 64, "chunk": 2})
+    st.save(store_env)
+    apply.reset_cache()
+    with obs.run(metrics_out=None, tool="tune_test") as rec:
+        assert blocked.auto_panel(2048) == 64
+        # same bucket, different n
+        assert blocked.auto_panel(1500) == 64
+        # untuned bucket keeps the heuristic
+        assert blocked.auto_panel(512) == blocked.DEFAULT_PANEL
+        evs = [e for e in rec.events if e.get("type") == "tune"]
+    assert evs and evs[0]["source"] == "store"
+    assert evs[0]["key"] == "lu_factor/n2048/float32/blocked"
+    assert rec.counters.get("tune.store_hits", 0) >= 1
+
+
+def test_vmem_budget_override_and_monkeypatch_priority(store_env,
+                                                       monkeypatch):
+    from gauss_tpu.core import blocked
+
+    # Without a store the module global governs — including monkeypatched
+    # values (the pre-existing kernel tests rely on this).
+    with monkeypatch.context() as m:
+        m.setattr(blocked, "PANEL_VMEM_BUDGET", 1024)
+        assert not blocked.panel_fits_vmem(4096, 128)
+    st = _current_store()
+    st.put("panel_kernel", 4096, {"vmem_budget": 10})
+    st.save(store_env)
+    apply.reset_cache()
+    assert not blocked.panel_fits_vmem(4096, 128)  # tuned budget: tiny
+    assert blocked.panel_fits_vmem(512, 128)       # other bucket: seed
+
+
+def test_serve_warmup_picks_up_tuned_panel(store_env):
+    from gauss_tpu.serve.cache import CacheKey, ExecutableCache
+
+    st = _current_store()
+    st.put("lu_factor", 32, {"panel": 16})
+    st.save(store_env)
+    apply.reset_cache()
+    key = CacheKey(bucket_n=32, nrhs=1, batch=1, dtype="float32",
+                   engine="blocked", refine_steps=0)
+    with obs.run(metrics_out=None, tool="tune_test") as rec:
+        cache = ExecutableCache(capacity=2)
+        exe = cache.get(key)
+        consults = [e for e in rec.events if e.get("type") == "tune"
+                    and e.get("source") == "store"]
+    assert exe.panel == 16
+    # tuning changes how the executable is BUILT, never which entry it is
+    assert exe.key == key
+    assert cache.keys() == [key]
+    assert consults
+    # the tuned executable still solves correctly at the bucket shape
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((1, 32, 32)) + 32 * np.eye(32)
+    b = rng.standard_normal((1, 32, 1))
+    x = exe.solve(a, b)
+    assert np.linalg.norm(a[0] @ x[0] - b[0]) < 1e-3
+
+
+def test_tuned_factor_bit_identical_to_explicit(store_env):
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    st = _current_store()
+    st.put("lu_factor", 80, {"panel": 16})
+    st.save(store_env)
+    apply.reset_cache()
+    jax.clear_caches()
+    rng = np.random.default_rng(11)
+    a = jnp.asarray(rng.standard_normal((80, 80)) + 80 * np.eye(80),
+                    jnp.float32)
+    fac_auto = blocked.lu_factor_blocked(a, panel=None)
+    fac_explicit = blocked.lu_factor_blocked(a, panel=16)
+    assert np.array_equal(np.asarray(fac_auto.m),
+                          np.asarray(fac_explicit.m))
+    assert np.array_equal(np.asarray(fac_auto.perm),
+                          np.asarray(fac_explicit.perm))
+
+
+# -- the sweep runner --------------------------------------------------------
+
+def test_runner_micro_sweep_writes_concrete_store(store_env):
+    from gauss_tpu.tune import runner
+
+    summary = runner.run_sweep(["lu_factor"], [48], seed=1234, reps=1,
+                               axes={"panel": [16, 32], "chunk": [1]},
+                               run_id="sweeptest")
+    assert summary["kind"] == "tune_sweep"
+    (point,) = summary["points"]
+    assert point["key"] == "lu_factor/n64/float32/blocked"
+    assert point["best_s"] > 0 and point["seed_s"] > 0
+    # winners are concretized: the auto seed config never pins "None"
+    assert point["best_params"]["panel"] is not None
+    runner.write_store(summary, store_env)
+    loaded = TuneStore.load(store_env)
+    entry = loaded.get("lu_factor", 48)
+    assert entry["source"] == "sweeptest"
+    assert entry["params"]["panel"] == point["best_params"]["panel"]
+    recs = runner.history_records(summary)
+    metrics = {m for m, _, _ in recs}
+    assert "tune:lu_factor/n64/float32:s_per_solve" in metrics
+    assert "tune:lu_factor/n64/float32:win_ratio" in metrics
+
+
+def test_sweep_is_independent_of_existing_store(store_env):
+    from gauss_tpu.tune import runner
+
+    st = _current_store()
+    st.put("lu_factor", 48, {"panel": 16})  # a pre-existing "winner"
+    st.save(store_env)
+    apply.reset_cache()
+    summary = runner.run_sweep(["lu_factor"], [48], seed=1234, reps=1,
+                               axes={"panel": [32], "chunk": [1]})
+    # the seed baseline measured the SEED policy, not the stored panel=16
+    assert summary["points"][0]["seed_params"]["panel"] is None
+
+
+def test_regress_ingests_tune_sweep_summary(tmp_path):
+    from gauss_tpu.obs import regress
+
+    doc = {"kind": "tune_sweep",
+           "points": [{"op": "lu_factor", "n": 96, "n_bucket": 128,
+                       "dtype": "float32", "engine": "blocked",
+                       "seed_s": 0.002, "best_s": 0.001,
+                       "best_params": {"panel": 64}}]}
+    path = tmp_path / "tune_summary.json"
+    path.write_text(json.dumps(doc))
+    recs = regress.ingest_file(path)
+    by_metric = {r["metric"]: r for r in recs}
+    assert by_metric["tune:lu_factor/n128/float32:s_per_solve"][
+        "value"] == 0.001
+    assert by_metric["tune:lu_factor/n128/float32:win_ratio"]["value"] == 0.5
+    assert all(r["kind"] == "tune" for r in recs)
+
+
+# -- observability -----------------------------------------------------------
+
+def test_summarize_tuning_section(store_env, tmp_path):
+    from gauss_tpu.core import blocked
+    from gauss_tpu.obs import summarize
+
+    st = _current_store()
+    st.put("lu_factor", 256, {"panel": 64})
+    st.save(store_env)
+    apply.reset_cache()
+    stream = tmp_path / "run.jsonl"
+    with obs.run(metrics_out=str(stream), tool="tune_test") as rec:
+        blocked.auto_panel(256)
+        run_id = rec.run_id
+    events = obs.read_events(stream)
+    tn = summarize.run_summary(events, run_id)["tuning"]
+    assert tn["store"]["hits"] == 1
+    assert tn["consults"][0]["key"] == "lu_factor/n256/float32/blocked"
+    assert tn["consults"][0]["source"] == "store"
+    text = summarize.summarize_run(events, run_id)
+    assert "tuning:" in text
+    assert "lu_factor/n256/float32/blocked" in text
+
+
+def test_xla_cache_listener_counts_into_obs():
+    from gauss_tpu.obs import compile as obs_compile
+
+    assert obs_compile.track_xla_cache()
+    with obs.run(metrics_out=None, tool="tune_test") as rec:
+        obs_compile._xla_cache_listener("/jax/compilation_cache/cache_hits")
+        obs_compile._xla_cache_listener(
+            "/jax/compilation_cache/cache_misses")
+        obs_compile._xla_cache_listener("/jax/unrelated/event")
+    assert rec.counters["xla.cache_hits"] == 1
+    assert rec.counters["xla.cache_misses"] == 1
+
+
+def test_compilecache_enable_and_env_channel(tmp_path, monkeypatch):
+    from gauss_tpu.tune import compilecache
+
+    cache_dir = tmp_path / "xla_cache"
+    monkeypatch.delenv(compilecache.ENV_CACHE_DIR, raising=False)
+    try:
+        got = compilecache.enable(str(cache_dir))
+        assert got == str(cache_dir)
+        assert compilecache.enabled()
+        assert compilecache.cache_dir() == str(cache_dir)
+        # the env channel is exported for subprocesses (fleet workers)
+        assert os.environ[compilecache.ENV_CACHE_DIR] == str(cache_dir)
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+    finally:
+        compilecache._enabled_dir = None
+        jax.config.update("jax_compilation_cache_dir", None)
+        os.environ.pop(compilecache.ENV_CACHE_DIR, None)
+
+
+def test_fleet_config_carries_compile_cache_dir():
+    from gauss_tpu.resilience.fleet import FleetConfig
+
+    cfg = FleetConfig(compile_cache_dir="/tmp/somewhere")
+    assert cfg.compile_cache_dir == "/tmp/somewhere"
+
+
+# -- the CI gate end to end (subprocess-heavy: slow) -------------------------
+
+@pytest.mark.slow
+def test_tune_check_gate_end_to_end(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "gauss_tpu.tune.check", "--n", "64",
+         "--reps", "1", "--tmpdir", str(tmp_path / "work"),
+         "--summary-json", str(tmp_path / "summary.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "warm start ok" in r.stdout
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    ws = summary["warm_start"]
+    assert ws["warm_compiles"] < ws["cold_compiles"]
