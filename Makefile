@@ -8,9 +8,10 @@ SERVE_SMOKE ?= /tmp/gauss_serve_check
 FAULTS_SMOKE ?= /tmp/gauss_faults_check
 STRUCT_SMOKE ?= /tmp/gauss_structure_check
 TUNE_SMOKE ?= /tmp/gauss_tune_check
+LIVE_SMOKE ?= /tmp/gauss_live_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
-	structure-check tune-check clean
+	structure-check tune-check live-check clean
 
 all: native
 
@@ -131,6 +132,30 @@ tune-check:
 	tn=[r['tuning'] for r in runs.values() if r.get('tuning')]; \
 	assert tn and tn[0]['store']['hits'] >= 1 and tn[0]['sweep']['points'] >= 1, tn; \
 	print('tune-check: tuning summary ok:', tn[0]['store'])"
+
+# The live-telemetry gate (CI-callable): a SolverServer with the live
+# plane embedded (ephemeral /metrics port) is driven by a small loadgen
+# mix; the Prometheus scrape totals must agree EXACTLY with the loadgen's
+# final report (served/rejected/expired/failed/retries), every terminal
+# status must fold into exactly one per-request trace, an on-demand
+# /trace?batches=1 capture from the RUNNING server must contain the
+# serve_batch_solve span, and a forced deadline-violation burst must FIRE
+# the SLO burn-rate alert which then CLEARS under good traffic — then the
+# recorded stream is asserted to carry the alert transitions, and
+# gauss-top renders one frame from the committed-format exposition.
+live-check:
+	rm -rf $(LIVE_SMOKE) && mkdir -p $(LIVE_SMOKE)
+	timeout -k 10 300 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.obs.livecheck --requests 40 --seed 258458 \
+	  --metrics-out $(LIVE_SMOKE)/live.jsonl \
+	  --summary-json $(LIVE_SMOKE)/summary.json
+	$(PYTHON) -m gauss_tpu.obs.summarize $(LIVE_SMOKE)/live.jsonl --json \
+	  | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	sl=[r['slo'] for r in runs.values() if r.get('slo')]; \
+	assert sl and sl[0]['alerts'] >= 1 and sl[0]['unresolved'] == 0, sl; \
+	print('live-check: slo summary ok:', sl[0])"
+	$(PYTHON) -m gauss_tpu.obs.requesttrace $(LIVE_SMOKE)/live.jsonl \
+	  --check > /dev/null
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -1,0 +1,89 @@
+"""Live telemetry quickstart: watch a serving process while it runs.
+
+Run on any backend (CPU works):
+
+    JAX_PLATFORMS=cpu python examples/live_serve.py
+
+Starts a SolverServer with the live plane embedded (ephemeral port),
+drives a little traffic, scrapes /metrics over HTTP like a Prometheus
+collector would, forces a deadline-violation burst so the SLO burn-rate
+alert fires (then clears), and folds the recorded stream into per-request
+span trees. See docs/OBSERVABILITY.md ("live telemetry") for the endpoint
+table and `gauss-top --help` for the interactive dashboard.
+"""
+
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.obs import requesttrace
+from gauss_tpu.obs.slo import SLO
+from gauss_tpu.serve import ServeConfig, SolverServer
+
+
+def system(rng, n):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)  # well-conditioned
+    return a, rng.standard_normal(n)
+
+
+def main():
+    rng = np.random.default_rng(258458)
+    # Tiny SLO windows so the fire/clear cycle fits in an example run.
+    slo = SLO(name="serve_ok", objective=0.95, short_window_s=1.5,
+              long_window_s=8.0, fire_burn=2.0, clear_burn=1.0, min_count=4)
+    cfg = ServeConfig(ladder=(64, 128), max_batch=8, refine_steps=1,
+                      verify_gate=1e-4, live_port=0, slos=(slo,))
+    with obs.run(tool="live_serve_example") as rec:
+        with SolverServer(cfg) as server:
+            url = server.live_url
+            print(f"live endpoint: {url}  (try: gauss-top --url {url})")
+
+            for _ in range(12):
+                a, b = system(rng, rng.choice([48, 100]))
+                assert server.solve(a, b).ok
+
+            text = urllib.request.urlopen(url + "/metrics").read().decode()
+            print("\n/metrics scrape (excerpt):")
+            for line in text.splitlines():
+                if line.startswith(("gauss_serve_served_total",
+                                    "gauss_serve_latency_s{",
+                                    "gauss_slo_firing")):
+                    print(f"  {line}")
+
+            print("\nforcing a deadline-violation burst ...")
+            for _ in range(10):
+                a, b = system(rng, 48)
+                server.submit(a, b, deadline_s=1e-6).result(30)
+            mon = server.live.slos[0]
+            print(f"slo alert firing = {mon.firing} "
+                  f"(burn short/long = {mon.burn_rates()[0]:.1f}x / "
+                  f"{mon.burn_rates()[1]:.1f}x)")
+
+            time.sleep(slo.short_window_s + 0.2)
+            while mon.firing:  # good traffic clears the alert
+                a, b = system(rng, 48)
+                server.solve(a, b)
+            print(f"slo alert cleared after good traffic "
+                  f"({mon.alerts} fired / {mon.clears} cleared)")
+
+    trees = requesttrace.request_traces(rec.events)
+    problems = requesttrace.check_traces(trees)
+    print(f"\nper-request traces: {len(trees)} request(s), "
+          f"{len(problems)} problem(s) — exactly one terminal each")
+    sample = next(t for t in trees.values() if t["status"] == "ok")
+    print(requesttrace.format_tree(sample))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,14 +39,21 @@ from gauss_tpu.obs.registry import Recorder, new_run_id, read_events  # noqa: F4
 from gauss_tpu.obs.spans import (  # noqa: F401
     active,
     counter,
+    current_trace,
     emit,
     gauge,
     histogram,
+    live_sink,
     record_span,
     run,
+    set_live_sink,
     span,
+    trace_context,
 )
 
-# NOTE: gauss_tpu.obs.summarize is deliberately NOT imported here — it is a
-# `python -m` entry point, and importing it from the package __init__ would
-# trip runpy's double-import warning.
+# NOTE: gauss_tpu.obs.summarize, .doctor, .requesttrace, and .top are
+# deliberately NOT imported here — they are `python -m` entry points, and
+# importing them from the package __init__ would trip runpy's double-import
+# warning. The live plane (obs.live / obs.slo / obs.export) is imported
+# lazily by its users (SolverServer --live-port, gauss-fleet --live-port)
+# so unobserved processes never pay for it.

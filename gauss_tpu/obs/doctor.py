@@ -1,0 +1,188 @@
+"""Span-tree diff between two recorded runs: WHERE did the time go?
+
+``python -m gauss_tpu.obs.doctor RUN_A RUN_B [--json] [--top N]``
+
+The ROADMAP's open perf item is exactly this question: the n=2048 solve
+was 1.476 ms in round 3 and 2.251 ms in round 5 — which PHASE absorbed the
++0.775 ms? Eyeballing two flat profiles answers it badly (ten numbers each,
+mental subtraction); this tool answers it directly: align the two runs'
+leaf-span profiles by phase name, attribute the wall-time delta to phases,
+and sort by **regression contribution** (largest slowdown first), flagging
+phases that only exist on one side (a hook compiled in, a phase renamed).
+
+``RUN_A`` / ``RUN_B`` are metrics JSONL paths, optionally suffixed
+``:RUN_ID`` to pick a run out of a multi-run file. A is the reference
+(before / fast), B the candidate (after / slow); positive delta = B is
+slower there.
+
+A committed example lives under ``reports/``: ``doctor_r3_vs_r5.json`` is
+the diff of the seeded round-3-like vs round-5-like streams
+(``doctor_r3like.jsonl`` / ``doctor_r5like.jsonl``), showing the host-
+stepped hook threading — not the factor math — absorbing the regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from gauss_tpu.obs import registry
+from gauss_tpu.obs.summarize import _runs, flat_profile
+
+
+def parse_target(target: str) -> Tuple[str, Optional[str]]:
+    """Split ``path[:run_id]``; tolerates Windows-style drive colons by
+    only treating the suffix as a run id when the prefix is a real file."""
+    if ":" in target:
+        path, _, rid = target.rpartition(":")
+        if path and os.path.exists(path):
+            return path, rid
+    return target, None
+
+
+def load_profile(target: str) -> Dict[str, Any]:
+    """Read one diff side: the flat profile plus identity metadata."""
+    path, rid = parse_target(target)
+    events = registry.read_events(path)
+    runs = _runs(events)
+    if not runs:
+        raise ValueError(f"no runs found in '{path}'")
+    rid = rid or runs[0]
+    if rid not in runs:
+        raise ValueError(f"run '{rid}' not in '{path}'; runs: "
+                         f"{', '.join(runs)}")
+    evs = [ev for ev in events if ev.get("run") == rid]
+    prof = flat_profile(evs)
+    start = next((ev for ev in evs if ev.get("type") == "run_start"), {})
+    return {"path": path, "run": rid, "tool": start.get("tool"),
+            "profile": prof}
+
+
+def diff_profiles(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """The span-tree diff document (the ``--json`` payload and the text
+    renderer's single source). Phases sorted by delta descending — the
+    top line IS the regression's biggest contributor."""
+    pa, pb = a["profile"], b["profile"]
+    names = sorted(set(pa["phases"]) | set(pb["phases"]))
+    span_delta = pb["span_total_s"] - pa["span_total_s"]
+    wall_a, wall_b = pa.get("wall_s"), pb.get("wall_s")
+    wall_delta = (wall_b - wall_a
+                  if isinstance(wall_a, (int, float))
+                  and isinstance(wall_b, (int, float)) else None)
+    phases: List[Dict[str, Any]] = []
+    for name in names:
+        ea = pa["phases"].get(name, {"seconds": 0.0, "calls": 0})
+        eb = pb["phases"].get(name, {"seconds": 0.0, "calls": 0})
+        delta = eb["seconds"] - ea["seconds"]
+        entry = {
+            "phase": name,
+            "a_s": round(ea["seconds"], 6), "b_s": round(eb["seconds"], 6),
+            "delta_s": round(delta, 6),
+            "share_of_delta": (round(delta / span_delta, 4)
+                               if span_delta else None),
+            "a_calls": ea["calls"], "b_calls": eb["calls"],
+            "a_per_call_s": (round(ea["seconds"] / ea["calls"], 9)
+                             if ea["calls"] else None),
+            "b_per_call_s": (round(eb["seconds"] / eb["calls"], 9)
+                             if eb["calls"] else None),
+            "only_in": ("b" if not ea["calls"] and eb["calls"] else
+                        "a" if ea["calls"] and not eb["calls"] else None),
+        }
+        phases.append(entry)
+    phases.sort(key=lambda p: -p["delta_s"])
+    return {
+        "kind": "span_diff",
+        "a": {k: a[k] for k in ("path", "run", "tool")},
+        "b": {k: b[k] for k in ("path", "run", "tool")},
+        "a_span_total_s": round(pa["span_total_s"], 6),
+        "b_span_total_s": round(pb["span_total_s"], 6),
+        "span_delta_s": round(span_delta, 6),
+        "a_wall_s": wall_a, "b_wall_s": wall_b,
+        "wall_delta_s": (round(wall_delta, 6)
+                         if wall_delta is not None else None),
+        "phases": phases,
+    }
+
+
+def _ms(v) -> str:
+    return "-" if not isinstance(v, (int, float)) else f"{v * 1e3:10.3f}"
+
+
+def format_diff(diff: Dict[str, Any], top: Optional[int] = None) -> str:
+    da, db = diff["a"], diff["b"]
+    sd = diff["span_delta_s"]
+    lines = [
+        f"span-tree diff: A={da['path']} (run {da['run']}) -> "
+        f"B={db['path']} (run {db['run']})",
+        f"  span totals: {diff['a_span_total_s'] * 1e3:.3f} -> "
+        f"{diff['b_span_total_s'] * 1e3:.3f} ms  "
+        f"(delta {sd * 1e3:+.3f} ms)"
+        + (f"; wall {diff['wall_delta_s'] * 1e3:+.3f} ms"
+           if diff.get("wall_delta_s") is not None else ""),
+        "",
+        "   delta_ms     %delta        A_ms        B_ms   calls A->B  phase",
+    ]
+    shown = diff["phases"][:top] if top else diff["phases"]
+    for p in shown:
+        share = (f"{100 * p['share_of_delta']:7.1f}%"
+                 if p["share_of_delta"] is not None else "       -")
+        note = f"  [only in {p['only_in'].upper()}]" if p["only_in"] else ""
+        lines.append(
+            f" {p['delta_s'] * 1e3:+10.3f}   {share}  {_ms(p['a_s'])}  "
+            f"{_ms(p['b_s'])}   {p['a_calls']:4d}->{p['b_calls']:<4d}"
+            f"  {p['phase']}{note}")
+    hidden = len(diff["phases"]) - len(shown)
+    if hidden > 0:
+        lines.append(f"  ... {hidden} more phase(s); rerun with --top 0")
+    worst = next((p for p in diff["phases"] if p["delta_s"] > 0), None)
+    if worst is not None and sd > 0:
+        lines.append("")
+        lines.append(
+            f"  biggest regression contributor: {worst['phase']} "
+            f"(+{worst['delta_s'] * 1e3:.3f} ms"
+            + (f", {100 * worst['share_of_delta']:.0f}% of the delta"
+               if worst["share_of_delta"] is not None else "") + ")")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.obs.doctor",
+        description="Diff two recorded runs' span trees: attribute the "
+                    "wall-time delta to phases, sorted by regression "
+                    "contribution.")
+    p.add_argument("run_a", help="reference stream: path[:run_id]")
+    p.add_argument("run_b", help="candidate stream: path[:run_id]")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full diff document as JSON")
+    p.add_argument("--top", type=int, default=12,
+                   help="phases to show in text mode (0 = all; default 12)")
+    p.add_argument("-o", "--out", default=None, metavar="PATH",
+                   help="also write the JSON diff here")
+    args = p.parse_args(argv)
+    try:
+        a = load_profile(args.run_a)
+        b = load_profile(args.run_b)
+    except (OSError, ValueError) as e:
+        print(f"doctor: {e}", file=sys.stderr)
+        return 2
+    diff = diff_profiles(a, b)
+    if args.out:
+        parent = os.path.dirname(args.out)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(diff, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(diff, indent=1, sort_keys=True))
+    else:
+        print(format_diff(diff, args.top or None))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

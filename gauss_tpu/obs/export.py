@@ -1,0 +1,249 @@
+"""/metrics exposition + the embedded live-telemetry HTTP plane.
+
+Pull-based exposition, the production-serving shape: the process embeds one
+stdlib ``http.server`` thread (no new dependencies) and anything — a
+Prometheus scraper, ``curl``, ``gauss-top`` — reads the live aggregator's
+rolling windows while the system runs. Endpoints:
+
+====================  =====================================================
+``/metrics``          Prometheus text exposition (v0.0.4): every counter as
+                      ``gauss_*_total``, gauges plain, rolling windows as
+                      summary quantiles + ``_count``/``_sum``, SLO burn
+                      rates/alert states with ``{slo=...}`` labels.
+``/healthz``          liveness JSON: uptime, counts, firing-alert count.
+``/slo``              full SLO monitor states as JSON.
+``/snapshot``         the raw aggregator snapshot as JSON (gauss-top's
+                      fallback; /metrics is the stable surface).
+``/trace?batches=N``  arm an on-demand capture, block until the running
+                      server has served N more batches (or ``timeout=S``),
+                      return the Chrome-trace JSON — the PR-2 exporter
+                      (obs.trace) pointed at a LIVE process instead of a
+                      flushed file. 409 when a capture is already armed.
+====================  =====================================================
+
+Metric name mangling is mechanical and stable: ``serve.cache.hits`` ->
+``gauss_serve_cache_hits_total``; the window ``span.serve_batch_solve.s``
+-> ``gauss_span_serve_batch_solve_s{quantile="0.5"}``. A scrape totals-
+match with the loadgen's final report is asserted by ``make live-check``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from gauss_tpu.obs.live import LiveAggregator
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_QUANTILES = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+
+
+def metric_name(name: str, prefix: str = "gauss") -> str:
+    """Flatten a dotted obs name into a legal Prometheus metric name."""
+    flat = _NAME_RE.sub("_", name.strip("."))
+    if flat and flat[0].isdigit():
+        flat = "_" + flat
+    return f"{prefix}_{flat}" if prefix else flat
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(snapshot: Dict[str, Any], prefix: str = "gauss") -> str:
+    """Render an aggregator snapshot as the Prometheus text format.
+
+    Deterministic (sorted by metric name) so the format has a golden test;
+    one ``# TYPE`` line per family, counters suffixed ``_total``, windows
+    rendered as summaries (quantile labels + _count/_sum)."""
+    lines = []
+
+    def family(name: str, typ: str, help_: Optional[str] = None):
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {typ}")
+
+    up = metric_name("live.uptime_s", prefix)
+    family(up, "gauge", "seconds since the live aggregator started")
+    lines.append(f"{up} {_fmt_value(snapshot.get('uptime_s', 0.0))}")
+
+    for name in sorted(snapshot.get("counters", {})):
+        m = metric_name(name, prefix) + "_total"
+        family(m, "counter")
+        lines.append(f"{m} {_fmt_value(snapshot['counters'][name])}")
+
+    for name in sorted(snapshot.get("gauges", {})):
+        m = metric_name(name, prefix)
+        family(m, "gauge")
+        lines.append(f"{m} {_fmt_value(snapshot['gauges'][name])}")
+
+    for name in sorted(snapshot.get("windows", {})):
+        win = snapshot["windows"][name]
+        m = metric_name(name, prefix)
+        family(m, "summary")
+        for key, q in _QUANTILES.items():
+            if win.get(key) is not None:
+                lines.append(f'{m}{{quantile="{q}"}} '
+                             f"{_fmt_value(win[key])}")
+        lines.append(f"{m}_count {_fmt_value(win.get('count', 0))}")
+        lines.append(f"{m}_sum {_fmt_value(win.get('sum', 0.0))}")
+
+    slos = snapshot.get("slo") or []
+    if slos:
+        burn = metric_name("slo.burn_rate", prefix)
+        family(burn, "gauge", "error-budget burn rate per SLO window")
+        firing = metric_name("slo.firing", prefix)
+        alerts = metric_name("slo.alerts", prefix) + "_total"
+        objective = metric_name("slo.objective", prefix)
+        viol = metric_name("slo.violation_rate", prefix)
+        for s in sorted(slos, key=lambda s: s.get("name", "")):
+            lines.append(f'{burn}{{slo="{s["name"]}",window="short"}} '
+                         f"{_fmt_value(s['burn_short'])}")
+            lines.append(f'{burn}{{slo="{s["name"]}",window="long"}} '
+                         f"{_fmt_value(s['burn_long'])}")
+        family(firing, "gauge", "1 while the SLO alert is firing")
+        for s in sorted(slos, key=lambda s: s.get("name", "")):
+            lines.append(f'{firing}{{slo="{s["name"]}"}} '
+                         f"{1 if s.get('firing') else 0}")
+        family(alerts, "counter")
+        for s in sorted(slos, key=lambda s: s.get("name", "")):
+            lines.append(f'{alerts}{{slo="{s["name"]}"}} '
+                         f"{_fmt_value(s.get('alerts', 0))}")
+        family(objective, "gauge")
+        for s in sorted(slos, key=lambda s: s.get("name", "")):
+            lines.append(f'{objective}{{slo="{s["name"]}"}} '
+                         f"{_fmt_value(s.get('objective', 0.0))}")
+        family(viol, "gauge")
+        for s in sorted(slos, key=lambda s: s.get("name", "")):
+            lines.append(f'{viol}{{slo="{s["name"]}"}} '
+                         f"{_fmt_value(s.get('violation_rate', 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "gauss-live/1"
+    agg: LiveAggregator = None  # type: ignore[assignment] # set per server
+
+    def log_message(self, fmt, *args):  # quiet: obs, not stdout noise
+        pass
+
+    def _reply(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):  # client went away
+            pass
+
+    def _json(self, code: int, payload) -> None:
+        self._reply(code, json.dumps(payload, sort_keys=True) + "\n",
+                    "application/json")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        agg = self.agg
+        if url.path == "/metrics":
+            agg.on_counter("live.scrapes")
+            self._reply(200, render_prometheus(agg.snapshot()),
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif url.path == "/healthz":
+            snap = agg.snapshot()
+            self._json(200, {
+                "status": "ok", "uptime_s": round(snap["uptime_s"], 3),
+                "counters": len(snap["counters"]),
+                "windows": len(snap["windows"]),
+                "slo_firing": sum(1 for s in snap["slo"]
+                                  if s.get("firing"))})
+        elif url.path == "/slo":
+            self._json(200, {"slo": agg.slo_status()})
+        elif url.path == "/snapshot":
+            self._json(200, agg.snapshot())
+        elif url.path == "/trace":
+            self._trace(parse_qs(url.query))
+        else:
+            self._json(404, {"error": f"unknown endpoint {url.path!r}",
+                             "endpoints": ["/metrics", "/healthz", "/slo",
+                                           "/snapshot", "/trace"]})
+
+    def _trace(self, q) -> None:
+        from gauss_tpu.obs import trace as _trace
+
+        try:
+            batches = int(q.get("batches", ["1"])[0])
+            timeout = float(q.get("timeout", ["30"])[0])
+        except ValueError as e:
+            self._json(400, {"error": f"bad query: {e}"})
+            return
+        try:
+            self.agg.start_capture(batches=batches)
+        except RuntimeError as e:
+            self._json(409, {"error": str(e)})
+            return
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+            return
+        complete = self.agg.wait_capture(timeout)
+        events = self.agg.finish_capture()
+        try:
+            doc = _trace.to_chrome_trace(events)
+        except ValueError as e:  # no spans arrived at all
+            self._json(408, {"error": f"capture timed out empty: {e}"})
+            return
+        doc["otherData"]["complete"] = complete
+        self._json(200 if complete else 206, doc)
+
+
+class LiveServer:
+    """The embedded telemetry endpoint: one daemon thread serving the
+    aggregator. ``port=0`` binds an ephemeral port (tests); read the bound
+    address back from :attr:`port` / :attr:`url`."""
+
+    def __init__(self, aggregator: LiveAggregator, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.agg = aggregator
+        handler = type("BoundHandler", (_Handler,), {"agg": aggregator})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "LiveServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="gauss-live",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "LiveServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

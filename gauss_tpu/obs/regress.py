@@ -133,6 +133,20 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "slo_report":
+        # A gauss-serve --slo-json report (or the nested "slo" dict of a
+        # live-plane loadgen summary, exported standalone): violation rate,
+        # worst burn rate, and alert count enter history so an SLO-health
+        # regression — the service spending its error budget faster —
+        # gates in CI exactly like a latency regression. Derivation lives
+        # with the SLO module (single source); the import is jax-free.
+        from gauss_tpu.obs.slo import history_records as slo_hist
+
+        for metric, value, unit in slo_hist(doc):
+            rec = _record(metric, value, path, "slo", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "fleet_solve":
         # A gauss-fleet --summary-json report: recovery depth (rung), resume
         # latency, and restart counts enter history so supervised-recovery

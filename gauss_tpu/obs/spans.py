@@ -7,6 +7,22 @@ gprof-style flat profile (aggregate by name) and a nesting-aware coverage
 check (leaf spans vs the root's duration). Spans measure HOST wall-clock;
 callers bounding device work must block/fetch before the span closes, same
 rule as ``PhaseTimer.phase(block_on=...)``.
+
+Two sinks hang off these hooks:
+
+- the **recorder** (per-run JSONL, post-hoc analysis) — one process-global
+  handed over by :func:`run`;
+- the **live sink** (:class:`gauss_tpu.obs.live.LiveAggregator`) — rolling-
+  window in-memory views the ``/metrics`` exposition serves while the
+  process runs. Installed by :func:`set_live_sink`; every hook forwards to
+  it with the same zero-cost-when-absent contract the recorder has (one
+  module-global read).
+
+Additionally, a thread-local **trace context** (:func:`trace_context`)
+stamps every event emitted inside it with a ``trace`` id, so request-scoped
+work that flows through library code with no trace parameter (the recovery
+ladder, handoff routing) still lands in the right per-request span tree
+(``gauss_tpu.obs.requesttrace``).
 """
 
 from __future__ import annotations
@@ -24,6 +40,7 @@ from gauss_tpu.obs import registry as _registry
 # cannot corrupt each other's nesting.
 _state_lock = threading.Lock()
 _active: Optional[_registry.Recorder] = None
+_live = None  # live sink (duck-typed: on_counter/on_gauge/... — see live.py)
 _tls = threading.local()
 
 
@@ -32,10 +49,49 @@ def active() -> Optional[_registry.Recorder]:
     return _active
 
 
+def live_sink():
+    """The installed live aggregator (None -> live forwarding no-ops)."""
+    return _live
+
+
+def set_live_sink(sink):
+    """Install ``sink`` as the process's live telemetry sink; returns the
+    previous sink so callers can restore it (the server install/uninstall
+    pair). ``None`` uninstalls. The sink receives ``on_counter``,
+    ``on_gauge``, ``on_histogram``, ``on_span``, and ``on_event`` calls
+    from the same hooks the recorder gets — in-band, no second
+    instrumentation path."""
+    global _live
+    with _state_lock:
+        prev = _live
+        _live = sink
+    return prev
+
+
 def _stack():
     if not hasattr(_tls, "stack"):
         _tls.stack = []
     return _tls.stack
+
+
+# -- trace context ---------------------------------------------------------
+
+def current_trace() -> Optional[str]:
+    """The trace id events on THIS thread are being stamped with."""
+    return getattr(_tls, "trace", None)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]):
+    """Stamp every event emitted on this thread inside the block with
+    ``trace=trace_id`` (unless the emit already carries one). Nests: the
+    innermost context wins, the outer one is restored on exit."""
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = trace_id
+    try:
+        yield
+    finally:
+        _tls.trace = prev
 
 
 @contextlib.contextmanager
@@ -71,35 +127,56 @@ def run(metrics_out=None, run_id: Optional[str] = None, **meta):
 
 
 def emit(type_: str, **fields):
-    """Record one event on the active recorder (no-op when inactive)."""
+    """Record one event on the active recorder and forward it to the live
+    sink (no-op when neither is present). Events emitted inside a
+    :func:`trace_context` are stamped with the context's trace id."""
     rec = _active
-    return rec.emit(type_, **fields) if rec is not None else None
+    ls = _live
+    if rec is None and ls is None:
+        return None
+    tid = getattr(_tls, "trace", None)
+    if tid is not None and "trace" not in fields and "traces" not in fields:
+        fields["trace"] = tid
+    ev = rec.emit(type_, **fields) if rec is not None else None
+    if ls is not None:
+        ls.on_event(type_, fields)
+    return ev
 
 
 def counter(name: str, inc: float = 1) -> None:
     rec = _active
     if rec is not None:
         rec.counter(name, inc)
+    ls = _live
+    if ls is not None:
+        ls.on_counter(name, inc)
 
 
 def gauge(name: str, value: float) -> None:
     rec = _active
     if rec is not None:
         rec.gauge(name, value)
+    ls = _live
+    if ls is not None:
+        ls.on_gauge(name, value)
 
 
 def histogram(name: str, value: float) -> None:
     rec = _active
     if rec is not None:
         rec.histogram(name, value)
+    ls = _live
+    if ls is not None:
+        ls.on_histogram(name, value)
 
 
 @contextlib.contextmanager
 def span(name: str, **attrs):
     """Time a named region; records a ``span`` event with parent/depth on
-    exit. Zero-cost (single global read) when no recorder is active."""
+    exit. Zero-cost (two global reads) when no sink is active."""
     rec = _active
-    if rec is None:
+    ls = _live
+    if rec is None and ls is None:
         yield
         return
     stack = _stack()
@@ -111,9 +188,15 @@ def span(name: str, **attrs):
     finally:
         dur = time.perf_counter() - t0
         stack.pop()
-        rec.emit("span", name=name, dur_s=round(dur, 6), parent=parent,
-                 depth=len(stack), **attrs)
-        rec.histogram(f"span.{name}.s", dur)
+        tid = getattr(_tls, "trace", None)
+        if tid is not None and "trace" not in attrs and "traces" not in attrs:
+            attrs = dict(attrs, trace=tid)
+        if rec is not None:
+            rec.emit("span", name=name, dur_s=round(dur, 6), parent=parent,
+                     depth=len(stack), **attrs)
+            rec.histogram(f"span.{name}.s", dur)
+        if ls is not None:
+            ls.on_span(name, dur, parent, len(stack), attrs)
 
 
 def record_span(name: str, seconds: float, parent: Optional[str] = None,
@@ -124,11 +207,15 @@ def record_span(name: str, seconds: float, parent: Optional[str] = None,
     currently open span of THIS thread, so these interleave correctly with
     ``with span(...)`` nesting."""
     rec = _active
-    if rec is None:
+    ls = _live
+    if rec is None and ls is None:
         return
     stack = _stack()
     if parent is None and stack:
         parent = stack[-1]
-    rec.emit("span", name=name, dur_s=round(float(seconds), 6),
-             parent=parent, depth=len(stack), **attrs)
-    rec.histogram(f"span.{name}.s", float(seconds))
+    if rec is not None:
+        rec.emit("span", name=name, dur_s=round(float(seconds), 6),
+                 parent=parent, depth=len(stack), **attrs)
+        rec.histogram(f"span.{name}.s", float(seconds))
+    if ls is not None:
+        ls.on_span(name, float(seconds), parent, len(stack), attrs)

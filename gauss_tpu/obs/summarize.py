@@ -227,6 +227,44 @@ def _serving_lines(sv: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def slo_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the live plane's ``alert`` transitions (obs.slo burn-rate
+    alerts) into per-SLO fire/clear counts with the last observed burn
+    rates. Empty dict when the run raised none — healthy runs carry no
+    alert noise."""
+    alerts = [ev for ev in events if ev.get("type") == "alert"]
+    if not alerts:
+        return {}
+    per: Dict[str, Dict[str, Any]] = {}
+    for ev in alerts:
+        name = str(ev.get("slo", "?"))
+        s = per.setdefault(name, {"fired": 0, "cleared": 0,
+                                  "last_state": None, "worst_burn": 0.0})
+        state = str(ev.get("state", "?"))
+        if state == "firing":
+            s["fired"] += 1
+        elif state == "clear":
+            s["cleared"] += 1
+        s["last_state"] = state
+        if isinstance(ev.get("burn_short"), (int, float)):
+            s["worst_burn"] = max(s["worst_burn"], float(ev["burn_short"]))
+    return {"slos": per,
+            "alerts": sum(s["fired"] for s in per.values()),
+            "unresolved": sum(1 for s in per.values()
+                              if s["last_state"] == "firing")}
+
+
+def _slo_lines(sl: Dict[str, Any]) -> List[str]:
+    lines = [f"  {sl['alerts']} alert(s) fired, "
+             f"{sl['unresolved']} still firing at run end"]
+    for name, s in sorted(sl["slos"].items()):
+        lines.append(f"  {name}: fired x{s['fired']}, cleared "
+                     f"x{s['cleared']}, worst short-window burn "
+                     f"{_fmt(s['worst_burn'])}x, last state "
+                     f"{s['last_state']}")
+    return lines
+
+
 def resilience_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the resilience layer's events (``fault`` injections from
     gauss_tpu.resilience.inject, ``recovery`` ladder steps from
@@ -510,6 +548,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "profile": flat_profile(evs),
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
         "serving": serving_summary(evs),
+        "slo": slo_summary(evs),
         "structure": structure_summary(evs),
         "resilience": resilience_summary(evs),
         "fleet": fleet_summary(evs),
@@ -564,6 +603,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("serving:")
         out.extend(_serving_lines(serving))
+
+    slo = slo_summary(evs)
+    if slo:
+        out.append("")
+        out.append("slo burn-rate alerts:")
+        out.extend(_slo_lines(slo))
 
     structure = structure_summary(evs)
     if structure:

@@ -432,12 +432,21 @@ def _supervise(cfg: FleetConfig, jobdir: str, a64, b64):
 
             replace: List[_Worker] = []
             degrade = False
+            obs.gauge("fleet.world", world)
             for w in workers:
                 rc = w.proc.poll()
                 if rc is None:
                     if not beaten.get(w.id) and _lease_fresh(jobdir, w):
                         beaten[w.id] = True
                         note_resume(w)
+                    # Heartbeat age as a live gauge per worker: the
+                    # supervisor's failure-detection input, scraped on
+                    # /metrics when the live plane is on (gauss-fleet
+                    # --live-port) so a stalling worker is visible before
+                    # the stall threshold kills it.
+                    obs.gauge(f"fleet.w{w.id}.heartbeat_age_s",
+                              round(time.monotonic()
+                                    - _last_activity(jobdir, w), 3))
                     # Freshness, not existence: a respawned worker still
                     # importing jax must get the startup grace even though
                     # its dead predecessor's lease file is present.
@@ -627,6 +636,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject-worker", type=int, default=None,
                    help="restrict --inject to this worker id (default all)")
     p.add_argument("--jobdir", default=None)
+    p.add_argument("--live-port", type=int, default=None, metavar="PORT",
+                   help="embed the live telemetry endpoint on PORT "
+                        "(0 = ephemeral): /metrics exposes per-worker "
+                        "heartbeat ages, world size, restart/stall/shrink "
+                        "counters while the supervised solve runs "
+                        "(read with gauss-top)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compile-cache dir shared by the "
                         "supervisor and every (re)spawned worker via the "
@@ -688,15 +703,33 @@ def main(argv=None) -> int:
                       job_timeout_s=args.job_timeout, inject=args.inject,
                       inject_worker=args.inject_worker, keep=args.keep,
                       compile_cache_dir=cache_dir)
+    live_server = live_prev = None
+    if args.live_port is not None:
+        from gauss_tpu.obs import export as _export
+        from gauss_tpu.obs import live as _live
+
+        agg = _live.LiveAggregator()
+        live_prev = _live.install(agg)
+        live_server = _export.LiveServer(agg, port=args.live_port).start()
+        print(f"live telemetry: {live_server.url}/metrics "
+              f"(watch with: gauss-top --url {live_server.url})")
+
     t0 = time.monotonic()
     error = None
-    with obs.run(metrics_out=args.metrics_out, tool="gauss_fleet",
-                 n=int(a.shape[0]), workers=args.workers) as rec:
-        run_id = rec.run_id
-        try:
-            res = solve_supervised(a, b, config=cfg, jobdir=args.jobdir)
-        except (FleetError, ValueError) as e:
-            error = e
+    try:
+        with obs.run(metrics_out=args.metrics_out, tool="gauss_fleet",
+                     n=int(a.shape[0]), workers=args.workers) as rec:
+            run_id = rec.run_id
+            try:
+                res = solve_supervised(a, b, config=cfg, jobdir=args.jobdir)
+            except (FleetError, ValueError) as e:
+                error = e
+    finally:
+        if live_server is not None:
+            live_server.stop()
+            from gauss_tpu.obs import live as _live
+
+            _live.uninstall(live_prev)
 
     if error is not None:
         print(f"gauss-fleet: FAILED (typed): {type(error).__name__}: "

@@ -23,7 +23,11 @@ crashing:
 
 Each escalation emits an obs ``recovery`` event (trigger, rung, attempt,
 outcome), so the summarizer's resilience section and the chaos campaign
-count recoveries from the stream. Only when every rung has failed does a
+count recoveries from the stream. When the caller runs under an
+``obs.trace_context`` (the serve worker wraps its recovery lane in the
+request's trace), every rung event is additionally stamped with that
+``trace`` id — the ladder shows up inside the request's span tree
+(``gauss_tpu.obs.requesttrace``) with no parameter threading here. Only when every rung has failed does a
 typed :class:`UnrecoverableSolveError` surface — the invariant the chaos
 campaign asserts is exactly "verified solution or this error, never a
 silent wrong answer".

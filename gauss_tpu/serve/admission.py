@@ -62,6 +62,20 @@ class ServeConfig:
     #                                 certified SPD batches the half-price
     #                                 Cholesky executable (see
     #                                 gauss_tpu.structure)
+    # -- live telemetry plane (gauss_tpu.obs.live / export / slo) ----------
+    live_port: Optional[int] = None  # serve /metrics etc. on this port
+    #                                  (0 = ephemeral; None = plane off —
+    #                                  the hot path pays nothing)
+    live_host: str = "127.0.0.1"    # bind address for the live endpoint
+    live_window: int = 1024         # rolling-window samples per series
+    slos: tuple = ()                # obs.slo.SLO definitions; () with the
+    #                                 live plane on -> the default serving
+    #                                 SLO (99% of requests terminate ok)
+    slo_shed: bool = False          # while an SLO alert FIRES, admit only
+    #                                 up to max_queue * degraded_queue_
+    #                                 factor — degradation starts before
+    #                                 the deadline cliff, not at it
+    degraded_queue_factor: float = 0.5  # admission bound scale under alert
 
 
 @dataclasses.dataclass
@@ -92,8 +106,14 @@ class ServeRequest:
     def __init__(self, a: np.ndarray, b: np.ndarray,
                  deadline_s: Optional[float] = None,
                  structure: Optional[str] = None):
+        from gauss_tpu.obs import requesttrace
+
         with ServeRequest._ids_lock:
             self.id = next(ServeRequest._ids)
+        #: end-to-end trace identity, minted at admission and carried by
+        #: every event this request touches (obs.requesttrace folds the
+        #: stream back into one span tree per request).
+        self.trace_id = requesttrace.mint()
         self.a = np.asarray(a)
         self.b = np.asarray(b)
         #: structure routing tag ("spd" / "banded" / "blockdiag" / "dense"),
@@ -149,7 +169,8 @@ class ServeRequest:
 
             obs.counter("serve.cancelled")
             obs.emit("serve_request", id=self.id, n=self.n,
-                     status=STATUS_CANCELLED, reason=error)
+                     trace=self.trace_id, status=STATUS_CANCELLED,
+                     reason=error)
         return won
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:

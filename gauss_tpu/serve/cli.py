@@ -74,6 +74,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "the GAUSS_COMPILE_CACHE env). A second process "
                         "sharing DIR warms up from cached executables — "
                         "the report's warmup_s shows the delta")
+    # -- live telemetry plane ---------------------------------------------
+    p.add_argument("--live-port", type=int, default=None, metavar="PORT",
+                   help="embed the live telemetry endpoint on PORT "
+                        "(0 = ephemeral): /metrics Prometheus exposition, "
+                        "/slo burn-rate alert states, /trace on-demand "
+                        "Chrome-trace capture; read it live with "
+                        "`gauss-top --url http://127.0.0.1:PORT`")
+    p.add_argument("--slo-shed", action="store_true",
+                   help="while an SLO burn-rate alert fires, shrink the "
+                        "admission bound (degradation before the deadline "
+                        "cliff); requires --live-port")
     # -- outputs ----------------------------------------------------------
     p.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="append the run's obs JSONL event stream here "
@@ -81,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--summary-json", default=None, metavar="PATH",
                    help="write the serving report as JSON (regress-"
                         "ingestable: kind=serve_loadgen)")
+    p.add_argument("--slo-json", default=None, metavar="PATH",
+                   help="write the run's SLO report as JSON (regress-"
+                        "ingestable: kind=slo_report; requires "
+                        "--live-port)")
     p.add_argument("--history", nargs="?", const="", default=None,
                    metavar="PATH",
                    help="append this run's throughput/latency records to "
@@ -122,7 +137,8 @@ def main(argv=None) -> int:
     serve_cfg = ServeConfig(
         ladder=ladder, max_batch=args.max_batch, max_queue=args.max_queue,
         batch_linger_s=args.linger, cache_capacity=args.cache_capacity,
-        refine_steps=args.refine_steps, panel=args.panel)
+        refine_steps=args.refine_steps, panel=args.panel,
+        live_port=args.live_port, slo_shed=args.slo_shed)
     cfg = LoadgenConfig(
         mix=args.mix, requests=args.requests, warmup=args.warmup,
         mode=args.mode, concurrency=args.concurrency, rate=args.rate,
@@ -132,6 +148,9 @@ def main(argv=None) -> int:
     with obs.run(metrics_out=args.metrics_out, tool="gauss_serve",
                  mode=args.mode, mix=args.mix, requests=args.requests):
         with SolverServer(serve_cfg) as server:
+            if server.live_url:
+                print(f"live telemetry: {server.live_url}/metrics "
+                      f"(watch with: gauss-top --url {server.live_url})")
             summary = run_load(server, cfg)
     print(format_summary(summary))
     if args.metrics_out:
@@ -140,6 +159,14 @@ def main(argv=None) -> int:
     if args.summary_json:
         write_summary(summary, args.summary_json)
         print(f"summary: {args.summary_json}")
+
+    if args.slo_json:
+        if summary.get("slo"):
+            write_summary(summary["slo"], args.slo_json)
+            print(f"slo report: {args.slo_json}")
+        else:
+            print("gauss-serve: --slo-json needs --live-port (no SLO "
+                  "monitors ran)", file=sys.stderr)
 
     rc = 0
     records = [{"metric": m, "value": v, "unit": "s",

@@ -229,6 +229,7 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
     warmup_s = time.perf_counter() - t_warm
     hits0, misses0 = server.cache.hits, server.cache.misses
     batches0 = server.batches
+    retries0 = server.retries
     rec = obs.active()
     occ_skip = (len(rec.histograms.get("serve.batch_occupancy", []))
                 if rec is not None else 0)
@@ -329,12 +330,21 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
         },
         "batch_occupancy_mean": round(occ, 4) if occ is not None else None,
         "batches": server.batches - batches0,
+        "retries": server.retries - retries0,
         "cache": {"hits": hits, "misses": misses,
                   "hit_rate": round(hits / lookups, 4) if lookups else None,
                   **{k: v for k, v in server.cache.stats().items()
                      if k in ("entries", "capacity", "evictions")}},
         "verify_gate": cfg.verify_gate,
     }
+    if getattr(server, "live", None) is not None:
+        # The live plane was on: fold its SLO monitors into the report.
+        # The nested dict is ALSO exportable standalone (gauss-serve
+        # --slo-json) as the regress-ingestable ``kind: slo_report``.
+        from gauss_tpu.obs import slo as _slo
+
+        summary["slo"] = _slo.slo_report(server.live.slos, mix=cfg.mix,
+                                         mode=cfg.mode)
     obs.emit("serve_loadgen", **{k: v for k, v in summary.items()
                                  if k != "kind"})
     for name, value in history_records(summary):
@@ -400,6 +410,15 @@ def format_summary(summary: Dict) -> str:
         f"{_s(summary['batch_occupancy_mean'])}",
         f"  cache: {cache['hits']} hits / {cache['misses']} misses "
         f"(hit-rate {_s(cache['hit_rate'])}), {cache['entries']} entries, "
-        f"{cache['evictions']} evictions",
+        f"{cache['evictions']} evictions"
+        + (f"; {summary['retries']} retried batch attempt(s)"
+           if summary.get("retries") else ""),
     ]
+    slo = summary.get("slo")
+    if slo:
+        lines.append(
+            f"  slo: {slo['violations']}/{slo['requests_counted']} "
+            f"violation(s) (rate {slo['violation_rate']:.4f}), worst burn "
+            f"{slo['worst_burn_rate']:.2f}x, {slo['alerts']} alert(s) "
+            f"fired / {slo['clears']} cleared")
     return "\n".join(lines)

@@ -22,6 +22,18 @@ Everything observable lands on the active obs recorder: per-request
 events (occupancy), cache/retry/fallback events, and the latency histogram —
 the summarizer's "serving" section and the loadgen report both read this one
 stream.
+
+With ``ServeConfig(live_port=...)`` the same stream ALSO feeds the live
+telemetry plane (gauss_tpu.obs.live): a rolling-window aggregator installed
+as the obs live sink and an embedded HTTP endpoint serving ``/metrics``
+(Prometheus text), ``/slo`` (burn-rate alert states), and ``/trace``
+(on-demand Chrome-trace capture of the next N batches) while the server
+runs. Every request is minted a ``trace_id`` at ``submit()`` and carries it
+through admission, batching, dispatch, retry, recovery, and handoff, so any
+terminal status folds back into one per-request span tree
+(gauss_tpu.obs.requesttrace). With ``slo_shed`` the admission path consults
+the firing SLO alerts and degrades EARLY (reduced queue bound) instead of
+riding into the deadline cliff.
 """
 
 from __future__ import annotations
@@ -76,12 +88,19 @@ class SolverServer:
         self._stop = threading.Event()
         self.batches = 0
         self.requests_served = 0
+        self.retries = 0                  # retried batch attempts (total)
+        #: the live telemetry plane (None until start() with a live_port)
+        self.live = None                  # obs.live.LiveAggregator
+        self._live_server = None          # obs.export.LiveServer
+        self._live_prev = None            # sink displaced by install()
 
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> "SolverServer":
         if self._worker is not None and self._worker.is_alive():
             return self
+        if self.config.live_port is not None and self._live_server is None:
+            self._start_live()
         self._stop.clear()
         with self._depth_lock:
             self._closed = False
@@ -89,6 +108,40 @@ class SolverServer:
                                         daemon=True)
         self._worker.start()
         return self
+
+    def _start_live(self) -> None:
+        """Bring up the live telemetry plane: aggregator installed as the
+        process obs live sink + the embedded HTTP endpoint. Lazy imports:
+        a server without a live_port never loads (or pays for) any of
+        this."""
+        from gauss_tpu.obs import export as _export
+        from gauss_tpu.obs import live as _live
+        from gauss_tpu.obs import slo as _slo
+
+        cfg = self.config
+        slos = cfg.slos or (_slo.default_serving_slo(),)
+        self.live = _live.LiveAggregator(window=cfg.live_window, slos=slos)
+        self._live_prev = _live.install(self.live)
+        self._live_server = _export.LiveServer(
+            self.live, port=cfg.live_port, host=cfg.live_host).start()
+        obs.emit("live", event="listening", url=self._live_server.url,
+                 slos=[m.slo.name for m in self.live.slos])
+
+    def _stop_live(self) -> None:
+        if self._live_server is not None:
+            self._live_server.stop()
+            self._live_server = None
+        if self.live is not None:
+            from gauss_tpu.obs import live as _live
+
+            _live.uninstall(self._live_prev)
+            self.live = None
+            self._live_prev = None
+
+    @property
+    def live_url(self) -> Optional[str]:
+        """The live endpoint base URL (None when the plane is off)."""
+        return self._live_server.url if self._live_server else None
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the worker; with ``drain`` (default) requests accepted
@@ -131,7 +184,9 @@ class SolverServer:
                                        error="server stopped")):
                 obs.counter("serve.rejected")
                 obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_REJECTED, reason="server_stopped")
+                         trace=req.trace_id, status=STATUS_REJECTED,
+                         reason="server_stopped")
+        self._stop_live()
 
     def __enter__(self) -> "SolverServer":
         return self.start()
@@ -144,7 +199,9 @@ class SolverServer:
     def _depth_add(self, d: int) -> int:
         with self._depth_lock:
             self._depth += d
-            return self._depth
+            depth = self._depth
+        obs.gauge("serve.queue_depth", depth)
+        return depth
 
     def _depth_snapshot(self) -> int:
         with self._depth_lock:
@@ -179,6 +236,16 @@ class SolverServer:
         if not self.config.structure_aware:
             structure = None
         req = ServeRequest(a, b, deadline_s=deadline_s, structure=structure)
+        # SLO-degraded admission (slo_shed): while a burn-rate alert FIRES,
+        # the effective queue bound shrinks, so load is turned away while
+        # the error budget is bleeding — shedding starts BEFORE the
+        # deadline cliff instead of at it. One boolean read when the live
+        # plane is off.
+        bound = self.config.max_queue
+        degraded = (self.config.slo_shed and self.live is not None
+                    and self.live.slo_firing())
+        if degraded:
+            bound = int(bound * self.config.degraded_queue_factor)
         # Admission is ONE critical section: the closed/full check and the
         # enqueue happen under the lock stop() closes admission under, so a
         # request is either enqueued strictly before the close (stop's
@@ -186,7 +253,7 @@ class SolverServer:
         # an accepted request can miss both and hang its client.
         with self._depth_lock:
             closed = self._closed
-            full = not closed and self._depth >= self.config.max_queue
+            full = not closed and self._depth >= bound
             if not closed and not full:
                 self._depth += 1
                 self._queue.put(req)
@@ -195,20 +262,30 @@ class SolverServer:
                                        error="server stopped")):
                 obs.counter("serve.rejected")
                 obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_REJECTED, reason="server_stopped")
+                         trace=req.trace_id, status=STATUS_REJECTED,
+                         reason="server_stopped")
             return req
         if full:
             hint = self.retry_after_hint()
+            reason = "slo_degraded" if degraded else "queue_full"
             if req.resolve(ServeResult(status=STATUS_REJECTED,
                                        retry_after_s=hint,
-                                       error="queue full")):
+                                       error="queue full"
+                                             + (" (slo degraded)"
+                                                if degraded else ""))):
                 obs.counter("serve.rejected")
+                if degraded:
+                    obs.counter("serve.slo_shed")
                 obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_REJECTED, reason="queue_full",
-                         retry_after_s=hint,
+                         trace=req.trace_id, status=STATUS_REJECTED,
+                         reason=reason, retry_after_s=hint,
                          queue_depth=self._depth_snapshot())
             return req
         obs.counter("serve.submitted")
+        obs.emit("serve_admit", id=req.id, trace=req.trace_id, n=req.n,
+                 k=req.k, queue_depth=self._depth_snapshot(),
+                 deadline_s=deadline_s,
+                 **({"structure": structure} if structure else {}))
         return req
 
     def solve(self, a, b, deadline_s: Optional[float] = None,
@@ -291,7 +368,7 @@ class SolverServer:
                                                  "compute")):
                     obs.counter("serve.expired")
                     obs.emit("serve_request", id=req.id, n=req.n,
-                             status=STATUS_EXPIRED)
+                             trace=req.trace_id, status=STATUS_EXPIRED)
             else:
                 live.append(req)
         if not live:
@@ -308,18 +385,26 @@ class SolverServer:
         bucket_n = buckets.bucket_for(reqs[0].n, self.ladder)
         nrhs = buckets.pow2_bucket(max(r.k for r in reqs))
         bb = buckets.pow2_bucket(len(reqs), cap=cfg.max_batch)
+        # Batch-level records carry the identity of EVERY member request
+        # (the trace_id list + the request count), so per-request serving
+        # percentiles and span trees are computable from per-batch spans —
+        # before this, serve_batch_* spans had no request identity at all.
+        traces = [r.trace_id for r in reqs]
         key = CacheKey(bucket_n=bucket_n, nrhs=nrhs, batch=bb,
                        dtype="float32", engine=cfg.engine,
                        refine_steps=cfg.refine_steps, mesh=None,
                        structure=reqs[0].structure)
 
-        if not self.health.device_allowed():
+        allowed = self.health.device_allowed()
+        obs.gauge("serve.breaker_open", 0.0 if allowed else 1.0)
+        if not allowed:
             obs.counter("serve.fallback_batches")
             for req in reqs:
                 self._serve_numpy(req)
             return
 
-        with obs.span("serve_batch_pad", bucket_n=bucket_n, batch=len(reqs)):
+        with obs.span("serve_batch_pad", bucket_n=bucket_n, batch=len(reqs),
+                      requests=len(reqs), traces=traces):
             a_pad = np.empty((bb, bucket_n, bucket_n), dtype=np.float64)
             b_pad = np.zeros((bb, bucket_n, nrhs), dtype=np.float64)
             for i, req in enumerate(reqs):
@@ -336,7 +421,8 @@ class SolverServer:
             try:
                 exe = self.cache.get(key, panel=cfg.panel)
                 with obs.span("serve_batch_solve", bucket_n=bucket_n,
-                              batch=len(reqs)):
+                              batch=len(reqs), requests=len(reqs),
+                              traces=traces):
                     x = exe.solve(a_pad, b_pad)
                 err = None
                 break
@@ -344,8 +430,10 @@ class SolverServer:
                 err = e
                 if not is_transient_device_error(e):
                     break
+                self.retries += 1
                 obs.counter("serve.retries")
                 obs.emit("serve_retry", attempt=attempt, bucket_n=bucket_n,
+                         requests=len(reqs), traces=traces,
                          error=f"{type(e).__name__}: {e}"[:200])
                 if attempt < cfg.max_retries:
                     time.sleep(retry_backoff(cfg.retry_backoff_s, attempt))
@@ -370,18 +458,21 @@ class SolverServer:
                         error=f"{type(err).__name__}: {err}")):
                     obs.counter("serve.failed")
                     obs.emit("serve_request", id=req.id, n=req.n,
-                             status=STATUS_FAILED, lane="batched",
+                             trace=req.trace_id, status=STATUS_FAILED,
+                             lane="batched",
                              error=f"{type(err).__name__}: {err}"[:200])
             return
 
         self.health.record_success()
+        obs.gauge("serve.breaker_open", 0.0)
         self.batches += 1
         occupancy = len(reqs) / bb
         obs.counter("serve.batches")
         obs.histogram("serve.batch_occupancy", occupancy)
         obs.emit("serve_batch", bucket_n=bucket_n, nrhs=nrhs,
                  batch=len(reqs), batch_bucket=bb, occupancy=occupancy,
-                 seconds=round(batch_s, 6),
+                 seconds=round(batch_s, 6), requests=len(reqs),
+                 traces=traces,
                  **({"structure": reqs[0].structure}
                     if reqs[0].structure else {}))
         for i, req in enumerate(reqs):
@@ -400,7 +491,11 @@ class SolverServer:
         cfg = self.config
         lane = "handoff"
         try:
-            with obs.span("serve_handoff", n=req.n):
+            # The trace context stamps every event emitted below us —
+            # solve_handoff's route decision, fleet supervision events —
+            # with this request's trace id, no parameter threading needed.
+            with obs.trace_context(req.trace_id), \
+                    obs.span("serve_handoff", n=req.n):
                 if cfg.supervised_handoff and req.was_vector:
                     from gauss_tpu.resilience import fleet
 
@@ -420,7 +515,7 @@ class SolverServer:
                                        error=f"{type(e).__name__}: {e}")):
                 obs.counter("serve.failed")
                 obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_FAILED, lane=lane,
+                         trace=req.trace_id, status=STATUS_FAILED, lane=lane,
                          error=f"{type(e).__name__}: {e}"[:200])
             return
         self._finish(req, np.asarray(x), lane=lane, bucket_n=None)
@@ -437,7 +532,11 @@ class SolverServer:
 
         gate = self.config.verify_gate or recover.DEFAULT_GATE
         try:
-            with obs.span("serve_numpy", n=req.n):
+            # recover.solve_resilient emits per-rung ``recovery`` events;
+            # the trace context stamps them with this request's identity so
+            # the recovery ladder shows up inside the request's span tree.
+            with obs.trace_context(req.trace_id), \
+                    obs.span("serve_numpy", n=req.n):
                 rr = recover.solve_resilient(
                     req.a.astype(np.float64), req.b.astype(np.float64),
                     gate=gate, rungs=("numpy_f64", "rank1"))
@@ -447,7 +546,8 @@ class SolverServer:
                                        error=f"{type(e).__name__}: {e}")):
                 obs.counter("serve.failed")
                 obs.emit("serve_request", id=req.id, n=req.n,
-                         status=STATUS_FAILED, lane="numpy",
+                         trace=req.trace_id, status=STATUS_FAILED,
+                         lane="numpy",
                          error=f"{type(e).__name__}: {e}"[:200])
             return
         self._finish(req, x, lane="numpy", bucket_n=None)
@@ -467,8 +567,9 @@ class SolverServer:
                               f"{self.config.verify_gate:.0e} verify gate")):
                     obs.counter("serve.failed")
                     obs.emit("serve_request", id=req.id, n=req.n,
-                             status=STATUS_FAILED, lane=lane,
-                             rel_residual=rel, error="verify gate")
+                             trace=req.trace_id, status=STATUS_FAILED,
+                             lane=lane, rel_residual=rel,
+                             error="verify gate")
                 return
         queue_s = time.perf_counter() - req.t_submit
         if not req.resolve(ServeResult(status=STATUS_OK, x=x, lane=lane,
@@ -479,5 +580,6 @@ class SolverServer:
         obs.counter("serve.served")
         obs.histogram("serve.latency_s", queue_s)
         obs.emit("serve_request", id=req.id, n=req.n, k=req.k,
-                 status=STATUS_OK, lane=lane, bucket_n=bucket_n,
-                 latency_s=round(queue_s, 6), rel_residual=rel)
+                 trace=req.trace_id, status=STATUS_OK, lane=lane,
+                 bucket_n=bucket_n, latency_s=round(queue_s, 6),
+                 rel_residual=rel)

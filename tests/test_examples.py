@@ -63,3 +63,14 @@ def test_tuned_serve_runs():
     assert "sweep winner for lu_factor/n64/float32/blocked" in r.stdout
     assert "served 6/6 ok, 0 incorrect" in r.stdout
     assert "store consults during serve warmup: 1" in r.stdout
+
+
+def test_live_serve_runs():
+    r = _run(["examples/live_serve.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "gauss_serve_served_total 12" in r.stdout
+    assert "slo alert firing = True" in r.stdout
+    assert "slo alert cleared after good traffic (1 fired / 1 cleared)" \
+        in r.stdout
+    assert "0 problem(s) — exactly one terminal each" in r.stdout
+    assert "serve_batch_solve" in r.stdout

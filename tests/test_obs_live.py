@@ -1,0 +1,647 @@
+"""Live telemetry plane tests: rolling-window/quantile math vs numpy, the
+Prometheus exposition format (golden), the embedded HTTP endpoints,
+end-to-end trace_id propagation (batched / retry->recovery / handoff —
+exactly one trace per request), SLO burn-rate alert hysteresis, SLO-
+degraded shedding, on-demand /trace capture from a running server,
+gauss-top --once, the doctor span diff, and the slo_report regress ingest.
+
+All CPU (conftest pins the platform); the module-scoped live server keeps
+the jitted-executable compiles to one small set shared across tests.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import doctor, regress, requesttrace, summarize
+from gauss_tpu.obs import export as obs_export
+from gauss_tpu.obs import live as obs_live
+from gauss_tpu.obs import top as obs_top
+from gauss_tpu.obs.slo import SLO, SLOMonitor, slo_report
+from gauss_tpu.serve import ServeConfig, SolverServer
+
+LADDER = (16, 32)
+
+
+def _system(rng, n):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _config(**over):
+    kw = dict(ladder=LADDER, max_batch=4, panel=16, refine_steps=1,
+              verify_gate=1e-4, live_port=0)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    with SolverServer(_config()) as srv:
+        yield srv
+
+
+# -- rolling windows / percentile sketch -----------------------------------
+
+def test_quantile_matches_numpy(rng):
+    vals = sorted(rng.standard_normal(257).tolist())
+    for q in (0.0, 0.25, 0.5, 0.95, 0.99, 1.0):
+        np.testing.assert_allclose(obs_live.quantile(vals, q),
+                                   np.quantile(vals, q), rtol=1e-12)
+    assert obs_live.quantile([], 0.5) is None
+    assert obs_live.quantile([7.0], 0.99) == 7.0
+
+
+def test_rolling_window_ring_and_quantiles(rng):
+    win = obs_live.RollingWindow(capacity=128, horizon_s=None)
+    vals = rng.standard_normal(500).tolist()
+    for v in vals:
+        win.add(v)
+    # the ring keeps exactly the LAST 128 observations
+    survivors = vals[-128:]
+    assert sorted(win.values()) == sorted(survivors)
+    assert win.count == 500
+    np.testing.assert_allclose(win.total, sum(vals))
+    got = win.quantiles((0.5, 0.95, 0.99))
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        np.testing.assert_allclose(got[key], np.quantile(survivors, q),
+                                   rtol=1e-12)
+
+
+def test_rolling_window_horizon_eviction():
+    win = obs_live.RollingWindow(capacity=64, horizon_s=10.0)
+    for i in range(5):
+        win.add(float(i), t=100.0 + i)   # t = 100..104
+    # at now=112, samples older than 102 have aged out
+    assert sorted(win.values(now=112.0)) == [2.0, 3.0, 4.0]
+    assert win.values(now=200.0) == []
+    with pytest.raises(ValueError):
+        obs_live.RollingWindow(capacity=0)
+
+
+def test_aggregator_counters_gauges_windows_and_rates():
+    agg = obs_live.LiveAggregator()
+    agg.on_counter("serve.served", 3)
+    agg.on_counter("serve.served", 2)
+    agg.on_gauge("serve.queue_depth", 7)
+    for v in (0.1, 0.2, 0.3, 0.4):
+        agg.on_histogram("serve.latency_s", v)
+    agg.on_span("factor", 0.5, None, 0, {})
+    snap = agg.snapshot()
+    assert snap["counters"]["serve.served"] == 5
+    assert snap["gauges"]["serve.queue_depth"] == 7
+    lat = snap["windows"]["serve.latency_s"]
+    assert lat["count"] == 4
+    np.testing.assert_allclose(lat["p50"], np.quantile([0.1, 0.2, 0.3, 0.4],
+                                                       0.5))
+    assert "span.factor.s" in snap["windows"]
+    # windowed rate: 5 increments over the last minute
+    assert agg.window_rate("serve.served", 60.0) == pytest.approx(5 / 60.0)
+    assert agg.window_rate("nope", 60.0) == 0.0
+
+
+def test_live_sink_receives_obs_hooks_without_recorder():
+    agg = obs_live.LiveAggregator()
+    prev = obs_live.install(agg)
+    try:
+        assert obs.active() is None  # no recorder — live sink alone
+        obs.counter("x.hits")
+        obs.gauge("x.depth", 2)
+        with obs.span("x_phase"):
+            pass
+        obs.emit("health", min_pivot=0.25, label="t")
+    finally:
+        obs_live.uninstall(prev)
+    snap = agg.snapshot()
+    assert snap["counters"]["x.hits"] == 1
+    assert snap["gauges"]["x.depth"] == 2
+    assert "span.x_phase.s" in snap["windows"]
+    # health events become live gauges
+    assert snap["gauges"]["health.min_pivot"] == 0.25
+    # uninstalled: hooks are no-ops again
+    obs.counter("x.hits")
+    assert agg.snapshot()["counters"]["x.hits"] == 1
+
+
+# -- exposition format (golden) --------------------------------------------
+
+def test_prometheus_exposition_golden():
+    agg = obs_live.LiveAggregator(slos=(SLO(),))
+    agg.on_counter("serve.served", 12)
+    agg.on_gauge("serve.queue_depth", 3)
+    agg.on_histogram("serve.latency_s", 0.25)
+    agg.on_histogram("serve.latency_s", 0.75)
+    snap = agg.snapshot()
+    snap["uptime_s"] = 1.5  # pin the only nondeterministic value
+    text = obs_export.render_prometheus(snap)
+    lines = text.splitlines()
+    assert "# TYPE gauss_live_uptime_s gauge" in lines
+    assert "gauss_live_uptime_s 1.5" in lines
+    assert "# TYPE gauss_serve_served_total counter" in lines
+    assert "gauss_serve_served_total 12" in lines
+    assert "gauss_serve_queue_depth 3" in lines
+    assert "# TYPE gauss_serve_latency_s summary" in lines
+    assert 'gauss_serve_latency_s{quantile="0.5"} 0.5' in lines
+    assert "gauss_serve_latency_s_count 2" in lines
+    assert "gauss_serve_latency_s_sum 1" in lines
+    assert 'gauss_slo_burn_rate{slo="serve_ok",window="short"} 0' in lines
+    assert 'gauss_slo_firing{slo="serve_ok"} 0' in lines
+    assert 'gauss_slo_objective{slo="serve_ok"} 0.99' in lines
+    assert text.endswith("\n")
+    # rendering is deterministic — the format is a stable scrape target
+    assert text == obs_export.render_prometheus(snap)
+    # and gauss-top's parser round-trips it
+    samples = obs_top.parse_metrics(text)
+    flat = {n: v for n, labels, v in samples if not labels}
+    assert flat["gauss_serve_served_total"] == 12
+    q = {labels["quantile"]: v for n, labels, v in samples
+         if n == "gauss_serve_latency_s" and labels}
+    assert q["0.5"] == 0.5
+
+
+def test_metric_name_mangling():
+    assert obs_export.metric_name("serve.cache.hits") == \
+        "gauss_serve_cache_hits"
+    assert obs_export.metric_name("span.serve_batch_solve.s") == \
+        "gauss_span_serve_batch_solve_s"
+    assert obs_export.metric_name("9weird-name") == "gauss__9weird_name"
+
+
+# -- SLO burn-rate alerts ---------------------------------------------------
+
+def test_slo_validation():
+    with pytest.raises(ValueError):
+        SLO(objective=1.0)
+    with pytest.raises(ValueError):
+        SLO(short_window_s=300.0, long_window_s=60.0)
+    with pytest.raises(ValueError):
+        SLO(fire_burn=1.0, clear_burn=1.0)
+
+
+def test_slo_burn_alert_fires_and_clears_with_hysteresis():
+    mon = SLOMonitor(SLO(objective=0.9, short_window_s=10.0,
+                         long_window_s=60.0, fire_burn=2.0, clear_burn=1.0,
+                         min_count=4))
+    t = 1000.0
+    transitions = []
+    # healthy traffic: no alert
+    for i in range(20):
+        tr = mon.observe("ok", now=t + i * 0.1)
+        assert tr is None
+    t += 2.0
+    # a violation burst: fires EXACTLY once (no flapping while it stays bad)
+    for i in range(10):
+        tr = mon.observe("expired", now=t + i * 0.1)
+        if tr:
+            transitions.append(tr)
+    assert [tr["state"] for tr in transitions] == ["firing"]
+    assert mon.firing and mon.alerts == 1
+    assert transitions[0]["burn_short"] >= 2.0
+    # good traffic inside the window: bad fraction decays but hysteresis
+    # holds the alert until burn_short <= clear_burn
+    t += 1.0
+    for i in range(60):
+        tr = mon.observe("ok", now=t + i * 0.05)
+        if tr:
+            transitions.append(tr)
+    t += 11.0  # bad observations age fully out of the short window
+    tr = mon.observe("ok", now=t)
+    if tr:
+        transitions.append(tr)
+    assert [tr["state"] for tr in transitions] == ["firing", "clear"]
+    assert not mon.firing and mon.clears == 1
+    assert mon.worst_burn >= 2.0
+
+
+def test_slo_min_count_and_long_window_guard():
+    # one early bad request must NOT page: min_count gates the short
+    # window, and the long window needs sustained burn.
+    mon = SLOMonitor(SLO(objective=0.99, short_window_s=10.0,
+                         long_window_s=60.0, fire_burn=2.0, clear_burn=1.0,
+                         min_count=4))
+    assert mon.observe("failed", now=100.0) is None
+    assert not mon.firing
+    # cancelled is ignored entirely (neither good nor bad)
+    mon.observe("cancelled", now=100.1)
+    assert mon.good + mon.bad == 1
+
+
+def test_slo_report_and_regress_ingest(tmp_path):
+    mon = SLOMonitor(SLO(objective=0.9, short_window_s=10.0,
+                         long_window_s=60.0, fire_burn=2.0, clear_burn=1.0,
+                         min_count=2))
+    t = 0.0
+    for status in ("ok", "ok", "expired", "expired", "expired", "ok"):
+        mon.observe(status, now=t)
+        t += 0.5
+    report = slo_report([mon], mix="test")
+    assert report["kind"] == "slo_report"
+    assert report["requests_counted"] == 6 and report["violations"] == 3
+    assert report["violation_rate"] == 0.5
+    assert report["alerts"] == 1
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(report))
+    recs = regress.ingest_file(path)
+    metrics = {r["metric"]: r for r in recs}
+    assert metrics["slo/violation_rate"]["value"] == 0.5
+    assert metrics["slo/worst_burn"]["value"] == report["worst_burn_rate"]
+    assert metrics["slo/alerts"]["value"] == 1.0
+    assert all(r["kind"] == "slo" for r in recs)
+    # roundtrip through a history file and gate a matching fresh epoch
+    hist = tmp_path / "history.jsonl"
+    for i in range(3):
+        epoch = [dict(r, source=f"epoch{i}") for r in recs]
+        regress.append_history(epoch, hist)
+    verdicts = regress.check_records(recs, regress.load_history(hist))
+    assert all(v["status"] in ("ok", "fast") for v in verdicts)
+
+
+# -- the embedded HTTP plane ------------------------------------------------
+
+def test_live_server_endpoints():
+    agg = obs_live.LiveAggregator(slos=(SLO(),))
+    agg.on_counter("serve.served", 2)
+    with obs_export.LiveServer(agg, port=0) as ls:
+        body = urllib.request.urlopen(ls.url + "/metrics").read().decode()
+        assert "gauss_serve_served_total 2" in body
+        health = json.loads(urllib.request.urlopen(
+            ls.url + "/healthz").read().decode())
+        assert health["status"] == "ok" and health["slo_firing"] == 0
+        slo = json.loads(urllib.request.urlopen(
+            ls.url + "/slo").read().decode())
+        assert slo["slo"][0]["name"] == "serve_ok"
+        snap = json.loads(urllib.request.urlopen(
+            ls.url + "/snapshot").read().decode())
+        assert snap["counters"]["serve.served"] == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(ls.url + "/nope")
+        assert ei.value.code == 404
+
+
+def test_server_metrics_totals_match_requests(live_server, rng):
+    agg = live_server.live
+    before = agg.snapshot()["counters"]
+    ok0 = before.get("serve.served", 0)
+    for n in (12, 20, 12):
+        a, b = _system(rng, n)
+        res = live_server.solve(a, b)
+        assert res.ok
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        flat = {name: v for name, labels, v in obs_top.parse_metrics(
+            urllib.request.urlopen(
+                live_server.live_url + "/metrics").read().decode())
+            if not labels}
+        if flat.get("gauss_serve_served_total", 0) >= ok0 + 3:
+            break
+        time.sleep(0.05)
+    assert flat["gauss_serve_served_total"] == ok0 + 3
+    assert "gauss_serve_latency_s_count" in flat
+    assert flat.get("gauss_serve_queue_depth", 0) == 0
+
+
+def test_on_demand_trace_capture_from_running_server(live_server, rng):
+    url = live_server.live_url
+    got = {}
+
+    def grab():
+        with urllib.request.urlopen(url + "/trace?batches=1&timeout=15",
+                                    timeout=20) as resp:
+            got["doc"] = json.loads(resp.read().decode())
+
+    t = threading.Thread(target=grab)
+    t.start()
+    time.sleep(0.2)
+    a, b = _system(rng, 12)
+    assert live_server.solve(a, b).ok
+    t.join(timeout=20)
+    doc = got["doc"]
+    spans = [ev for ev in doc["traceEvents"] if ev.get("ph") == "X"]
+    names = {ev["name"] for ev in spans}
+    assert "serve_batch_solve" in names
+    solve = next(ev for ev in spans if ev["name"] == "serve_batch_solve")
+    # the captured span carries request identity (the satellite bugfix)
+    assert solve["args"].get("requests") == 1
+    assert len(solve["args"].get("traces", [])) == 1
+    assert doc["otherData"]["complete"] is True
+    # bad query and double-arm behavior
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url + "/trace?batches=zero")
+    assert ei.value.code == 400
+
+
+def test_gauss_top_once_smoke(live_server, capsys):
+    rc = obs_top.main(["--url", live_server.live_url, "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gauss-top" in out and "requests:" in out and "cache:" in out
+    rc = obs_top.main(["--url", live_server.live_url, "--once", "--json"])
+    assert rc == 0
+    samples = json.loads(capsys.readouterr().out)
+    assert any(s["name"] == "gauss_serve_served_total" for s in samples)
+
+
+def test_gauss_top_unreachable_endpoint_exits_2(capsys):
+    rc = obs_top.main(["--url", "http://127.0.0.1:9", "--once"])
+    assert rc == 2
+    assert "cannot scrape" in capsys.readouterr().err
+
+
+# -- trace_id propagation ---------------------------------------------------
+
+def test_trace_propagation_batched_lane(live_server, rng):
+    with obs.run(tool="trace_test") as rec:
+        handles = []
+        for _ in range(3):
+            a, b = _system(rng, 12)
+            handles.append(live_server.submit(a, b))
+        results = [h.result(60) for h in handles]
+    assert all(r.ok for r in results)
+    trees = requesttrace.request_traces(rec.events)
+    mine = [trees[h.trace_id] for h in handles]
+    assert requesttrace.check_traces(
+        {h.trace_id: t for h, t in zip(handles, mine)}) == []
+    for tree in mine:
+        stages = [s["stage"] for s in tree["stages"]]
+        assert stages[0] == "serve_admit"
+        assert "serve_batch" in stages
+        assert "serve_batch_solve" in stages
+        assert tree["status"] == "ok" and tree["lane"] == "batched"
+        assert tree["terminal_count"] == 1
+        # batch spans are shared records: members see the share count
+        batch = next(s for s in tree["stages"]
+                     if s["stage"] == "serve_batch_solve")
+        assert batch.get("shared", 1) >= 1
+
+
+def test_trace_propagation_retry_recovery_exactly_one_trace(rng,
+                                                            monkeypatch):
+    # Device lane poisoned with a transient error: the request must flow
+    # admission -> retry -> numpy recovery lane, and the whole journey must
+    # fold into EXACTLY ONE trace carrying the retry + recovery stages.
+    server = SolverServer(_config(live_port=None, max_retries=1,
+                                  unhealthy_after=1000))
+    server.start()
+    try:
+        monkeypatch.setattr(
+            server.cache, "get",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("injected transient device error")))
+        with obs.run(tool="trace_retry") as rec:
+            a, b = _system(rng, 12)
+            h = server.submit(a, b)
+            res = h.result(60)
+    finally:
+        server.stop()
+    assert res.ok and res.lane == "numpy"
+    trees = requesttrace.request_traces(rec.events)
+    assert list(trees) == [h.trace_id]  # exactly one trace, the request's
+    tree = trees[h.trace_id]
+    stages = [s["stage"] for s in tree["stages"]]
+    assert "serve_admit" in stages
+    assert "serve_retry" in stages          # the poisoned device attempts
+    assert "serve_numpy" in stages          # the recovery lane, trace-bound
+    assert tree["terminal_count"] == 1 and tree["status"] == "ok"
+    assert requesttrace.check_traces(trees) == []
+
+
+def test_recovery_rung_events_stamped_by_trace_context():
+    # A rung-0 success emits no recovery noise by design; force the ladder
+    # to escalate (singular system) and assert every emitted recovery rung
+    # carries the surrounding trace context — the mechanism by which the
+    # serve numpy lane's ladder lands inside the request's span tree.
+    from gauss_tpu.resilience import recover
+
+    a = np.zeros((4, 4))
+    b = np.ones(4)
+    with obs.run(tool="rung_trace") as rec:
+        with obs.trace_context("rung-tid"):
+            with pytest.raises(recover.UnrecoverableSolveError):
+                recover.solve_resilient(a, b, rungs=("numpy_f64",))
+    rungs = [ev for ev in rec.events if ev.get("type") == "recovery"]
+    assert rungs and all(ev.get("trace") == "rung-tid" for ev in rungs)
+    tree = requesttrace.request_traces(rec.events)["rung-tid"]
+    assert "recovery" in [s["stage"] for s in tree["stages"]]
+
+
+def test_trace_propagation_handoff_lane(rng):
+    server = SolverServer(_config(live_port=None))
+    server.start()
+    try:
+        with obs.run(tool="trace_handoff") as rec:
+            a, b = _system(rng, 40)  # past the (16, 32) ladder top
+            h = server.submit(a, b)
+            res = h.result(120)
+    finally:
+        server.stop()
+    assert res.ok and res.lane == "handoff"
+    trees = requesttrace.request_traces(rec.events)
+    tree = trees[h.trace_id]
+    stages = [s["stage"] for s in tree["stages"]]
+    assert "serve_handoff" in stages
+    assert "route" in stages  # solve_handoff's decision, trace-stamped
+    assert tree["terminal_count"] == 1
+
+
+def test_rejected_and_expired_requests_carry_traces(rng):
+    server = SolverServer(_config(live_port=None, max_queue=1))
+    # NOT started: the queue fills and deadline requests expire untouched
+    with obs.run(tool="trace_reject") as rec:
+        a, b = _system(rng, 12)
+        h1 = server.submit(a, b)              # occupies the queue
+        h2 = server.submit(a, b)              # queue full -> rejected
+        assert h2.result(5).status == "rejected"
+        server.start()
+        assert h1.result(30).ok
+        server.stop()
+    trees = requesttrace.request_traces(rec.events)
+    assert trees[h2.trace_id]["status"] == "rejected"
+    assert trees[h2.trace_id]["terminal_count"] == 1
+    assert requesttrace.check_traces(trees) == []
+
+
+def test_requesttrace_cli(tmp_path, capsys):
+    path = tmp_path / "stream.jsonl"
+    with obs.run(metrics_out=path, tool="cli_test"):
+        obs.emit("serve_admit", id=1, trace="t1", n=8, queue_depth=1)
+        obs.emit("serve_request", id=1, trace="t1", n=8, status="ok",
+                 lane="batched", latency_s=0.01)
+    rc = requesttrace.main([str(path), "--check"])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "trace t1" in out.out and "status=ok" in out.out
+    assert "0 problem(s)" in out.err
+    rc = requesttrace.main([str(path), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["t1"]["status"] == "ok"
+    # a trace with no terminal fails --check
+    with obs.run(metrics_out=path, tool="cli_test2"):
+        obs.emit("serve_admit", id=2, trace="t2", n=8)
+    assert requesttrace.main([str(path), "--check"]) == 1
+
+
+# -- SLO-degraded shedding --------------------------------------------------
+
+def test_slo_shed_degrades_admission_before_the_cliff(rng):
+    server = SolverServer(_config(slo_shed=True, degraded_queue_factor=0.0))
+    server.start()
+    try:
+        mon = server.live.slos[0]
+        a, b = _system(rng, 12)
+        assert server.solve(a, b).ok           # healthy: admitted
+        mon.firing = True                      # force the alert state
+        with obs.run(tool="shed_test") as rec:
+            h = server.submit(a, b)
+            res = h.result(5)
+        assert res.status == "rejected"
+        assert "slo degraded" in res.error
+        ev = next(ev for ev in rec.events
+                  if ev.get("type") == "serve_request"
+                  and ev.get("id") == h.id)
+        assert ev["reason"] == "slo_degraded"
+        mon.firing = False                     # alert cleared: admitted again
+        assert server.solve(a, b).ok
+    finally:
+        server.stop()
+
+
+# -- loadgen + live plane ---------------------------------------------------
+
+def test_loadgen_report_with_live_plane_includes_slo_and_retries(
+        live_server):
+    from gauss_tpu.serve.loadgen import (LoadgenConfig, format_summary,
+                                         run_load)
+
+    cfg = LoadgenConfig(mix="random:12*2,random:20", requests=8, warmup=2,
+                        concurrency=2, seed=7, serve=live_server.config)
+    summary = run_load(live_server, cfg)
+    assert summary["counts"]["ok"] == 8 and summary["incorrect"] == 0
+    assert summary["retries"] == 0
+    slo = summary["slo"]
+    assert slo["kind"] == "slo_report"
+    assert slo["requests_counted"] >= 8
+    text = format_summary(summary)
+    assert "slo:" in text and "worst burn" in text
+
+
+# -- summarize slo section --------------------------------------------------
+
+def test_summarize_slo_alert_section(tmp_path, capsys):
+    path = tmp_path / "alerts.jsonl"
+    with obs.run(metrics_out=path, tool="slo_sum"):
+        obs.emit("alert", slo="serve_ok", state="firing", burn_short=5.2,
+                 burn_long=3.1)
+        obs.emit("alert", slo="serve_ok", state="clear", burn_short=0.2,
+                 burn_long=1.0)
+    events = obs.read_events(path)
+    sl = summarize.slo_summary(events)
+    assert sl["alerts"] == 1 and sl["unresolved"] == 0
+    assert sl["slos"]["serve_ok"]["worst_burn"] == 5.2
+    assert summarize.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "slo burn-rate alerts:" in out and "fired x1" in out
+    assert summarize.main([str(path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    (run_doc,) = doc.values()
+    assert run_doc["slo"]["alerts"] == 1
+
+
+# -- doctor: span-tree diff -------------------------------------------------
+
+def _write_stream(path, tool, phases, repeat=1):
+    with obs.run(metrics_out=path, tool=tool) as rec:
+        for _ in range(repeat):
+            for name, dur in phases:
+                obs.record_span(name, dur)
+    return rec.run_id
+
+
+def test_doctor_attributes_regression_by_contribution(tmp_path):
+    a_path = tmp_path / "r3.jsonl"
+    b_path = tmp_path / "r5.jsonl"
+    _write_stream(a_path, "bench_a",
+                  [("factor", 0.0010), ("solve", 0.0003),
+                   ("refine", 0.0002)])
+    _write_stream(b_path, "bench_b",
+                  [("factor", 0.0014), ("solve", 0.0003),
+                   ("refine", 0.0002), ("host_hooks", 0.0004)])
+    diff = doctor.diff_profiles(doctor.load_profile(str(a_path)),
+                                doctor.load_profile(str(b_path)))
+    assert diff["kind"] == "span_diff"
+    np.testing.assert_allclose(diff["span_delta_s"], 0.0008, atol=1e-9)
+    # sorted by regression contribution: the two slowdowns lead
+    top2 = {p["phase"] for p in diff["phases"][:2]}
+    assert top2 == {"factor", "host_hooks"}
+    hooks = next(p for p in diff["phases"] if p["phase"] == "host_hooks")
+    assert hooks["only_in"] == "b" and hooks["a_calls"] == 0
+    factor = next(p for p in diff["phases"] if p["phase"] == "factor")
+    np.testing.assert_allclose(factor["delta_s"], 0.0004, atol=1e-9)
+    assert factor["share_of_delta"] == 0.5
+    unchanged = next(p for p in diff["phases"] if p["phase"] == "solve")
+    assert unchanged["delta_s"] == 0.0 and unchanged["only_in"] is None
+
+
+def test_doctor_cli_text_json_and_run_selection(tmp_path, capsys):
+    a_path = tmp_path / "a.jsonl"
+    _write_stream(a_path, "t", [("factor", 0.001)])
+    rid_b = _write_stream(a_path, "t", [("factor", 0.002)])  # same file
+    rc = doctor.main([str(a_path), f"{a_path}:{rid_b}"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "span-tree diff" in out
+    assert "biggest regression contributor: factor" in out
+    out_json = tmp_path / "diff.json"
+    rc = doctor.main([str(a_path), f"{a_path}:{rid_b}", "--json",
+                      "-o", str(out_json)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == json.loads(out_json.read_text())
+    assert doc["b"]["run"] == rid_b
+    # bad inputs are typed, not tracebacks
+    assert doctor.main([str(a_path) + ":nope", str(a_path)]) == 2
+    assert doctor.main([str(tmp_path / "missing.jsonl"), str(a_path)]) == 2
+
+
+# -- the hooks stay zero-cost when everything is off ------------------------
+
+def test_hooks_noop_without_recorder_or_live_sink():
+    # the module live_server fixture may hold the sink — detach it for the
+    # duration so the disabled state is actually exercised
+    prev = obs.set_live_sink(None)
+    try:
+        assert obs.active() is None and obs.live_sink() is None
+        assert obs.emit("anything", x=1) is None
+        obs.counter("nope")
+        obs.gauge("nope", 1)
+        obs.histogram("nope", 1)
+        with obs.span("nope"):
+            pass
+        with obs.trace_context("tid"):
+            assert obs.current_trace() == "tid"
+            assert obs.emit("anything") is None
+        assert obs.current_trace() is None
+    finally:
+        obs.set_live_sink(prev)
+
+
+# -- the whole gate, end to end (the make live-check path) ------------------
+
+@pytest.mark.slow
+def test_livecheck_gate_end_to_end(tmp_path):
+    from gauss_tpu.obs import livecheck
+
+    rc = livecheck.main(["--requests", "16", "--burst", "8",
+                         "--metrics-out", str(tmp_path / "live.jsonl"),
+                         "--summary-json", str(tmp_path / "summary.json")])
+    assert rc == 0
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    assert summary["slo"]["alerts"] >= 1 and not summary["slo"]["firing"]
+    assert summary["traces"] >= 16
