@@ -9,9 +9,10 @@ FAULTS_SMOKE ?= /tmp/gauss_faults_check
 STRUCT_SMOKE ?= /tmp/gauss_structure_check
 TUNE_SMOKE ?= /tmp/gauss_tune_check
 LIVE_SMOKE ?= /tmp/gauss_live_check
+ABFT_SMOKE ?= /tmp/gauss_abft_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
-	structure-check tune-check live-check clean
+	structure-check tune-check live-check abft-check clean
 
 all: native
 
@@ -156,6 +157,33 @@ live-check:
 	print('live-check: slo summary ok:', sl[0])"
 	$(PYTHON) -m gauss_tpu.obs.requesttrace $(LIVE_SMOKE)/live.jsonl \
 	  --check > /dev/null
+
+# The ABFT gate (CI-callable): the silent-data-corruption smoke campaign —
+# >= 100 seeded on-device sdc_bitflip faults injected at panel-group
+# boundaries of the checksum-carrying LU and Cholesky engines; every
+# corruption must be DETECTED by the checksum invariant before the final
+# residual gate, localized to its panel group, and recovered via the
+# localized replay rung (bit-identical to an uninterrupted ABFT run) or
+# ladder escalation for persistent faults (exit 2 on a missed detection,
+# silent wrong answer, or bit-identity failure). The identity phase
+# asserts abft=False paths stay BIT-IDENTICAL to the checksum-carrying
+# forms' factors and records the plain-path s_per_solve as the
+# zero-overhead regression sentinel (exit 1 when it leaves the noise
+# band); the matmul phase asserts single-element GEMM corruption is
+# corrected in place from the row x column checksum intersection. Then
+# the recorded stream is asserted to carry an sdc summary.
+abft-check:
+	rm -rf $(ABFT_SMOKE) && mkdir -p $(ABFT_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.resilience.abftcheck \
+	  --cases 110 --seed 258458 \
+	  --metrics-out $(ABFT_SMOKE)/abft.jsonl \
+	  --summary-json $(ABFT_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(ABFT_SMOKE)/abft.jsonl --json \
+	  | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	sd=[r['sdc'] for r in runs.values() if r.get('sdc')]; \
+	assert sd and sd[0]['detections']['total'] >= 100 \
+	  and sd[0]['injected']['total'] >= 100, sd; \
+	print('abft-check: sdc summary ok:', sd[0]['detections'])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

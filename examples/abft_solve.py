@@ -1,0 +1,91 @@
+"""ABFT quickstart: detect, localize, and repair silent data corruption
+MID-solve with checksum-carrying factorizations.
+
+Run on any backend (CPU works):
+
+    JAX_PLATFORMS=cpu python examples/abft_solve.py
+
+Solves the same system three ways — clean ABFT (checksum verified every
+panel group, zero detections), with an injected ON-DEVICE bit flip at a
+panel-group boundary (detected by the checksum invariant within that
+group, repaired by the localized replay rung, bit-identical to the clean
+run), and with PERSISTENT corruption (replay exhausts, the typed error
+escalates to the full recovery ladder) — then corrects a single-element
+GEMM error in place from the row x column checksum intersection.
+See docs/RESILIENCE.md (ABFT section).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # run from anywhere
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+honor_jax_platforms()
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import abft, inject, recover
+
+
+def main():
+    rng = np.random.default_rng(258458)
+    n = 128
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    b = rng.standard_normal(n)
+
+    # 1. Clean ABFT solve: the checksum rides every panel factor and
+    #    trailing GEMM; zero detections, factor bit-identical to the
+    #    plain (abft=False) path.
+    res = recover.solve_resilient(a, b, abft=True, panel=16)
+    print(f"clean:      rung={res.rung} detections="
+          f"{res.sdc['detections']} rel_residual={res.rel_residual:.2e}")
+
+    # 2. One transient on-device bit flip at panel group 1: the group's
+    #    checksum check catches it, the group replays from the last
+    #    verified carry, and the result is bit-identical to the clean run.
+    plan = inject.FaultPlan.parse("abft.lu.group=sdc_bitflip:skip=1:max=1")
+    with obs.run(tool="abft_solve") as rec:
+        with inject.plan(plan) as active:
+            res2 = recover.solve_resilient(a, b, abft=True, panel=16)
+    print(f"sdc flip:   rung={res2.rung} detections="
+          f"{res2.sdc['detections']} replays={res2.sdc['replays']} "
+          f"localized to group(s) {res2.sdc['detect_groups']} "
+          f"(injected: {active.stats()['triggered']})")
+    print(f"            bit-identical to clean: "
+          f"{bool(np.array_equal(res.x, res2.x))}")
+    for ev in rec.events:
+        if ev["type"] in ("sdc", "sdc_inject"):
+            kv = {k: v for k, v in ev.items()
+                  if k in ("site", "engine", "group", "col", "bit",
+                           "magnitude", "action")}
+            print(f"  obs {ev['type']}: {kv}")
+
+    # 3. Persistent corruption: replay cannot heal it; the typed
+    #    SDCUnrecoverableError escalates to the full recovery ladder,
+    #    which still returns a verified solution.
+    plan = inject.FaultPlan.parse("abft.lu.group=sdc_bitflip:max=100")
+    with inject.plan(plan):
+        res3 = recover.solve_resilient(a, b, abft=True, panel=16)
+    print(f"persistent: served by rung={res3.rung} (escalations: "
+          f"{[r for r, _ in res3.escalations]}) "
+          f"rel_residual={res3.rel_residual:.2e}")
+
+    # 4. ABFT matmul: a single corrupted element of C = A @ B is
+    #    localized to its (row, col) checksum intersection and corrected
+    #    in place.
+    am = rng.standard_normal((64, 48)).astype(np.float32)
+    bm = rng.standard_normal((48, 56)).astype(np.float32)
+    plan = inject.FaultPlan.parse("abft.matmul=sdc_bitflip:max=1")
+    with inject.plan(plan):
+        c, info = abft.abft_matmul(am, bm)
+    print(f"matmul:     detections={info['detections']} "
+          f"corrected={info['corrected']} at "
+          f"({info['row']}, {info['col']})")
+
+
+if __name__ == "__main__":
+    main()

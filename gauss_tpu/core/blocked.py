@@ -161,6 +161,11 @@ class BlockedLU(NamedTuple):
     chains (the TRTRI+GEMM scheme GPU LU libraries use; measured 0.52 ms
     of trisolve + 0.42 ms of solve at n=2048 on v5e with the chain form).
     None only for hand-constructed instances; lu_solve then substitutes.
+    abft_err: only set by the ``abft=True`` checksum-carrying forms — the
+    per-panel-group column-checksum mismatch magnitudes (one entry per
+    group plus a final whole-factor ``e^T PA = (e^T L) U`` identity check,
+    see the ABFT block below). Near-zero on a healthy run; a large entry
+    localizes silent data corruption to the group that produced it.
     """
 
     m: jax.Array
@@ -168,6 +173,7 @@ class BlockedLU(NamedTuple):
     min_abs_pivot: jax.Array
     linv: jax.Array | None = None
     uinv: jax.Array | None = None
+    abft_err: jax.Array | None = None
 
 
 TRI_INV_BASE = 64  # base-case size for the recursive triangular inversions
@@ -468,14 +474,113 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype,
     return sub, linv_k, uinv_k
 
 
+# --- ABFT: checksum-carrying factorization (Huang & Abraham 1984) ---------
+#
+# A column-checksum row c = e^T A, carried as a separate (1, npad) array,
+# is an invariant of blocked LU with partial pivoting: row swaps permute
+# rows WITHIN the active trailing set (column sums over it are unchanged),
+# and the group update A22' = A22 - L21 @ U12 maps the checksum to
+# c2' = c2 - (c1 @ Ugroup^-1) @ U12 = e^T A22' (the checksum row is just
+# one more eliminated row that never wins a pivot contest). Verifying
+# c2' == colsums(A22') after each panel group detects silent data
+# corruption WITHIN the group that produced it — an O(n * trailing)
+# reduction against the group's O(n^2 * w) GEMM FLOPs — and the final
+# e^T PA = (e^T L) @ U identity covers the already-factored region the
+# group checks no longer watch. All helpers are traced only when
+# ``abft=True``; the off path compiles to the exact pre-ABFT program.
+
+
+def _csum_init(m: jax.Array) -> jax.Array:
+    """The initial column-checksum row ``e^T m`` of the padded operand."""
+    return jnp.sum(m, axis=0, keepdims=True)
+
+
+def _csum_group_solve(c1, grp, uinvs, gpanels: int, panel: int, prec):
+    """``Lc = c1 @ Ugroup^-1``: blockwise right-substitution against the
+    factored group's (w, w) upper triangle, through the stored per-panel
+    ``uinv`` diagonal-block inverses (the checksum row's multipliers — the
+    same quantity ``e^T [L11; L21]`` the elimination would have produced
+    row-operation by row-operation)."""
+    xs = []
+    for j in range(gpanels):
+        r = c1[:, j * panel:(j + 1) * panel]
+        for i in range(j):
+            r = r - jnp.dot(xs[i], grp[i * panel:(i + 1) * panel,
+                                       j * panel:(j + 1) * panel],
+                            precision=prec)
+        xs.append(jnp.dot(r, uinvs[j], precision=prec))
+    return jnp.concatenate(xs, axis=1)
+
+
+def _csum_group_col_err(block, u, c1, w: int):
+    """The group-column identity ``c1 == (e^T L_group) @ Ugroup`` over the
+    group's own ``w`` columns: ``block`` is the (h, w) factored column
+    trapezoid (L multipliers strictly below the diagonal, whose row index
+    equals the column index within the group), ``u`` its (w, w) top block.
+    This is the whole-factor identity restricted to the group — EXACT in
+    the corruption (a flip of magnitude d in the group block shows as a
+    ~d mismatch), where the trailing-block check only sees group-column
+    corruption through U^-1-attenuated propagation (~d/n for diagonally
+    dominant systems). Returns (max mismatch, argmax column within the
+    group); NaN folds to +inf."""
+    h = block.shape[0]
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    one = jnp.ones((), block.dtype)
+    el = jnp.sum(jnp.where(rows > cols, block, jnp.zeros((), block.dtype)),
+                 axis=0) + one  # unit diagonal of L
+    rw = jnp.arange(w)
+    ug = jnp.where(rw[:, None] <= rw[None, :], u,
+                   jnp.zeros((), block.dtype))
+    pred = jnp.dot(el[None, :], ug, precision=lax.Precision.HIGHEST)
+    diff = pred[0] - c1[0]
+    diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+    return jnp.max(diff), jnp.argmax(diff)
+
+
+def _csum_trailing_err(m, crow, split):
+    """``(max |colsums(trailing) - crow|, argmax column)`` over the
+    trailing block at rows/cols >= ``split`` (which may be traced; masked
+    form). NaN mismatches fold to +inf so a NaN-poisoning corruption is
+    DETECTED rather than comparing false."""
+    npad = m.shape[0]
+    live = jnp.arange(npad) >= split
+    colsum = jnp.sum(jnp.where(live[:, None], m, jnp.zeros((), m.dtype)),
+                     axis=0)
+    diff = jnp.where(live, colsum - crow[0], jnp.zeros((), m.dtype))
+    diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+    return jnp.max(diff), jnp.argmax(diff)
+
+
+def _csum_final_err_lu(m, crow0):
+    """The post-factor identity ``e^T P A = (e^T L) @ U``: column sums are
+    invariant under the row permutation, so the initial checksum row must
+    equal the L-column-sum-weighted combination of U's rows. O(n^2) total;
+    covers the factored L/U region the per-group trailing checks stop
+    watching once a group retires (including the final group, whose
+    trailing block is empty)."""
+    npad = m.shape[0]
+    rows = jnp.arange(npad)
+    strict_lower = rows[:, None] > rows[None, :]
+    one = jnp.ones((), m.dtype)
+    el = jnp.sum(jnp.where(strict_lower, m, jnp.zeros((), m.dtype)),
+                 axis=0) + one  # unit diagonal of L
+    u = jnp.where(~strict_lower, m, jnp.zeros((), m.dtype))
+    pred = jnp.dot(el[None, :], u, precision=lax.Precision.HIGHEST)
+    diff = pred[0] - crow0[0]
+    diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+    return jnp.max(diff), jnp.argmax(diff)
+
+
 @_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision",
-                                   "swap_impl", "zero_pivot_safe"))
+                                   "swap_impl", "zero_pivot_safe", "abft"))
 def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
                       panel_impl: str = "auto",
                       gemm_precision: str = "highest",
                       swap_impl: str = "gather",
-                      zero_pivot_safe: bool = False) -> BlockedLU:
+                      zero_pivot_safe: bool = False,
+                      abft: bool = False) -> BlockedLU:
     """Blocked LU with partial pivoting; one fori_loop over column panels.
 
     panel_impl: "jax" (stock fori_loop rank-1 updates), "pallas" (the
@@ -497,6 +602,13 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     system factors to a FINITE factor the residual gate can judge, instead
     of a NaN factor nothing downstream can use. Only the stock-JAX panel
     implements the guard, so the panel impl is pinned to "jax".
+    abft: carry the Huang-Abraham column-checksum row and verify it against
+    the trailing block after every panel (plus the final ``(e^T L) U``
+    identity); mismatch magnitudes return in ``BlockedLU.abft_err``
+    ((nb + 1,)). The factor arrays m/perm/linv/uinv are BIT-IDENTICAL to
+    ``abft=False`` — the checksum is a rider, never an operand — and with
+    ``abft=False`` (the default) none of it is traced: zero cost, same
+    compiled program as before the option existed.
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
@@ -519,7 +631,10 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     dtype = m.dtype
 
     def outer(k, carry):
-        m, perm, min_piv, linvs, uinvs = carry
+        if abft:
+            m, perm, min_piv, linvs, uinvs, crow, errs = carry
+        else:
+            m, perm, min_piv, linvs, uinvs = carry
         kb = k * panel
         p, ipiv, perm_local, mp = _factor_panel(m, kb, npad, panel,
                                                 panel_impl,
@@ -557,12 +672,54 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
                                                 gemm_prec, dtype)
         linvs = lax.dynamic_update_slice(linvs, linv_k[None], (k, 0, 0))
         uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (k, 0, 0))
+        if abft:
+            # The checksum row is one more eliminated row: its multipliers
+            # are Lc = c1 @ U11^-1, its trailing entries get the same
+            # L @ U12 subtraction the real rows got, and the trailing
+            # block's column sums must then still match it.
+            c1 = lax.dynamic_slice(crow, (0, kb), (1, panel))
+            lc = jnp.dot(c1, uinv_k, precision=gemm_prec)
+            cols_ge = jnp.arange(npad) >= kb + panel
+            u12 = jnp.where(cols_ge[None, :],
+                            lax.dynamic_slice(m, (kb, 0), (panel, npad)),
+                            jnp.zeros((), dtype))
+            crow = crow - jnp.dot(lc, u12, precision=gemm_prec)
+            ev, _ = _csum_trailing_err(m, crow, kb + panel)
+            # Panel-column identity (exact in the corruption; cf.
+            # _csum_group_col_err — inlined because the flat form's panel
+            # block spans all rows with a traced diagonal offset).
+            rr = jnp.arange(npad)[:, None]
+            cc = jnp.arange(panel)[None, :]
+            blk = lax.dynamic_slice(m, (0, kb), (npad, panel))
+            el = jnp.sum(jnp.where(rr > kb + cc, blk,
+                                   jnp.zeros((), dtype)),
+                         axis=0) + jnp.ones((), dtype)
+            d = lax.dynamic_slice(m, (kb, kb), (panel, panel))
+            rp = jnp.arange(panel)
+            u11 = jnp.where(rp[:, None] <= rp[None, :], d,
+                            jnp.zeros((), dtype))
+            pred = jnp.dot(el[None, :], u11,
+                           precision=lax.Precision.HIGHEST)
+            gdiff = pred[0] - c1[0]
+            gdiff = jnp.where(jnp.isnan(gdiff), jnp.inf, jnp.abs(gdiff))
+            ev = jnp.maximum(ev, jnp.max(gdiff))
+            errs = lax.dynamic_update_slice(errs, ev[None], (k,))
+            return m, perm, min_piv, linvs, uinvs, crow, errs
         return m, perm, min_piv, linvs, uinvs
 
-    m, perm, min_piv, linvs, uinvs = lax.fori_loop(
-        0, nb, outer, (m, jnp.arange(npad), jnp.asarray(jnp.inf, dtype),
-                       jnp.zeros((nb, panel, panel), dtype),
-                       jnp.zeros((nb, panel, panel), dtype)))
+    init = (m, jnp.arange(npad), jnp.asarray(jnp.inf, dtype),
+            jnp.zeros((nb, panel, panel), dtype),
+            jnp.zeros((nb, panel, panel), dtype))
+    if abft:
+        crow0 = _csum_init(m)
+        init = init + (crow0, jnp.zeros((nb,), dtype))
+        m, perm, min_piv, linvs, uinvs, _, errs = lax.fori_loop(
+            0, nb, outer, init)
+        fe, _ = _csum_final_err_lu(m, crow0)
+        return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
+                         linv=linvs, uinv=uinvs,
+                         abft_err=jnp.concatenate([errs, fe[None]]))
+    m, perm, min_piv, linvs, uinvs = lax.fori_loop(0, nb, outer, init)
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=linvs, uinv=uinvs)
 
@@ -772,12 +929,13 @@ def lu_solve(factors: BlockedLU, b: jax.Array,
 
 @_reraise_scoped_vmem
 @partial(jax.jit, static_argnames=("panel", "chunk", "panel_impl",
-                                   "gemm_precision"))
+                                   "gemm_precision", "abft"))
 def lu_factor_blocked_chunked(a: jax.Array,
                               panel: int | None = DEFAULT_PANEL,
                               chunk: int = CHUNK_DEFAULT,
                               panel_impl: str = "auto",
-                              gemm_precision: str = "highest") -> BlockedLU:
+                              gemm_precision: str = "highest",
+                              abft: bool = False) -> BlockedLU:
     """Blocked LU with the panel loop unrolled in GROUPS of ``chunk`` panels.
 
     The middle point between :func:`lu_factor_blocked` (one fori_loop, flat
@@ -827,24 +985,47 @@ def lu_factor_blocked_chunked(a: jax.Array,
     min_piv = jnp.asarray(jnp.inf, dtype)
     linvs_all, uinvs_all = [], []
 
+    crow0 = crow = _csum_init(m) if abft else None
+    errs = []
     for g0 in range(0, nb, chunk):
-        m, perm, min_piv, linvs, uinvs = _factor_group(
-            m, perm, min_piv, g0, panel, chunk, panel_impl, gemm_prec)
+        if abft:
+            m, perm, min_piv, linvs, uinvs, crow, err, _ = _factor_group(
+                m, perm, min_piv, g0, panel, chunk, panel_impl, gemm_prec,
+                crow=crow)
+            errs.append(err)
+        else:
+            m, perm, min_piv, linvs, uinvs = _factor_group(
+                m, perm, min_piv, g0, panel, chunk, panel_impl, gemm_prec)
         linvs_all.append(linvs)
         uinvs_all.append(uinvs)
 
+    abft_err = None
+    if abft:
+        fe, _ = _csum_final_err_lu(m, crow0)
+        abft_err = jnp.stack(errs + [fe])
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=jnp.concatenate(linvs_all),
-                     uinv=jnp.concatenate(uinvs_all))
+                     uinv=jnp.concatenate(uinvs_all),
+                     abft_err=abft_err)
 
 
 def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
-                  panel_impl: str, gemm_prec):
+                  panel_impl: str, gemm_prec, crow=None):
     """One group of the chunked factorization: factor (up to) ``chunk``
     panels starting at panel index ``g0``, apply the group's composed
     permutation, and run the deferred right-of-group update. Returns
     ``(m, perm, min_piv, linvs, uinvs)`` with the group's (gpanels, panel,
     panel) diagonal-block inverses.
+
+    ``crow``: an optional (1, npad) ABFT column-checksum row (see the
+    checksum helpers above). When given, it receives the group's
+    ``Lc @ U12`` update and the trailing block is verified against it; the
+    return grows to ``(..., crow', err, err_col)`` — the mismatch
+    magnitude and the global column index it localizes to. ``None`` (the
+    default) traces exactly the pre-ABFT program; the checkpointed path
+    (gauss_tpu.resilience.checkpoint) and the ABFT group runner
+    (gauss_tpu.resilience.abft) share this one function, so checkpointed,
+    ABFT, and one-shot chunked factorizations cannot drift numerically.
 
     Single source for :func:`lu_factor_blocked_chunked` (which unrolls every
     group into one traced program) and
@@ -967,6 +1148,14 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
 
         u12, _ = lax.scan(usolve, jnp.zeros((w, rt), dtype),
                           jnp.arange(gpanels))
+        if crow is not None:
+            # The checksum row's group-end update: its multipliers over the
+            # group columns (c1 @ Ugroup^-1) times the group's U12 — the
+            # exact rider of the big trailing GEMM below.
+            lc = _csum_group_solve(crow[:, gs:gs + w], grp, uinvs, gpanels,
+                                   panel, gemm_prec)
+            crow = crow.at[:, gs + w:].add(
+                -jnp.dot(lc, u12, precision=gemm_prec))
 
         if unstripped:
             # One gather + one GEMM; transients peak ~3 trailing-block
@@ -1004,6 +1193,26 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
                                     precision=gemm_prec)
                 m = m.at[gs + w + nfull * sw:gs + gh, gs + w:].set(new)
 
+    if crow is not None:
+        # Two checks, two failure surfaces: the group-column identity is
+        # EXACT for corruption landing in the group's own columns (where
+        # the trailing check only sees it through U^-1-attenuated
+        # propagation), the trailing-sum check is exact for corruption in
+        # the deferred-update region. Together every active-region flip
+        # shows at ~its own magnitude, in the group that produced it.
+        g_err, g_col = _csum_group_col_err(grp, grp[:w, :w],
+                                           crow[:, gs:gs + w], w)
+        g_col = gs + g_col
+        if rt:
+            sub = m[gs + w:, gs + w:]
+            diff = jnp.sum(sub, axis=0) - crow[0, gs + w:]
+            diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+            t_err, t_col = jnp.max(diff), gs + w + jnp.argmax(diff)
+            err = jnp.maximum(g_err, t_err)
+            err_col = jnp.where(g_err >= t_err, g_col, t_col)
+        else:
+            err, err_col = g_err, g_col
+        return m, perm, min_piv, linvs, uinvs, crow, err, err_col
     return m, perm, min_piv, linvs, uinvs
 
 

@@ -101,7 +101,14 @@ def _gather_order(npad: int, nshards: int, panel: int) -> np.ndarray:
 
 @lru_cache(maxsize=32)
 def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
-                          dtype_name: str):
+                          dtype_name: str, abft: bool = False):
+    """``abft=True`` additionally carries a REPLICATED Huang-Abraham
+    column-checksum row (covering the augmented RHS column too — it rides
+    the same trailing GEMM) and verifies the trailing block's column sums
+    against it after every panel: the partial column sums ride one extra
+    psum per panel next to the three the protocol already pays, and the
+    per-panel mismatch magnitudes return as an extra (nblocks,) output
+    (replicated, like min_piv). The ``abft=False`` trace is unchanged."""
     axis = mesh.axis_names[0]
     nshards = mesh.devices.shape[0]
     m = npad // nshards
@@ -118,7 +125,10 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
         zero = jnp.zeros((), dtype)
 
         def panel_step(carry, k):
-            A, min_piv, gperm = carry
+            if abft:
+                A, min_piv, gperm, crow = carry
+            else:
+                A, min_piv, gperm = carry
             kb = k * panel
             own_k = (k % nshards) == d          # owner of diagonal block k
             lb = (k // nshards) * panel         # its local row offset there
@@ -185,16 +195,40 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
             below = g_loc >= kb + panel
             f_own = jnp.where(below[:, None], strip_mine, zero)
             A = A - jnp.dot(f_own, u12, precision=lax.Precision.HIGHEST)
-            return (A, min_piv, gperm), k
+            if not abft:
+                return (A, min_piv, gperm), k
+            # ABFT rider: the checksum row's multipliers over the panel
+            # columns are Lc = c1 @ U11^-1 (replicated small solve), its
+            # trailing update the same Lc @ U12 GEMM the rows got, and the
+            # verification psums each shard's partial trailing column sums
+            # — one extra collective riding next to the three above.
+            u11 = jnp.where(~lmask, dblk, zero)
+            c1 = lax.dynamic_slice(crow, (kb,), (panel,))
+            lc = lax.linalg.triangular_solve(
+                u11, c1[None, :], left_side=False, lower=False)
+            crow = crow - jnp.dot(lc, u12,
+                                  precision=lax.Precision.HIGHEST)[0]
+            colsum = lax.psum(
+                jnp.sum(jnp.where(below[:, None], A, zero), axis=0), axis)
+            diff = jnp.where(right, colsum - crow, zero)
+            diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+            return (A, min_piv, gperm, crow), jnp.max(diff)
 
         # min_piv init inherits a_loc's varying type (shard_map vma);
         # NaN-proof zero via the integer domain (int x * 0 is always 0).
         vma0i = a_loc[0, 0].astype(jnp.int32) * 0
         vma0 = vma0i.astype(dtype)
-        (A, min_piv, gperm), _ = lax.scan(
-            panel_step, (a_loc, jnp.asarray(jnp.inf, dtype) + vma0,
-                         jnp.arange(npad) + vma0i),
-            jnp.arange(nblocks))
+        init = (a_loc, jnp.asarray(jnp.inf, dtype) + vma0,
+                jnp.arange(npad) + vma0i)
+        if abft:
+            # Replicated initial checksum row: global column sums of the
+            # augmented matrix, one psum of each shard's local row sums.
+            crow0 = lax.psum(jnp.sum(a_loc, axis=0), axis)
+            (A, min_piv, gperm, _), errs = lax.scan(
+                panel_step, init + (crow0,), jnp.arange(nblocks))
+        else:
+            (A, min_piv, gperm), _ = lax.scan(
+                panel_step, init, jnp.arange(nblocks))
 
         # --- blockwise back-substitution: one psum per block. The RHS was
         # eliminated in place as the augmented column (L already applied),
@@ -204,12 +238,18 @@ def _build_solver_blocked(mesh: jax.sharding.Mesh, npad: int, panel: int,
         # min_piv and gperm are numerically identical on every shard
         # (replicated panel factorization) but typed varying; a pmin makes
         # the replication provable for out_specs.
-        return (x, A, lax.pmin(gperm, axis), lax.pmin(min_piv, axis))
+        out = (x, A, lax.pmin(gperm, axis), lax.pmin(min_piv, axis))
+        if abft:
+            out = out + (lax.pmin(errs, axis),)
+        return out
 
+    out_specs = (P(None), P(axis, None), P(None), P())
+    if abft:
+        out_specs = out_specs + (P(None),)
     mapped = compat.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(axis, None),),
-        out_specs=(P(None), P(axis, None), P(None), P()))
+        out_specs=out_specs)
     return jax.jit(mapped)
 
 
@@ -364,23 +404,37 @@ class DistBlockedLU:
     :func:`lu_solve_dist_blocked` — one distributed factorization, many
     O(n^2) solves (the same getrf/getrs split the single-chip path has)."""
 
-    def __init__(self, a_fac, perm, min_piv, n, npad, panel, mesh):
+    def __init__(self, a_fac, perm, min_piv, n, npad, panel, mesh,
+                 abft_err=None):
         self.a_fac, self.perm, self.min_piv = a_fac, perm, min_piv
         self.n, self.npad, self.panel, self.mesh = n, npad, panel, mesh
+        #: (nblocks,) per-panel ABFT checksum mismatch magnitudes when the
+        #: factorization carried the checksum row; None otherwise.
+        self.abft_err = abft_err
 
 
-def factor_solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh):
-    """Factor + solve a staged system; returns (x, DistBlockedLU)."""
+def factor_solve_dist_blocked_staged(staged, mesh: jax.sharding.Mesh,
+                                     abft: bool = False):
+    """Factor + solve a staged system; returns (x, DistBlockedLU).
+
+    ``abft=True`` builds the checksum-carrying solver (see
+    :func:`_build_solver_blocked`); the per-panel mismatch magnitudes land
+    on ``DistBlockedLU.abft_err`` for the caller to judge (the refined
+    entry below raises the typed SDC error past the tolerance)."""
     a_c, n, npad, panel = staged
-    solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype))
+    solver = _build_solver_blocked(mesh, npad, panel, str(a_c.dtype),
+                                   abft=abft)
     if _fleet.active() or _watchdog.enabled():
         _fleet.beat(phase="dist_factor_solve", engine="gauss_dist_blocked",
                     n=n)
-        x, a_fac, perm, min_piv = _watchdog.guarded_device(
+        out = _watchdog.guarded_device(
             lambda: solver(a_c), site="dist.gauss_dist_blocked.factor")
     else:
-        x, a_fac, perm, min_piv = solver(a_c)
-    return x[:n], DistBlockedLU(a_fac, perm, min_piv, n, npad, panel, mesh)
+        out = solver(a_c)
+    x, a_fac, perm, min_piv = out[:4]
+    errs = out[4] if abft else None
+    return x[:n], DistBlockedLU(a_fac, perm, min_piv, n, npad, panel, mesh,
+                                abft_err=errs)
 
 
 def lu_solve_dist_blocked(fac: DistBlockedLU, r) -> jax.Array:
@@ -422,7 +476,8 @@ def host_refine(a64, b64, x0, lu_solve_fn, iters: int,
 def gauss_solve_dist_blocked_refined(a, b, mesh: jax.sharding.Mesh = None,
                                      panel: int | None = None,
                                      iters: int = 2,
-                                     tol: float = 0.0) -> np.ndarray:
+                                     tol: float = 0.0,
+                                     abft: bool = False) -> np.ndarray:
     """Distributed blocked solve + host-f64 iterative refinement; returns
     x float64.
 
@@ -434,14 +489,46 @@ def gauss_solve_dist_blocked_refined(a, b, mesh: jax.sharding.Mesh = None,
 
     ``tol``: same early-stop contract as solve_refined — stop once
     ``||Ax - b||_2 <= tol * min(1, ||b||_2)``; 0.0 runs exactly ``iters``.
+
+    ``abft=True``: the factorization carries the replicated checksum row
+    (one extra psum per panel) and every panel's trailing block is
+    verified on-device; a mismatch past the tolerance emits an obs ``sdc``
+    event localizing the panel and raises the typed
+    :class:`~gauss_tpu.resilience.abft.SDCDetectedError` — the
+    distributed engine has no in-place replay (no host-stepped carry to
+    roll back to), so detection escalates to the caller's recovery ladder
+    instead of refining a corrupted factor into a wrong-but-plausible
+    answer.
     """
+    from gauss_tpu import obs
+
     if mesh is None:
         mesh = make_mesh()
     a64 = np.asarray(a, np.float64)
     b64 = np.asarray(b, np.float64)
     staged = prepare_dist_blocked(a64.astype(np.float32),
                                   b64.astype(np.float32), mesh, panel=panel)
-    x0, fac = factor_solve_dist_blocked_staged(staged, mesh)
+    x0, fac = factor_solve_dist_blocked_staged(staged, mesh, abft=abft)
+    if abft:
+        from gauss_tpu.resilience import abft as _abft
+
+        errs = np.asarray(fac.abft_err, np.float64)
+        scale = float(max(1.0, np.max(np.abs(a64).sum(axis=0))))
+        sdc_tol = _abft.default_tol(fac.npad, np.float32, scale)
+        worst = int(np.argmax(np.where(np.isnan(errs), np.inf, errs)))
+        worst_err = float(errs[worst]) if np.isfinite(errs[worst]) \
+            else float("inf")
+        if not worst_err <= sdc_tol:
+            obs.counter("abft.sdc_detected")
+            obs.emit("sdc", engine="dist_blocked", group=worst,
+                     col=worst * fac.panel, magnitude=worst_err,
+                     action="escalate")
+            raise _abft.SDCDetectedError(
+                f"dist_blocked ABFT: panel {worst} failed its checksum "
+                f"(|mismatch| {worst_err:.3e} > tol {sdc_tol:.3e}); the "
+                f"distributed engine escalates instead of replaying",
+                engine="dist_blocked", group=worst, col=worst * fac.panel,
+                magnitude=worst_err)
     return host_refine(a64, b64, x0,
                        lambda r: lu_solve_dist_blocked(fac, r), iters, tol)
 

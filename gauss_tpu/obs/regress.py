@@ -202,6 +202,23 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "abft_campaign":
+        # An ABFT campaign summary (python -m gauss_tpu.resilience
+        # .abftcheck --summary-json): detection-miss/escalation rates, per-
+        # case cost, and the plain-path (abft OFF) seconds-per-solve enter
+        # history — the last is the ZERO-OVERHEAD sentinel: the checksum
+        # machinery creeping into the unprotected hot path gates exactly
+        # like a perf regression. Metric derivation lives with the campaign
+        # runner (single source); lazy import keeps the solver stack out of
+        # this module.
+        from gauss_tpu.resilience.abftcheck import history_records as \
+            abft_hist
+
+        for metric, value, unit in abft_hist(doc):
+            rec = _record(metric, value, path, "abft", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, list):  # bench-grid --json cells
         for cell in doc:
             if isinstance(cell, dict) and cell.get("verified"):

@@ -334,6 +334,72 @@ def _resilience_lines(rs: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def sdc_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the ABFT layer's events (``sdc`` checksum-mismatch detections
+    from gauss_tpu.resilience.abft, ``sdc_inject`` on-device corruption
+    injections) into one report: detections by engine and action
+    (replay / escalate / correct / recompute), injected on-device faults,
+    worst mismatch magnitude, and detection-latency stats. Empty dict when
+    the run saw none of it — healthy runs carry no SDC noise."""
+    dets = [ev for ev in events if ev.get("type") == "sdc"]
+    injs = [ev for ev in events if ev.get("type") == "sdc_inject"]
+    if not (dets or injs):
+        return {}
+    by_engine: Dict[str, int] = {}
+    by_action: Dict[str, int] = {}
+    lat = []
+    max_mag = 0.0
+    for ev in dets:
+        eng = str(ev.get("engine", "?"))
+        act = str(ev.get("action", "?"))
+        by_engine[eng] = by_engine.get(eng, 0) + 1
+        by_action[act] = by_action.get(act, 0) + 1
+        if isinstance(ev.get("latency_s"), (int, float)):
+            lat.append(float(ev["latency_s"]))
+        mag = ev.get("magnitude")
+        if isinstance(mag, (int, float)) and mag == mag:
+            max_mag = max(max_mag, float(mag))
+    inj_by_site: Dict[str, int] = {}
+    for ev in injs:
+        site = str(ev.get("site", "?"))
+        inj_by_site[site] = inj_by_site.get(site, 0) + 1
+    out = {
+        "detections": {"total": len(dets), "by_engine": by_engine,
+                       "by_action": by_action},
+        "injected": {"total": len(injs), "by_site": inj_by_site},
+        "max_magnitude": max_mag,
+    }
+    if lat:
+        out["detect_latency_s"] = {
+            "mean": round(sum(lat) / len(lat), 6),
+            "max": round(max(lat), 6),
+        }
+    return out
+
+
+def _sdc_lines(sd: Dict[str, Any]) -> List[str]:
+    det = sd["detections"]
+    inj = sd["injected"]
+    engines = ", ".join(f"{k} x{v}"
+                        for k, v in sorted(det["by_engine"].items()))
+    actions = ", ".join(f"{k} x{v}"
+                        for k, v in sorted(det["by_action"].items()))
+    lines = [f"  detections: {det['total']}"
+             + (f"  ({engines})" if engines else "")
+             + (f"; actions: {actions}" if actions else "")]
+    if inj["total"]:
+        sites = ", ".join(f"{k} x{v}"
+                          for k, v in sorted(inj["by_site"].items()))
+        lines.append(f"  injected on-device faults: {inj['total']}"
+                     + (f"  ({sites})" if sites else ""))
+    lines.append(f"  worst |mismatch|: {_fmt(sd['max_magnitude'])}")
+    if "detect_latency_s" in sd:
+        ls = sd["detect_latency_s"]
+        lines.append(f"  detect latency: mean {_fmt(ls['mean'])} s, "
+                     f"max {_fmt(ls['max'])} s")
+    return lines
+
+
 def structure_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the structure router's events (``structure`` detections /
     routing tags, ``structure_solve`` engine outcomes) into per-structure
@@ -551,6 +617,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "slo": slo_summary(evs),
         "structure": structure_summary(evs),
         "resilience": resilience_summary(evs),
+        "sdc": sdc_summary(evs),
         "fleet": fleet_summary(evs),
         "tuning": tuning_summary(evs),
         "comms": comms_summary(evs),
@@ -621,6 +688,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("resilience:")
         out.extend(_resilience_lines(resilience))
+
+    sdc = sdc_summary(evs)
+    if sdc:
+        out.append("")
+        out.append("sdc (abft checksum detections):")
+        out.extend(_sdc_lines(sdc))
 
     fleet = fleet_summary(evs)
     if fleet:

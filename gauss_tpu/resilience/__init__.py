@@ -30,11 +30,25 @@ chaos-engineering way production serving stacks do:
   restart-and-resume from the sharded checkpoint, and elastic degrade
   (shrink the world, or finish in-process) — a verified solution or a
   typed :class:`FleetError`, never a hang.
+- :mod:`gauss_tpu.resilience.abft` — algorithm-based fault tolerance:
+  checksum-carrying LU/Cholesky/matmul that DETECT silent data corruption
+  within one panel group (Huang–Abraham column-checksum invariant,
+  verified on-device per group), LOCALIZE it, and REPAIR it by replaying
+  just the affected group from the last verified carry — bit-identical to
+  an uninterrupted run — escalating (typed
+  :class:`~gauss_tpu.resilience.abft.SDCUnrecoverableError`) to the full
+  recovery ladder only when replay fails. ``abft=False`` paths stay
+  bit-identical to the pre-ABFT solvers at zero cost.
 - :mod:`gauss_tpu.resilience.chaos` — the campaign runner
   (``python -m gauss_tpu.resilience.chaos``): seeded randomized fault plans
   swept across engines and hook points, asserting the one invariant that
   matters — every injected fault is either recovered (verified solution) or
   surfaced as a typed error; never a silent wrong answer.
+- :mod:`gauss_tpu.resilience.abftcheck` — the ABFT campaign
+  (``make abft-check``): >= 100 seeded on-device ``sdc_bitflip`` faults
+  across LU + Cholesky, 100% detection / localized-replay recovery /
+  bit-identity asserted, with the abft-off zero-overhead contract pinned
+  to the regression history.
 
 ``inject`` is imported eagerly (it is stdlib+numpy only and the hook points
 in core/serve/dist reference it at module load); the other submodules import

@@ -1,0 +1,733 @@
+"""Algorithm-based fault tolerance: checksum-carrying solves that detect,
+localize, and repair silent data corruption MID-solve.
+
+The stack's verification so far is end-of-job: the 1e-4 residual gate (and
+the recovery ladder behind it) notices a corrupted solve only after ALL the
+O(n^3) work is spent, and recovery redoes everything. Fleets see silent
+data corruption from flaky cores as a matter of course (Dixit et al.,
+"Silent Data Corruptions at Scale", 2021); the classic answer is Huang &
+Abraham's algorithm-based fault tolerance (checksum-augmented matrix
+factorizations, IEEE ToC 1984; blocked-factorization form per Du, Bosilca
+& Dongarra, PPoPP'12): carry a column-checksum row through the
+factorization — it is an invariant of every panel factor and trailing GEMM
+(see the ABFT block in :mod:`gauss_tpu.core.blocked`) — and verify it
+on-device after each panel group, a cheap reduction against the group's
+GEMM FLOPs.
+
+This module is the host-stepped runner that turns the invariant into
+repair:
+
+- :func:`lu_factor_abft` / :func:`cholesky_factor_abft` run the SAME group
+  math as the checkpointed factorizations (``blocked._factor_group`` /
+  ``cholesky._chol_panel_step`` — shared code, numerical lockstep),
+  holding the last VERIFIED carry in memory exactly like a PR-4
+  checkpoint. On a checksum mismatch the fault is localized to the
+  offending panel group (and the argmax column), an obs ``sdc`` event +
+  health gauge fires, and the group is REPLAYED from the last-good carry
+  — a deterministic compiled program over bit-identical inputs, so a
+  repaired run is bit-identical to an uninterrupted one (the fleet
+  recovery guarantee, asserted by ``make abft-check``). Replay exhaustion
+  (persistent corruption) raises the typed :class:`SDCUnrecoverableError`
+  so the recovery ladder (gauss_tpu.resilience.recover, rungs ``abft`` /
+  ``abft_chol``) escalates to the full pre-existing ladder.
+- A final whole-factor identity (``e^T PA = (e^T L) U``, resp.
+  ``e^T A = (e^T L) L^T``) covers the factored region the per-group
+  trailing checks stop watching — including the last group, whose
+  trailing block is empty.
+- :func:`abft_matmul` is the standalone GEMM form: column-checksum row on
+  A and row-checksum column on B give full output checksums; a
+  single-element error is localized to its (row, column) intersection and
+  corrected IN PLACE (to checksum precision); anything wider is repaired
+  by recomputation. Never a silent wrong product.
+
+Fault injection (gauss_tpu.resilience.inject, kind ``sdc_bitflip`` at
+sites ``abft.lu.group`` / ``abft.chol.group`` / ``abft.matmul``) flips one
+bit of one element of the ON-DEVICE carry at a panel-group boundary — the
+first on-device corruption channel in the chaos stack (the PR-4 bitflips
+corrupt host operands before launch). Default bits are drawn from the
+sign/exponent/high-mantissa range: a low-order mantissa flip perturbs the
+result below the f32 checksum rounding floor and below the 1e-4 gate —
+numerically invisible corruption is not a detectable (or meaningful)
+fault class for an f32 pipeline, and docs/RESILIENCE.md says so honestly.
+
+Detection threshold: ``tol = scale * max(64 * npad * eps, 1e-6)`` with
+``scale = max |initial column sums|`` — comfortably above the checksum's
+accumulated rounding noise (measured ~2e-7 relative at n=96..2048) and far
+below any high-bit flip's perturbation. NaN mismatches fold to +inf inside
+the on-device check, so NaN-poisoning corruption is always detected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
+
+#: fault-injection hook sites (inject kind ``sdc_bitflip``)
+SITE_LU = "abft.lu.group"
+SITE_CHOL = "abft.chol.group"
+SITE_MATMUL = "abft.matmul"
+
+#: the final whole-factor identity accumulates rounding across all groups;
+#: its acceptance band is this many group-check tolerances wide.
+FINAL_TOL_FACTOR = 4.0
+
+#: default replay budget per factorization — a transient fault heals on
+#: the first replay; two failed replays of the same group mean the
+#: corruption reproduces (sick core, poisoned input) and the ladder is
+#: the right tool.
+DEFAULT_MAX_REPLAYS = 2
+
+
+class SDCDetectedError(RuntimeError):
+    """A checksum mismatch the runner could not (or was not asked to)
+    repair in place. Carries the localization: engine, panel group,
+    column, and mismatch magnitude."""
+
+    def __init__(self, message: str, engine: str = "", group: int = -1,
+                 col: int = -1, magnitude: float = 0.0):
+        super().__init__(message)
+        self.engine = engine
+        self.group = group
+        self.col = col
+        self.magnitude = magnitude
+
+
+class SDCUnrecoverableError(SDCDetectedError):
+    """Replay exhausted: the same panel group failed its checksum
+    ``max_replays + 1`` times — persistent corruption, not a transient
+    flip. Typed so the recovery ladder escalates to the full pre-existing
+    rung chain (pivot-safe refactor -> ds refine -> alternate engine ->
+    host NumPy) instead of surfacing an untyped crash."""
+
+
+@dataclasses.dataclass
+class AbftReport:
+    """What the checksum machinery saw during one factorization."""
+
+    engine: str
+    groups: int
+    tol: float
+    detections: int = 0
+    replays: int = 0
+    escalated: bool = False
+    max_err: float = 0.0
+    detect_groups: List[int] = dataclasses.field(default_factory=list)
+    detect_cols: List[int] = dataclasses.field(default_factory=list)
+    detect_latency_s: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def repaired(self) -> bool:
+        return self.detections > 0 and not self.escalated
+
+    def to_dict(self) -> dict:
+        return {"engine": self.engine, "groups": self.groups,
+                "detections": self.detections, "replays": self.replays,
+                "escalated": self.escalated,
+                "max_err": float(self.max_err), "tol": float(self.tol),
+                "detect_groups": list(self.detect_groups),
+                "detect_cols": list(self.detect_cols),
+                "detect_latency_s": [round(v, 6)
+                                     for v in self.detect_latency_s]}
+
+
+# The last factorization's report, per thread — how the recovery ladder
+# (which only sees a rung's (x, factors) return) attaches SDC accounting
+# to its ResilientResult without changing every rung's signature.
+_tls = threading.local()
+
+
+def last_report() -> Optional[AbftReport]:
+    return getattr(_tls, "report", None)
+
+
+def clear_report() -> None:
+    _tls.report = None
+
+
+def default_tol(npad: int, dtype, scale: float) -> float:
+    """Detection threshold for an (npad, npad) factorization at checksum
+    magnitude ``scale`` — above the accumulated checksum rounding noise,
+    far below any high-bit flip's perturbation."""
+    eps = float(np.finfo(np.dtype(dtype)).eps)
+    return max(float(scale), 1.0) * max(64.0 * npad * eps, 1e-6)
+
+
+# -- on-device bit flip (the corruption primitive AND the test substrate) --
+
+_UINT = {2: "uint16", 4: "uint32", 8: "uint64"}
+_JITS: dict = {}
+
+
+def flip_bit(m, i: int, j: int, bit: int):
+    """Flip bit ``bit`` of element (i, j) of the device array ``m`` — a
+    jitted bitcast-XOR, so the corruption happens ON DEVICE against the
+    live carry (never a host round-trip of the matrix)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    fn = _JITS.get("flip")
+    if fn is None:
+        def impl(m, i, j, bit):
+            uint = jnp.dtype(_UINT[m.dtype.itemsize])
+            v = lax.dynamic_slice(m, (i, j), (1, 1))
+            u = lax.bitcast_convert_type(v, uint)
+            u = u ^ (jnp.ones((), uint) << bit.astype(uint))
+            return lax.dynamic_update_slice(
+                m, lax.bitcast_convert_type(u, m.dtype), (i, j))
+
+        fn = jax.jit(impl)
+        _JITS["flip"] = fn
+    return fn(m, jnp.asarray(i, jnp.int32), jnp.asarray(j, jnp.int32),
+              jnp.asarray(bit, jnp.int32))
+
+
+def _flipped_host(v: float, bit: int, np_dtype) -> float:
+    """What flipping ``bit`` of ``v`` yields, computed host-side (used to
+    pre-qualify an injection as detectable)."""
+    uint = np.dtype(_UINT[np.dtype(np_dtype).itemsize])
+    u = np.asarray(v, np_dtype).view(uint)
+    return float(np.asarray(u ^ uint.type(1 << bit)).view(np_dtype))
+
+
+def _poll_sdc_corrupt(site: str, m, lo: int, engine: str, group: int,
+                      tol: float = 0.0, lower_only: bool = False):
+    """Poll ``site``; on an ``sdc_bitflip`` trigger, flip one seeded bit of
+    one seeded element of the ACTIVE region (rows/cols >= ``lo``) of the
+    on-device carry. Returns (m, fired).
+
+    The seeded draw prefers (element, bit) pairs whose flip perturbs the
+    value by more than the detection tolerance: a flip of a near-zero
+    element (or a low-order mantissa bit) perturbs the result below the
+    f32 checksum rounding floor AND below the final residual gate —
+    numerically invisible corruption is not a meaningful fault class for
+    an f32 pipeline (docs/RESILIENCE.md). ``spec.param`` > 0 pins the bit
+    index verbatim, bypassing the qualification (tests use it to exercise
+    the sub-noise case deliberately).
+
+    ``lower_only``: draw (i, j) with i >= j — the Cholesky fault model:
+    the factorization never reads the strict upper triangle, so a flip
+    there is corruption of DEAD memory (harmless and, correctly,
+    invisible to a checksum over the computation's inputs/outputs)."""
+    if not _inject.enabled():
+        return m, False
+    hit = _inject.poll_sdc(site)
+    if hit is None:
+        return m, False
+    sp, rng = hit
+    npad = m.shape[0]
+    np_dtype = np.dtype(str(m.dtype))
+    nbits = np_dtype.itemsize * 8
+    mant = {2: 10, 4: 23, 8: 52}[np_dtype.itemsize]
+    def draw_ij():
+        i = lo + int(rng.integers(0, max(1, npad - lo)))
+        j = lo + int(rng.integers(0, max(1, npad - lo)))
+        return (max(i, j), min(i, j)) if lower_only else (i, j)
+
+    i = j = bit = None
+    if sp.param and sp.param > 0:
+        i, j = draw_ij()
+        bit = int(sp.param) % nbits
+    else:
+        floor = max(4.0 * tol, 1e-3)
+        for _ in range(16):
+            i, j = draw_ij()
+            v = float(np.asarray(m[i, j]))
+            for b in rng.permutation(np.arange(mant - 3, nbits)):
+                nv = _flipped_host(v, int(b), np_dtype)
+                delta = abs(nv - v)
+                if not np.isfinite(delta) or delta > floor:
+                    bit = int(b)
+                    break
+            if bit is not None:
+                break
+        if bit is None:
+            bit = nbits - 2  # top exponent bit: always catastrophic
+    obs.emit("sdc_inject", site=site, engine=engine, group=group,
+             row=i, col=j, bit=bit)
+    return flip_bit(m, i, j, bit), True
+
+
+def _record_detection(report: AbftReport, engine: str, group: int,
+                      col: int, err: float, lat: float,
+                      action: str) -> None:
+    report.detections += 1
+    report.max_err = max(report.max_err, err)
+    report.detect_groups.append(group)
+    report.detect_cols.append(col)
+    report.detect_latency_s.append(lat)
+    obs.counter("abft.sdc_detected")
+    obs.histogram("abft.detect_latency_s", lat)
+    obs.gauge("abft.last_sdc_group", float(group))
+    obs.emit("sdc", engine=engine, group=group, col=col,
+             magnitude=float(err), latency_s=round(lat, 6), action=action)
+    # The PR-1 health plane (and through it the live gauges: health events
+    # auto-gauge as health.* in obs.live) sees every detection too.
+    obs.emit("health", sdc_detected=1.0, sdc_magnitude=float(err),
+             sdc_group=group)
+
+
+def _emit_repair(report: AbftReport, replays: int, group: int) -> None:
+    report.replays += replays
+    obs.counter("abft.replays", replays)
+    obs.counter("abft.sdc_repaired")
+    obs.emit("recovery", trigger="sdc", rung="abft_replay", rung_index=0,
+             attempt=replays, outcome="recovered", group=group)
+
+
+def _escalate(report: AbftReport, engine: str, group: int, col: int,
+              err: float) -> "SDCUnrecoverableError":
+    report.escalated = True
+    _tls.report = report
+    obs.counter("abft.sdc_escalated")
+    obs.emit("recovery", trigger="sdc", rung="abft_replay", rung_index=0,
+             attempt=report.replays + 1, outcome="escalate", group=group)
+    return SDCUnrecoverableError(
+        f"{engine} ABFT: panel group {group} failed its checksum after "
+        f"{report.replays} replay(s) (|mismatch| {err:.3e} > tol "
+        f"{report.tol:.3e} at column {col}); corruption is persistent — "
+        f"escalate to the full recovery ladder", engine=engine,
+        group=group, col=col, magnitude=err)
+
+
+# -- checksum-carrying blocked LU (host-stepped groups + replay) -----------
+
+@functools.lru_cache(maxsize=32)
+def _lu_step_jit(panel: int, chunk: int, panel_impl: str,
+                 gemm_precision: str):
+    """The jitted per-group ABFT step — ``blocked._factor_group`` with the
+    checksum row riding, cached by jax.jit on its statics (the same trace
+    discipline as resilience.checkpoint._group_step_jit)."""
+    import jax
+
+    from functools import partial
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.core.matmul import resolve_precision
+
+    @partial(jax.jit, static_argnames=("g0",))
+    def step(m, perm, min_piv, crow, g0):
+        return blocked._factor_group(
+            m, perm, min_piv, g0, panel, chunk, panel_impl,
+            resolve_precision(gemm_precision), crow=crow)
+
+    return step
+
+
+def lu_factor_abft(a, *, panel: Optional[int] = None,
+                   chunk: Optional[int] = None, panel_impl: str = "auto",
+                   gemm_precision: str = "highest",
+                   max_replays: int = DEFAULT_MAX_REPLAYS,
+                   tol: Optional[float] = None):
+    """Checksum-carrying chunked blocked LU with detect -> localize ->
+    replay. Returns ``(BlockedLU, AbftReport)``; the factor is
+    bit-identical to ``blocked.lu_factor_blocked_chunked`` at the same
+    statics (the checksum is a rider, never an operand), faulted-and-
+    replayed runs are bit-identical to uninterrupted ones, and persistent
+    corruption raises the typed :class:`SDCUnrecoverableError`."""
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = blocked._resolve_panel(n, panel, itemsize)
+    chunk = blocked.CHUNK_DEFAULT if chunk is None else chunk
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    m = blocked._pad_to_panel(a, panel)
+    npad = m.shape[0]
+    nb = npad // panel
+    ngroups = -(-nb // chunk)
+    dtype = m.dtype
+    crow0 = blocked._csum_init(m)
+    scale = float(jnp.max(jnp.abs(crow0)))
+    tol = default_tol(npad, dtype, scale) if tol is None else float(tol)
+    report = AbftReport(engine="lu", groups=ngroups, tol=tol)
+    _tls.report = report
+
+    step = _lu_step_jit(panel, chunk, panel_impl, gemm_precision)
+    carry = (m, jnp.arange(npad), jnp.asarray(jnp.inf, dtype), crow0)
+    carry_before = carry   # the last group's rollback point
+    linv_parts, uinv_parts = [], []
+    errs = []
+
+    def run_group(gi: int, g0: int, carry):
+        """One verified group: corrupt-hook poll, step, on-device checksum
+        verdict, bounded replay from the (unchanged) input carry."""
+        replays = 0
+        while True:
+            t0 = time.perf_counter()
+            m_in, perm_in, piv_in, crow_in = carry
+            m_try, _ = _poll_sdc_corrupt(SITE_LU, m_in, g0 * panel, "lu",
+                                         gi, tol=tol)
+            m2, perm2, piv2, linvs, uinvs, crow2, err, col = step(
+                m_try, perm_in, piv_in, crow_in, g0=g0)
+            err_f = float(jax.block_until_ready(err))
+            if not err_f > tol:   # NaN already folded to inf on device
+                if replays:
+                    _emit_repair(report, replays, gi)
+                return ((m2, perm2, piv2, crow2), np.asarray(linvs),
+                        np.asarray(uinvs), err_f)
+            lat = time.perf_counter() - t0
+            col_i = int(col)
+            _record_detection(report, "lu", gi, col_i, err_f, lat,
+                              "replay" if replays < max_replays
+                              else "escalate")
+            if replays >= max_replays:
+                raise _escalate(report, "lu", gi, col_i, err_f)
+            replays += 1
+
+    for gi, g0 in enumerate(range(0, nb, chunk)):
+        carry_before = carry
+        carry, linv_g, uinv_g, err_f = run_group(gi, g0, carry)
+        linv_parts.append(linv_g)
+        uinv_parts.append(uinv_g)
+        errs.append(err_f)
+
+    # The whole-factor identity covers the factored region (and the last
+    # group, whose trailing block is empty). A mismatch that localizes to
+    # the final group replays from the held rollback point; anything
+    # earlier is beyond the carry we kept — typed escalation.
+    fcheck = _JITS.get("final_lu")
+    if fcheck is None:
+        fcheck = jax.jit(blocked._csum_final_err_lu)
+        _JITS["final_lu"] = fcheck
+    final_tol = tol * FINAL_TOL_FACTOR
+    last_gi, last_g0 = ngroups - 1, (ngroups - 1) * chunk
+    for attempt in range(max_replays + 1):
+        fe, fcol = fcheck(carry[0], crow0)
+        fe_f = float(jax.block_until_ready(fe))
+        if not fe_f > final_tol:
+            break
+        col_i = int(fcol)
+        group_i = min(col_i // (panel * chunk), last_gi)
+        _record_detection(report, "lu", group_i, col_i, fe_f, 0.0,
+                          "replay" if (group_i == last_gi
+                                       and attempt < max_replays)
+                          else "escalate")
+        if group_i != last_gi or attempt >= max_replays:
+            raise _escalate(report, "lu", group_i, col_i, fe_f)
+        carry, linv_parts[-1], uinv_parts[-1], errs[-1] = run_group(
+            last_gi, last_g0, carry_before)
+        _emit_repair(report, 1, last_gi)
+
+    m, perm, min_piv, _ = carry
+    errs.append(fe_f)
+    fac = blocked.BlockedLU(
+        m=m, perm=perm, min_abs_pivot=min_piv,
+        linv=jnp.concatenate([jnp.asarray(p) for p in linv_parts]),
+        uinv=jnp.concatenate([jnp.asarray(p) for p in uinv_parts]),
+        abft_err=jnp.asarray(np.asarray(errs, np.float64).astype(
+            np.dtype(str(dtype)))))
+    _tls.report = report
+    return fac, report
+
+
+def solve_lu_abft(a, b, *, panel: Optional[int] = None,
+                  chunk: Optional[int] = None, iters: int = 2,
+                  max_replays: int = DEFAULT_MAX_REPLAYS,
+                  tol: Optional[float] = None):
+    """ABFT-protected LU solve: f32 checksum-carrying factorization (with
+    replay repair) + host-f64 iterative refinement — the contract of
+    ``blocked.solve_refined`` with mid-solve SDC detection added. Returns
+    ``(x float64, factors, AbftReport)``."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    fac, report = lu_factor_abft(jnp.asarray(a64, jnp.float32), panel=panel,
+                                 chunk=chunk, max_replays=max_replays,
+                                 tol=tol)
+    x = np.asarray(blocked.lu_solve(fac, jnp.asarray(b64, jnp.float32)),
+                   dtype=np.float64)
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        d = np.asarray(blocked.lu_solve(fac, jnp.asarray(r, jnp.float32)),
+                       dtype=np.float64)
+        x = x + d
+    return x, fac, report
+
+
+# -- checksum-carrying blocked Cholesky (per-panel groups) -----------------
+
+@functools.lru_cache(maxsize=32)
+def _chol_step_jit(panel: int, gemm_precision: str):
+    import jax
+
+    from functools import partial
+
+    from gauss_tpu.core.matmul import resolve_precision
+    from gauss_tpu.structure import cholesky
+
+    @partial(jax.jit, static_argnames=("kb",))
+    def step(m, min_diag, crow, kb):
+        return cholesky._chol_panel_step(
+            m, min_diag, kb, panel, resolve_precision(gemm_precision),
+            crow=crow)
+
+    return step
+
+
+def cholesky_factor_abft(a, *, panel: Optional[int] = None,
+                         gemm_precision: str = "highest",
+                         max_replays: int = DEFAULT_MAX_REPLAYS,
+                         tol: Optional[float] = None):
+    """Checksum-carrying blocked Cholesky with detect -> localize ->
+    replay; the SPD sibling of :func:`lu_factor_abft` (panel-granular
+    groups — Cholesky has no chunked form to mirror). Returns
+    ``(BlockedCholesky, AbftReport)``; never raises on non-SPD input —
+    check ``min_diag`` (the solve wrapper does, preserving the
+    :class:`~gauss_tpu.structure.cholesky.NotSPDError` contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.structure import cholesky
+
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a.shape}")
+    itemsize = jnp.dtype(a.dtype).itemsize
+    panel = blocked._resolve_panel(n, panel, itemsize)
+    m = blocked._pad_to_panel(a, panel)
+    npad = m.shape[0]
+    nb = npad // panel
+    dtype = m.dtype
+    crow0 = cholesky._csum_sym_init(m)
+    scale = float(jnp.max(jnp.abs(crow0)))
+    tol = default_tol(npad, dtype, scale) if tol is None else float(tol)
+    report = AbftReport(engine="chol", groups=nb, tol=tol)
+    _tls.report = report
+
+    step = _chol_step_jit(panel, gemm_precision)
+    carry = (m, jnp.asarray(jnp.inf, dtype), crow0)
+    carry_before = carry
+    linv_parts = []
+    errs = []
+
+    def run_group(k: int, carry):
+        replays = 0
+        kb = k * panel
+        while True:
+            t0 = time.perf_counter()
+            m_in, mind_in, crow_in = carry
+            m_try, _ = _poll_sdc_corrupt(SITE_CHOL, m_in, kb, "chol", k,
+                                         tol=tol, lower_only=True)
+            m2, mind2, linv, crow2, err = step(m_try, mind_in, crow_in,
+                                               kb=kb)
+            err_f = float(jax.block_until_ready(err))
+            if not err_f > tol:
+                if replays:
+                    _emit_repair(report, replays, k)
+                return (m2, mind2, crow2), np.asarray(linv), err_f
+            lat = time.perf_counter() - t0
+            # The masked check's argmax is internal to the step here; the
+            # panel index IS the localization for per-panel groups.
+            _record_detection(report, "chol", k, kb, err_f, lat,
+                              "replay" if replays < max_replays
+                              else "escalate")
+            if replays >= max_replays:
+                # A checksum that keeps failing with a non-positive
+                # min-diagonal witness is the NOT-SPD signature, not SDC:
+                # the NaN-as-0 fold makes an indefinite operand's "factor"
+                # garbage by design, so A = L L^T cannot hold. (A
+                # corrupted-to-indefinite carry lands here too — the
+                # typed demotion to general LU is right either way; a
+                # TRANSIENT flip never reaches this branch, its first
+                # replay heals it.)
+                mind_f = float(np.asarray(mind_in))
+                if not mind_f > 0.0 or not float(np.asarray(mind2)) > 0.0:
+                    report.escalated = True
+                    _tls.report = report
+                    from gauss_tpu.structure import cholesky as _chol
+
+                    raise _chol.NotSPDError(
+                        f"matrix is not positive definite (Cholesky "
+                        f"min diagonal <= 0 with a persistent checksum "
+                        f"mismatch at panel {k}); route to general LU",
+                        min_diag=min(mind_f,
+                                     float(np.asarray(mind2))))
+                raise _escalate(report, "chol", k, kb, err_f)
+            replays += 1
+
+    for k in range(nb):
+        carry_before = carry
+        carry, linv_k, err_f = run_group(k, carry)
+        linv_parts.append(linv_k)
+        errs.append(err_f)
+
+    fcheck = _JITS.get("final_chol")
+    if fcheck is None:
+        fcheck = jax.jit(cholesky._csum_final_err_chol)
+        _JITS["final_chol"] = fcheck
+    final_tol = tol * FINAL_TOL_FACTOR
+    for attempt in range(max_replays + 1):
+        fe, fcol = fcheck(carry[0], crow0)
+        fe_f = float(jax.block_until_ready(fe))
+        if not fe_f > final_tol:
+            break
+        col_i = int(fcol)
+        group_i = min(col_i // panel, nb - 1)
+        _record_detection(report, "chol", group_i, col_i, fe_f, 0.0,
+                          "replay" if (group_i == nb - 1
+                                       and attempt < max_replays)
+                          else "escalate")
+        if group_i != nb - 1 or attempt >= max_replays:
+            raise _escalate(report, "chol", group_i, col_i, fe_f)
+        carry, linv_parts[-1], errs[-1] = run_group(nb - 1, carry_before)
+        _emit_repair(report, 1, nb - 1)
+
+    m, min_diag, _ = carry
+    errs.append(fe_f)
+    fac = cholesky.BlockedCholesky(
+        m=m, linv=jnp.stack([jnp.asarray(p) for p in linv_parts]),
+        min_diag=min_diag,
+        abft_err=jnp.asarray(np.asarray(errs, np.float64).astype(
+            np.dtype(str(dtype)))))
+    _tls.report = report
+    return fac, report
+
+
+def solve_chol_abft(a, b, *, panel: Optional[int] = None, iters: int = 2,
+                    max_replays: int = DEFAULT_MAX_REPLAYS,
+                    tol: Optional[float] = None):
+    """ABFT-protected SPD solve: checksum-carrying Cholesky (with replay
+    repair) + host-f64 refinement — ``cholesky.solve_spd_refined``'s
+    contract with mid-solve SDC detection. Returns
+    ``(x float64, factors, AbftReport)``; raises
+    :class:`~gauss_tpu.structure.cholesky.NotSPDError` on non-SPD input
+    (the router's demotion signal, unchanged)."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.structure import cholesky
+
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    fac, report = cholesky_factor_abft(
+        jnp.asarray(a64, jnp.float32), panel=panel,
+        max_replays=max_replays, tol=tol)
+    mind = float(np.asarray(fac.min_diag))
+    if not mind > 0.0:
+        raise cholesky.NotSPDError(
+            f"matrix is not positive definite (Cholesky min diagonal "
+            f"{mind:g}); route to general LU", min_diag=mind)
+    x = np.asarray(cholesky.cholesky_solve(fac, jnp.asarray(b64,
+                                                            jnp.float32)),
+                   dtype=np.float64)
+    for _ in range(iters):
+        r = b64 - a64 @ x
+        d = np.asarray(cholesky.cholesky_solve(
+            fac, jnp.asarray(r, jnp.float32)), dtype=np.float64)
+        x = x + d
+    return x, fac, report
+
+
+# -- ABFT matmul: detect + correct single-element GEMM errors --------------
+
+def abft_matmul(a, b, *, precision: str = "highest", correct: bool = True,
+                tol: Optional[float] = None):
+    """``C = A @ B`` with full Huang-Abraham checksums: the column-checksum
+    row ``(e^T A) B`` and the row-checksum column ``A (B e)`` predict C's
+    column and row sums. A single corrupted element is localized to the
+    intersection of the one mismatching row and one mismatching column and
+    corrected IN PLACE from the column-sum excess (to checksum precision);
+    multi-element corruption is repaired by recomputation. Returns
+    ``(c, info)`` with ``info = {detections, corrected, recomputed,
+    row, col, magnitude}``.
+
+    Hook site ``abft.matmul`` (kind ``sdc_bitflip``) corrupts the
+    on-device product between compute and verification."""
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.kernels.matmul_pallas import resolve_precision
+
+    prec = resolve_precision(precision)
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+
+    mm = _JITS.get(("mm", precision))
+    if mm is None:
+        def impl(a, b):
+            return jnp.dot(a, b, precision=prec)
+
+        mm = jax.jit(impl)
+        _JITS[("mm", precision)] = mm
+    chk = _JITS.get(("mmchk", precision))
+    if chk is None:
+        def chk_impl(a, b, c):
+            ccol = jnp.dot(jnp.sum(a, axis=0, keepdims=True), b,
+                           precision=prec)
+            crow = jnp.dot(a, jnp.sum(b, axis=1, keepdims=True),
+                           precision=prec)
+            dcol = jnp.sum(c, axis=0) - ccol[0]
+            drow = jnp.sum(c, axis=1) - crow[:, 0]
+            fold = lambda d: jnp.where(jnp.isnan(d), jnp.inf, jnp.abs(d))
+            return fold(dcol), fold(drow), dcol
+
+        chk = jax.jit(chk_impl)
+        _JITS[("mmchk", precision)] = chk
+
+    c = mm(a, b)
+    c, _ = _poll_sdc_corrupt(SITE_MATMUL, c, 0, "matmul", 0)
+    k = a.shape[1]
+    if tol is None:
+        eps = float(np.finfo(np.dtype(str(c.dtype))).eps)
+        scale = max(1.0, float(jnp.max(jnp.abs(a)))
+                    * float(jnp.max(jnp.abs(b))) * k)
+        tol = scale * max(64.0 * max(a.shape[0], b.shape[1], k) * eps, 1e-6)
+    info = {"detections": 0, "corrected": False, "recomputed": False,
+            "row": None, "col": None, "magnitude": 0.0, "tol": float(tol)}
+    dcol_a, drow_a, dcol = chk(a, b, c)
+    bad_cols = np.nonzero(np.asarray(dcol_a) > tol)[0]
+    bad_rows = np.nonzero(np.asarray(drow_a) > tol)[0]
+    if not len(bad_cols) and not len(bad_rows):
+        return c, info
+    info["detections"] = 1
+    mag = float(max(np.max(np.asarray(dcol_a)[bad_cols], initial=0.0),
+                    np.max(np.asarray(drow_a)[bad_rows], initial=0.0)))
+    info["magnitude"] = mag
+    obs.counter("abft.sdc_detected")
+    if correct and len(bad_cols) == 1 and len(bad_rows) == 1:
+        i, j = int(bad_rows[0]), int(bad_cols[0])
+        delta = float(np.asarray(dcol)[j])
+        if np.isfinite(delta):
+            c2 = c.at[i, j].add(jnp.asarray(-delta, c.dtype))
+            # Re-verify: a very large corrupted value inflates the f32
+            # column sum's ulp past the true terms, leaving the correction
+            # delta imprecise — if the repaired product still fails its
+            # checksums, fall through to recomputation instead of
+            # shipping an almost-corrected element.
+            d2c, d2r, _ = chk(a, b, c2)
+            if (float(np.max(np.asarray(d2c))) <= tol
+                    and float(np.max(np.asarray(d2r))) <= tol):
+                info.update(corrected=True, row=i, col=j)
+                obs.counter("abft.sdc_corrected")
+                obs.emit("sdc", engine="matmul", group=0, col=j, row=i,
+                         magnitude=mag, action="correct")
+                return c2, info
+    # Wider (or non-finite) corruption: recompute — GEMM replay is the
+    # whole-operation rollback, cheap at O(mnk) once.
+    c = mm(a, b)
+    info["recomputed"] = True
+    obs.counter("abft.replays")
+    obs.emit("sdc", engine="matmul", group=0,
+             col=int(bad_cols[0]) if len(bad_cols) else -1,
+             magnitude=mag, action="recompute")
+    return c, info

@@ -1,0 +1,492 @@
+"""ABFT campaign runner: ``python -m gauss_tpu.resilience.abftcheck``.
+
+Sweeps seeded on-device ``sdc_bitflip`` faults (gauss_tpu.resilience
+.inject) across the checksum-carrying LU and Cholesky engines
+(gauss_tpu.resilience.abft) and asserts the SDC invariant the chaos stack
+now extends to silent data corruption:
+
+    **every injected on-device corruption is DETECTED by the checksum
+    invariant before the final residual gate, LOCALIZED to the panel group
+    that produced it, and repaired — by the localized replay rung for
+    transient faults (bit-identical to an uninterrupted ABFT run) or by
+    escalation through the full recovery ladder for persistent ones — and
+    the runner independently verifies every solution at the 1e-4 gate.
+    Never a silent wrong answer, never a missed detection.**
+
+Three phases:
+
+- **sdc** (``--cases``): each case draws an engine (LU / Cholesky), a
+  size, a panel group, and a transient-or-persistent scenario from a
+  seeded catalog, installs an ``sdc_bitflip`` plan at the engine's ABFT
+  group site, and runs the full ``recover.solve_resilient`` ladder with
+  ABFT on. Replay-recovered solutions must be bit-identical to the
+  unfaulted ABFT solve of the same system.
+- **identity** (``--no-identity`` to skip): the zero-overhead contract —
+  ``abft=False`` paths must be BIT-IDENTICAL to the checksum-carrying
+  forms' factor output (the checksum is a rider, never an operand) across
+  the flat, chunked, host-stepped-LU, and Cholesky forms, and the plain
+  (abft off) solve's seconds-per-solve is recorded as the regression
+  sentinel ``abft:plain_s_per_solve`` — checksum machinery creeping into
+  the unprotected hot path gates like a perf regression.
+- **matmul** (``--no-matmul`` to skip): single-element GEMM corruption
+  must be localized to its row x column checksum intersection and
+  corrected in place (to checksum precision); wider corruption must be
+  repaired by recomputation.
+
+The summary (``--summary-json``) is regress-ingestable
+(``kind: abft_campaign``). Exit status: 2 when the invariant is violated
+(missed detection, silent wrong answer, bit-identity failure), 1 when
+``--regress-check`` finds an out-of-band metric, 0 otherwise.
+
+``make abft-check`` runs the CPU smoke configuration CI gates on (>= 100
+injected faults across both engines).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+#: scenario catalog: transient dominates ~11:1 (real SDC is overwhelmingly
+#: one-shot; the persistent slice exists to prove the escalate-to-ladder
+#: path, and budgets the replay-recovery rate at ~92%).
+SCENARIOS = (("transient", 11), ("persistent", 1))
+
+#: default sweep sizes — chosen so the LU rung path (panel 16, the ladder's
+#: CHUNK_DEFAULT grouping) has >= 2 panel groups to localize across.
+LU_SIZES = (96, 128)
+CHOL_SIZES = (64, 96)
+
+
+def _lu_groups(n: int, panel: int) -> int:
+    from gauss_tpu.core import blocked
+
+    nb = -(-n // panel)
+    return -(-nb // blocked.CHUNK_DEFAULT)
+
+
+def _system_lu(rng: np.random.Generator, n: int):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _system_chol(rng: np.random.Generator, n: int):
+    from gauss_tpu.io import synthetic
+
+    return np.asarray(synthetic.spd_matrix(n)), rng.standard_normal(n)
+
+
+def run_sdc_case(i: int, seed: int, gate: float, panel: int = 16,
+                 lu_sizes=LU_SIZES, chol_sizes=CHOL_SIZES,
+                 clean_cache: Optional[dict] = None) -> Dict:
+    """One seeded on-device SDC case; returns its outcome record.
+
+    Shared with the chaos campaign's sdc phase
+    (gauss_tpu.resilience.chaos) — one case runner, two harnesses."""
+    from gauss_tpu.resilience import abft, inject, recover
+    from gauss_tpu.verify import checks
+
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0xABF7, i)))
+    engine = ("lu", "chol")[i % 2]
+    names = [s for s, w in SCENARIOS for _ in range(w)]
+    scenario = names[int(rng.integers(0, len(names)))]
+    if engine == "lu":
+        n = int(lu_sizes[int(rng.integers(0, len(lu_sizes)))])
+        a, b = _system_lu(np.random.default_rng(
+            np.random.SeedSequence((seed, 0, n))), n)
+        groups = _lu_groups(n, panel)
+        site = abft.SITE_LU
+        rungs = None
+    else:
+        n = int(chol_sizes[int(rng.integers(0, len(chol_sizes)))])
+        a, b = _system_chol(np.random.default_rng(
+            np.random.SeedSequence((seed, 1, n))), n)
+        groups = -(-n // panel)
+        site = abft.SITE_CHOL
+        rungs = recover.structured_rungs("spd", abft=True)
+    group = int(rng.integers(0, groups))
+
+    # The unfaulted ABFT solve of this exact system — the bit-identity
+    # reference for replay recovery (cached per (engine, n): the systems
+    # are deterministic per campaign seed).
+    key = (engine, n)
+    if clean_cache is None:
+        clean_cache = {}
+    if key not in clean_cache:
+        if rungs is None:
+            clean = recover.solve_resilient(a, b, gate=gate, panel=panel,
+                                            abft=True)
+        else:
+            clean = recover.solve_resilient(a, b, gate=gate, panel=panel,
+                                            rungs=rungs)
+        clean_cache[key] = clean.x
+    clean_x = clean_cache[key]
+
+    spec = inject.FaultSpec(
+        site=site, kind="sdc_bitflip", skip=group, seed=i,
+        max_triggers=1 if scenario == "transient" else None)
+    out = {"case": i, "engine": engine, "n": n, "scenario": scenario,
+           "group": group}
+    with inject.plan(inject.FaultPlan([spec], seed=seed)) as ap:
+        try:
+            if rungs is None:
+                res = recover.solve_resilient(a, b, gate=gate, panel=panel,
+                                              abft=True)
+            else:
+                res = recover.solve_resilient(a, b, gate=gate, panel=panel,
+                                              rungs=rungs)
+            rel = checks.residual_norm(a, res.x, b, relative=True)
+            sdc = res.sdc or {}
+            detected = bool(sdc.get("detections"))
+            if not (np.isfinite(rel) and rel <= gate):
+                out.update(outcome="silent_wrong", rung=res.rung,
+                           rel_residual=float(rel), detected=detected)
+            elif res.rung_index == 0 and detected:
+                out.update(outcome="replayed", rung=res.rung,
+                           detected=True, replays=sdc.get("replays"),
+                           detect_groups=sdc.get("detect_groups"),
+                           localized=group in (sdc.get("detect_groups")
+                                               or []),
+                           detect_latency_s=sdc.get("detect_latency_s"),
+                           bit_identical=bool(np.array_equal(res.x,
+                                                             clean_x)),
+                           rel_residual=float(rel))
+            elif res.rung_index > 0:
+                out.update(outcome="escalated", rung=res.rung,
+                           detected=detected, rel_residual=float(rel))
+            else:
+                out.update(outcome="missed" if ap.stats()["triggered"]
+                           else "no_fault", rung=res.rung,
+                           detected=detected, rel_residual=float(rel))
+        except recover.UnrecoverableSolveError as e:
+            out.update(outcome="typed_error", trigger=e.trigger,
+                       detected=True)
+        except Exception as e:  # noqa: BLE001 — an untyped escape IS the bug
+            out.update(outcome="violation",
+                       error=f"{type(e).__name__}: {e}"[:200])
+        out["injected"] = ap.stats()["triggered"]
+    return out
+
+
+def summarize_sdc_cases(outcomes: List[Dict], wall_s: float) -> Dict:
+    counts: Dict[str, int] = {}
+    by_engine: Dict[str, int] = {}
+    injected = 0
+    missed = 0
+    bit_fail = 0
+    mislocalized = 0
+    lats: List[float] = []
+    for o in outcomes:
+        counts[o["outcome"]] = counts.get(o["outcome"], 0) + 1
+        injected += o.get("injected", 0)
+        if o.get("injected") and not o.get("detected"):
+            missed += 1
+        if o["outcome"] == "replayed":
+            by_engine[o["engine"]] = by_engine.get(o["engine"], 0) + 1
+            if not o.get("bit_identical"):
+                bit_fail += 1
+            if not o.get("localized"):
+                mislocalized += 1
+            lats.extend(o.get("detect_latency_s") or [])
+    replayed = counts.get("replayed", 0)
+    escalated = counts.get("escalated", 0)
+    faulted = sum(1 for o in outcomes if o.get("injected"))
+    violations = (counts.get("silent_wrong", 0)
+                  + counts.get("violation", 0) + missed + bit_fail)
+    return {
+        "cases": len(outcomes), "counts": counts, "injected": injected,
+        "faulted_cases": faulted, "missed": missed,
+        "detect_rate": round((faulted - missed) / faulted, 4)
+        if faulted else None,
+        "replayed": replayed, "escalated": escalated,
+        "replay_rate": round(replayed / (replayed + escalated), 4)
+        if replayed + escalated else None,
+        "replayed_by_engine": by_engine,
+        "bit_identity_failures": bit_fail,
+        "mislocalized": mislocalized,
+        "mean_detect_latency_s": round(float(np.mean(lats)), 6)
+        if lats else None,
+        "violations": violations, "wall_s": round(wall_s, 3),
+    }
+
+
+def run_sdc_phase(cases: int, seed: int, gate: float, panel: int = 16,
+                  log=print) -> Dict:
+    from gauss_tpu import obs
+
+    outcomes: List[Dict] = []
+    clean_cache: dict = {}
+    t0 = time.perf_counter()
+    with obs.span("abft_sdc_phase", cases=cases):
+        for i in range(cases):
+            outcomes.append(run_sdc_case(i, seed, gate, panel=panel,
+                                         clean_cache=clean_cache))
+            if (i + 1) % 25 == 0:
+                log(f"  sdc cases: {i + 1}/{cases}")
+    return summarize_sdc_cases(outcomes, time.perf_counter() - t0)
+
+
+def run_identity_phase(seed: int, reps: int = 3) -> Dict:
+    """The zero-overhead / bit-identity contract: abft=False output must
+    equal the checksum-carrying forms' factor bit for bit, and the plain
+    path's timing is the regression sentinel."""
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu import obs
+    from gauss_tpu.core import blocked
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.resilience import abft
+    from gauss_tpu.structure import cholesky
+
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0x1DE47)))
+    n = 96
+    a, b = _system_lu(rng, n)
+    a32 = jnp.asarray(a, jnp.float32)
+    mismatches: List[str] = []
+
+    def cmp(tag, f0, f1, fields):
+        for f in fields:
+            if not np.array_equal(np.asarray(getattr(f0, f)),
+                                  np.asarray(getattr(f1, f))):
+                mismatches.append(f"{tag}.{f}")
+
+    with obs.span("abft_identity_phase"):
+        lu_fields = ("m", "perm", "min_abs_pivot", "linv", "uinv")
+        cmp("flat", blocked.lu_factor_blocked(a32, panel=16),
+            blocked.lu_factor_blocked(a32, panel=16, abft=True), lu_fields)
+        ck0 = blocked.lu_factor_blocked_chunked(a32, panel=16, chunk=2)
+        cmp("chunked", ck0,
+            blocked.lu_factor_blocked_chunked(a32, panel=16, chunk=2,
+                                              abft=True), lu_fields)
+        stepped, _ = abft.lu_factor_abft(a32, panel=16, chunk=2)
+        cmp("stepped", ck0, stepped, lu_fields)
+        aspd = jnp.asarray(synthetic.spd_matrix(n), jnp.float32)
+        ch0 = cholesky.cholesky_factor_blocked(aspd, panel=16)
+        cmp("chol_flat", ch0,
+            cholesky.cholesky_factor_blocked(aspd, panel=16, abft=True),
+            ("m", "linv", "min_diag"))
+        ch_stepped, _ = abft.cholesky_factor_abft(aspd, panel=16)
+        cmp("chol_stepped", ch0, ch_stepped, ("m", "linv", "min_diag"))
+
+        # Plain-path timing (abft OFF) — the zero-overhead sentinel; and
+        # the protected path's cost as the honest overhead record.
+        def best_of(fn):
+            fn()  # warmup / compile outside the timed reps
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        plain_s = best_of(
+            lambda: blocked.lu_factor_blocked_chunked(a32, panel=16,
+                                                      chunk=2).m)
+        abft_s = best_of(lambda: abft.lu_factor_abft(a32, panel=16,
+                                                     chunk=2)[0].m)
+    return {
+        "ran": True, "n": n, "bit_identical": not mismatches,
+        "mismatches": mismatches,
+        "plain_s_per_solve": round(plain_s, 6),
+        "abft_s_per_solve": round(abft_s, 6),
+        "overhead_ratio": round(abft_s / plain_s, 4) if plain_s else None,
+    }
+
+
+def run_matmul_phase(cases: int, seed: int) -> Dict:
+    from gauss_tpu import obs
+    from gauss_tpu.resilience import abft, inject
+
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0x3A73)))
+    corrected = recomputed = detections = 0
+    max_dev = 0.0
+    violations = 0
+    with obs.span("abft_matmul_phase", cases=cases):
+        for i in range(cases):
+            mm, kk, nn = (int(rng.integers(24, 64)) for _ in range(3))
+            a = rng.standard_normal((mm, kk)).astype(np.float32)
+            b = rng.standard_normal((kk, nn)).astype(np.float32)
+            clean, info0 = abft.abft_matmul(a, b)
+            if info0["detections"]:
+                violations += 1  # clean product must verify clean
+                continue
+            plan = inject.FaultPlan([inject.FaultSpec(
+                site=abft.SITE_MATMUL, kind="sdc_bitflip",
+                max_triggers=1, seed=i)], seed=seed)
+            with inject.plan(plan) as ap:
+                fixed, info = abft.abft_matmul(a, b)
+            if not ap.stats()["triggered"]:
+                continue
+            detections += info["detections"]
+            corrected += bool(info["corrected"])
+            recomputed += bool(info["recomputed"])
+            if not (info["corrected"] or info["recomputed"]):
+                violations += 1
+            dev = float(np.max(np.abs(np.asarray(fixed)
+                                      - np.asarray(clean))))
+            max_dev = max(max_dev, dev)
+            if dev > info["tol"]:
+                violations += 1
+    return {"ran": True, "cases": cases, "detections": detections,
+            "corrected": corrected, "recomputed": recomputed,
+            "max_dev": max_dev, "violations": violations}
+
+
+def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
+    """(metric, value, unit) records an ABFT campaign contributes to the
+    regression history — all slow-side-gated: detection regressing shows
+    as a higher escalation rate or latency, overhead regressing as more
+    seconds per solve (the plain path is the zero-overhead sentinel)."""
+    out: List[Tuple[str, float, str]] = []
+    sdc = summary.get("sdc") or {}
+    if sdc.get("wall_s") and sdc.get("cases"):
+        out.append(("abft:s_per_case",
+                    round(sdc["wall_s"] / sdc["cases"], 6), "s"))
+    if sdc.get("mean_detect_latency_s"):
+        out.append(("abft:detect_latency_s",
+                    sdc["mean_detect_latency_s"], "s"))
+    esc = sdc.get("escalated")
+    if isinstance(esc, int) and esc > 0 and sdc.get("cases"):
+        out.append(("abft:escalation_rate",
+                    round(esc / sdc["cases"], 4), "ratio"))
+    ident = summary.get("identity") or {}
+    if ident.get("plain_s_per_solve"):
+        out.append(("abft:plain_s_per_solve", ident["plain_s_per_solve"],
+                    "s"))
+    if ident.get("overhead_ratio"):
+        out.append(("abft:overhead_ratio", ident["overhead_ratio"], "x"))
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.resilience.abftcheck",
+        description="Seeded ABFT campaign: inject on-device sdc_bitflip "
+                    "faults at panel-group boundaries of the checksum-"
+                    "carrying LU/Cholesky engines; assert 100%% detection, "
+                    "localized replay recovery (bit-identical), ladder "
+                    "escalation for persistent faults, and the abft-off "
+                    "zero-overhead/bit-identity contract.")
+    p.add_argument("--cases", type=int, default=110,
+                   help="sdc-phase fault cases (default 110: >= 100 "
+                        "injected faults across LU + Cholesky)")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--panel", type=int, default=16)
+    p.add_argument("--gate", type=float, default=1e-4)
+    p.add_argument("--matmul-cases", type=int, default=8)
+    p.add_argument("--no-identity", action="store_true",
+                   help="skip the bit-identity / zero-overhead phase")
+    p.add_argument("--no-matmul", action="store_true",
+                   help="skip the GEMM single-element-correction phase")
+    p.add_argument("--metrics-out", default=None, metavar="PATH")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the campaign summary (regress-ingestable: "
+                        "kind=abft_campaign)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append this campaign's records to the regression "
+                        "history (default reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate this campaign against the history baselines "
+                        "(exit 1 when out of band)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    honor_jax_platforms()
+
+    from gauss_tpu import obs
+    from gauss_tpu.obs import regress
+
+    t0 = time.perf_counter()
+    with obs.run(metrics_out=args.metrics_out, tool="abft_campaign",
+                 cases=args.cases, seed=args.seed):
+        sdc = run_sdc_phase(args.cases, args.seed, args.gate,
+                            panel=args.panel)
+        ident = {} if args.no_identity else run_identity_phase(args.seed)
+        mat = ({} if args.no_matmul
+               else run_matmul_phase(args.matmul_cases, args.seed))
+        wall = round(time.perf_counter() - t0, 3)
+        violations = (sdc["violations"]
+                      + (0 if not ident or ident["bit_identical"] else 1)
+                      + (mat.get("violations", 0) if mat else 0))
+        summary = {
+            "kind": "abft_campaign", "seed": args.seed,
+            "gate": args.gate, "panel": args.panel,
+            "sdc": sdc, "identity": ident, "matmul": mat,
+            "wall_s": wall, "invariant_ok": violations == 0,
+        }
+        obs.emit("abft_campaign",
+                 **{k: v for k, v in summary.items() if k != "kind"})
+
+    c = sdc["counts"]
+    print(f"abft campaign: {sdc['cases']} sdc case(s), {sdc['injected']} "
+          f"on-device fault(s) injected ({sdc['faulted_cases']} faulted "
+          f"case(s))")
+    print(f"  detection: rate={sdc['detect_rate']}, {sdc['missed']} "
+          f"missed; replay-recovered {sdc['replayed']} "
+          f"(rate {sdc['replay_rate']}, by engine "
+          f"{sdc['replayed_by_engine']}, {sdc['bit_identity_failures']} "
+          f"bit-identity failure(s), {sdc['mislocalized']} mislocalized), "
+          f"{sdc['escalated']} ladder escalation(s), "
+          f"{c.get('silent_wrong', 0)} SILENT WRONG, "
+          f"{c.get('violation', 0)} untyped")
+    if ident:
+        print(f"  identity: bit_identical={ident['bit_identical']}"
+              + (f" MISMATCHES={ident['mismatches']}"
+                 if ident["mismatches"] else "")
+              + f", plain {ident['plain_s_per_solve']} s/solve, abft "
+                f"{ident['abft_s_per_solve']} s/solve "
+                f"({ident['overhead_ratio']}x)")
+    if mat:
+        print(f"  matmul: {mat['detections']} detection(s) -> "
+              f"{mat['corrected']} corrected in place, "
+              f"{mat['recomputed']} recomputed, max deviation "
+              f"{mat['max_dev']:.2e}, {mat['violations']} violation(s)")
+    print(f"  invariant {'HOLDS' if violations == 0 else 'VIOLATED'} "
+          f"({wall} s)")
+
+    if args.summary_json:
+        parent = os.path.dirname(args.summary_json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.summary_json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    records = [{"metric": m, "value": v, "unit": u, "source": "abft",
+                "kind": "abft"} for m, v, u in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(
+            records, regress.load_history(history_path))
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = 1
+    if args.history is not None and records and rc == 0:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+
+    if violations:
+        print(f"abftcheck: INVARIANT VIOLATED ({violations} case(s))",
+              file=sys.stderr)
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

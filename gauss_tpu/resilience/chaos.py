@@ -35,6 +35,16 @@ Five phases:
   wrong tag, forced through the ``structure.detect`` mis-tag hook; the
   router must demote down the recovery ladder to general LU and end with
   an independently verified solution or a typed error.
+- **sdc** (``--sdc-cases``, 0 disables): ON-DEVICE silent data corruption
+  — seeded ``sdc_bitflip`` faults at the ABFT panel-group sites of the
+  checksum-carrying LU and Cholesky engines
+  (gauss_tpu.resilience.abft); every corruption must be DETECTED by the
+  checksum invariant before the final residual gate, localized to its
+  panel group, and repaired by the localized replay rung (bit-identical
+  to an uninterrupted ABFT run) or, for persistent corruption, by
+  escalation through the full ladder. The case runner is shared with
+  ``make abft-check`` (gauss_tpu.resilience.abftcheck — the deep
+  campaign); this phase keeps the invariant inside the one chaos gate.
 
 The summary (``--summary-json``) is regress-ingestable
 (``kind: chaos_campaign``): recovery depth (``mean_rung``), typed-error
@@ -372,6 +382,30 @@ def run_structure_phase(seed: int, gate: float) -> Dict:
             "violations": violations}
 
 
+def run_sdc_phase(cases: int, seed: int, gate: float, log=print) -> Dict:
+    """On-device SDC chaos: the abftcheck case runner under the campaign
+    invariant (100% detection, replay-or-ladder recovery, bit-identity)."""
+    from gauss_tpu import obs
+    from gauss_tpu.resilience import abftcheck
+
+    outcomes: List[Dict] = []
+    clean_cache: Dict = {}
+    by_site: Dict[str, int] = {}
+    t0 = time.perf_counter()
+    with obs.span("chaos_sdc_phase", cases=cases):
+        for i in range(cases):
+            o = abftcheck.run_sdc_case(i, seed, gate,
+                                       clean_cache=clean_cache)
+            outcomes.append(o)
+            site = f"abft.{o['engine']}.group"
+            by_site[site] = by_site.get(site, 0) + o.get("injected", 0)
+    summ = abftcheck.summarize_sdc_cases(outcomes,
+                                         time.perf_counter() - t0)
+    summ["ran"] = True
+    summ["injected_by_site"] = by_site
+    return summ
+
+
 def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
     """(metric, value, unit) records a campaign contributes to the
     regression history. All slow-side-gated: recovery regressing shows as a
@@ -422,6 +456,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(subprocess workers; the slowest phase)")
     p.add_argument("--no-structure", action="store_true",
                    help="skip the structured-solve mis-tag phase")
+    p.add_argument("--sdc-cases", type=int, default=12,
+                   help="on-device sdc_bitflip cases against the ABFT "
+                        "checksum engines (0 disables; the deep campaign "
+                        "is `make abft-check`)")
     p.add_argument("--tmpdir", default="/tmp",
                    help="where the checkpoint phase writes its files")
     p.add_argument("--metrics-out", default=None, metavar="PATH",
@@ -468,6 +506,8 @@ def main(argv=None) -> int:
                else run_fleet_phase(args.seed, args.gate))
         struct = ({} if args.no_structure
                   else run_structure_phase(args.seed, args.gate))
+        sdc = (run_sdc_phase(args.sdc_cases, args.seed, args.gate)
+               if args.sdc_cases > 0 else {})
         wall = round(time.perf_counter() - t0, 3)
 
         violations = (solver["counts"]["silent_wrong"]
@@ -476,11 +516,13 @@ def main(argv=None) -> int:
                       + (serve.get("unresolved", 0) if serve else 0)
                       + (0 if not ckpt or ckpt["bit_identical"] else 1)
                       + (flt.get("violations", 0) if flt else 0)
-                      + (struct.get("violations", 0) if struct else 0))
+                      + (struct.get("violations", 0) if struct else 0)
+                      + (sdc.get("violations", 0) if sdc else 0))
         injected = (solver["injected"] + (serve.get("injected", 0))
                     + (ckpt.get("injected", 0) if ckpt else 0)
                     + (flt.get("injected", 0) if flt else 0)
-                    + (struct.get("injected", 0) if struct else 0))
+                    + (struct.get("injected", 0) if struct else 0)
+                    + (sdc.get("injected", 0) if sdc else 0))
         sites = dict(solver["injected_by_site"])
         for k, v in (serve.get("injected_by_site") or {}).items():
             sites[k] = sites.get(k, 0) + v
@@ -493,12 +535,14 @@ def main(argv=None) -> int:
         if struct.get("injected"):
             sites["structure.detect"] = (sites.get("structure.detect", 0)
                                          + struct["injected"])
+        for k, v in (sdc.get("injected_by_site") or {}).items():
+            sites[k] = sites.get(k, 0) + v
         summary = {
             "kind": "chaos_campaign", "seed": args.seed,
             "engines": engines, "sizes": sizes, "gate": args.gate,
             "injected": injected, "injected_by_site": sites,
             "solver": solver, "serve": serve, "checkpoint": ckpt,
-            "fleet": flt, "structure": struct,
+            "fleet": flt, "structure": struct, "sdc": sdc,
             "wall_s": wall, "invariant_ok": violations == 0,
         }
         obs.emit("chaos_campaign",
@@ -536,6 +580,13 @@ def main(argv=None) -> int:
         print(f"  structure: {len(struct['cases'])} mis-tag case(s) -> "
               f"{by_outcome}, {struct['demotions']} demotion(s), "
               f"{struct['violations']} violation(s)")
+    if sdc:
+        print(f"  sdc: {sdc['cases']} on-device case(s), "
+              f"{sdc['injected']} bitflip(s) -> detect rate "
+              f"{sdc['detect_rate']}, {sdc['replayed']} replay-recovered, "
+              f"{sdc['escalated']} escalated, "
+              f"{sdc['bit_identity_failures']} bit-identity failure(s), "
+              f"{sdc['violations']} violation(s)")
     print(f"  invariant {'HOLDS' if violations == 0 else 'VIOLATED'} "
           f"({wall} s)")
 

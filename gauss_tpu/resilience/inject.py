@@ -35,6 +35,18 @@ in docs/RESILIENCE.md):
                             ``mistag``) — proves a lying classifier
                             demotes to general LU instead of shipping a
                             wrong answer — gauss_tpu.structure.router
+    abft.lu.group           flip one bit of one element of the ON-DEVICE
+    abft.chol.group         factorization carry at a panel-group boundary
+                            (kind ``sdc_bitflip``; ``skip`` picks the
+                            group, ``param`` > 0 pins the bit index) —
+                            the silent-data-corruption stand-in the ABFT
+                            checksum invariant must detect, localize, and
+                            repair — gauss_tpu.resilience.abft
+    abft.matmul             same, against an ABFT matmul's on-device
+                            output block (single-element GEMM errors are
+                            corrected in place from the row x column
+                            checksum intersection) —
+                            gauss_tpu.resilience.abft.abft_matmul
 
 Design rules:
 
@@ -81,8 +93,12 @@ ENV_VAR = "GAUSS_FAULTS"
 CORRUPT_KINDS = ("nan", "inf", "bitflip", "near_zero_pivot")
 #: kinds with dedicated action helpers; ``mistag`` forces the structure
 #: router's routing tag to ``STRUCTURE_KINDS[int(param)]`` (see
-#: gauss_tpu.structure.router.routed_tag) — the lying-classifier fault.
-ACTION_KINDS = ("raise", "compile_fail", "delay", "kill", "stall", "mistag")
+#: gauss_tpu.structure.router.routed_tag) — the lying-classifier fault;
+#: ``sdc_bitflip`` flips one bit of one ON-DEVICE array element at an ABFT
+#: panel-group site (the corruption is applied by the owning runner via
+#: :func:`poll_sdc` — this module never touches device arrays itself).
+ACTION_KINDS = ("raise", "compile_fail", "delay", "kill", "stall", "mistag",
+                "sdc_bitflip")
 KINDS = CORRUPT_KINDS + ACTION_KINDS
 
 #: exit status used by kind="kill" — distinctive, so a harness can tell an
@@ -373,6 +389,23 @@ def maybe_raise(site: str) -> None:
             f"(simulated scoped-VMEM compile failure injected at {site})")
     if sp.kind == "raise":
         raise SimulatedFaultError(f"injected fault at {site}")
+
+
+def poll_sdc(site: str):
+    """Poll ``site`` for an on-device silent-data-corruption fault (kind
+    ``sdc_bitflip``). Returns ``(spec, rng)`` when one fires — the caller
+    owns the device array and applies the flip itself (jitted XOR on the
+    bitcast element; see gauss_tpu.resilience.abft) — else None. Other
+    kinds at the site are ignored (wrong hook shape), matching the other
+    ``maybe_*`` helpers; the trigger still counts and emits its ``fault``
+    event either way."""
+    ap = _ACTIVE
+    if ap is None:
+        return None
+    sp = ap.poll(site)
+    if sp is None or sp.kind != "sdc_bitflip":
+        return None
+    return sp, ap.rng_for(sp)
 
 
 def maybe_delay(site: str) -> float:

@@ -7,7 +7,18 @@ along an explicit recovery ladder instead of returning a wrong answer or
 crashing:
 
     rung 0  primary engine        blocked f32 factor + host-f64 refinement
-                                  (or the rank-1 oracle engine)
+                                  (or the rank-1 oracle engine); with
+                                  ``abft=True`` this is the CHECKSUM-
+                                  CARRYING form (gauss_tpu.resilience
+                                  .abft): silent data corruption is
+                                  detected within one panel group and
+                                  REPLAYED in place from the last-good
+                                  carry (the localized replay rung —
+                                  emitted as ``rung="abft_replay"``
+                                  recovery events), and only a replay
+                                  failure (persistent corruption, typed
+                                  ``SDCUnrecoverableError``) escalates to
+                                  the rungs below
     rung 1  pivot_safe            re-factor with ``zero_pivot_safe``
                                   pivoting (a corrupted or near-singular
                                   system factors to a FINITE factor the
@@ -51,12 +62,23 @@ DEFAULT_GATE = 1e-4
 
 ENGINES = ("blocked", "rank1")
 
-def default_rungs(engine: str = "blocked") -> Tuple[str, ...]:
-    """The ladder's rung names in escalation order for a primary engine."""
+def default_rungs(engine: str = "blocked",
+                  abft: bool = False) -> Tuple[str, ...]:
+    """The ladder's rung names in escalation order for a primary engine.
+
+    ``abft=True`` swaps the blocked rung 0 for its checksum-carrying form
+    (in-rung detect/localize/replay; see gauss_tpu.resilience.abft) —
+    the full ladder below it is unchanged, so replay failure escalates
+    through exactly the pre-existing chain."""
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}; options: {ENGINES}")
     alternate = "rank1" if engine == "blocked" else "blocked"
-    return (engine, "pivot_safe", "ds_refine", alternate, "numpy_f64")
+    base = (engine, "pivot_safe", "ds_refine", alternate, "numpy_f64")
+    if abft:
+        # PREPEND the checksum-carrying rung: replay failure (persistent
+        # corruption) escalates to the EXISTING full ladder, unchanged.
+        return ("abft",) + base
+    return base
 
 
 class UnrecoverableSolveError(RuntimeError):
@@ -83,10 +105,19 @@ class ResilientResult:
     attempts: int              # rungs tried (1 = no escalation)
     rel_residual: float
     escalations: List[Tuple[str, str]]  # (rung, trigger) of each failure
+    #: ABFT accounting when an abft rung ran (gauss_tpu.resilience.abft
+    #: report as a dict: detections / replays / escalated / localization);
+    #: None on non-ABFT ladders and on ladders whose abft rung never saw a
+    #: checksum mismatch is still a populated dict with detections == 0.
+    sdc: Optional[dict] = None
 
     @property
     def recovered(self) -> bool:
         return self.rung_index > 0
+
+    @property
+    def sdc_detected(self) -> bool:
+        return bool(self.sdc and self.sdc.get("detections"))
 
 
 def _gate(a64: np.ndarray, b64: np.ndarray, x, factors=None,
@@ -174,6 +205,30 @@ def _rung_numpy(a64, b64, panel, iters):
     return np.linalg.solve(a64, b64), None
 
 
+def _rung_abft(a64, b64, panel, iters):
+    """Checksum-carrying blocked LU with in-rung detect/localize/replay
+    (gauss_tpu.resilience.abft). A transient mid-solve corruption never
+    surfaces here at all — the replay repairs it inside the rung, bit-
+    identical to an uninterrupted run; persistent corruption raises the
+    typed SDCUnrecoverableError, which the ladder records as
+    ``exception:SDCUnrecoverableError`` and escalates past."""
+    from gauss_tpu.resilience import abft
+
+    x, fac, _report = abft.solve_lu_abft(a64, b64, panel=panel, iters=iters)
+    return x, fac
+
+
+def _rung_abft_chol(a64, b64, panel, iters):
+    """The SPD sibling: checksum-carrying blocked Cholesky with replay.
+    Non-SPD input raises the same typed NotSPDError the plain cholesky
+    rung does — the structured demotion contract is unchanged."""
+    from gauss_tpu.resilience import abft
+
+    x, fac, _report = abft.solve_chol_abft(a64, b64, panel=panel,
+                                           iters=iters)
+    return x, fac
+
+
 def _rung_cholesky(a64, b64, panel, iters):
     """SPD rung: blocked Cholesky + host-f64 refinement. A non-SPD operand
     raises the typed NotSPDError, which the ladder records as
@@ -211,7 +266,13 @@ _RUNG_FNS: Dict[str, Callable] = {
     "cholesky": _rung_cholesky,
     "banded": _rung_banded,
     "blockdiag": _rung_blockdiag,
+    "abft": _rung_abft,
+    "abft_chol": _rung_abft_chol,
 }
+
+#: rungs backed by the checksum-carrying factorizations — the ladder
+#: clears/collects the ABFT report around these.
+_ABFT_RUNGS = ("abft", "abft_chol")
 
 #: ladder head per structure tag; every structured ladder then demotes
 #: "blocked" (general LU) -> pivot_safe -> ds_refine -> numpy_f64, so a
@@ -226,21 +287,36 @@ _STRUCTURE_HEADS: Dict[str, Tuple[str, ...]] = {
 }
 
 
-def structured_rungs(tag: str) -> Tuple[str, ...]:
+def structured_rungs(tag: str, abft: bool = False) -> Tuple[str, ...]:
     """The escalation ladder for a structure tag: the structured engine
-    first, then the general-LU demotion rungs."""
+    first, then the general-LU demotion rungs.
+
+    ``abft=True`` PREPENDS the checksum-carrying engine form where one
+    exists (``abft_chol`` ahead of the spd ladder, ``abft`` ahead of the
+    others' general-LU rung) — the existing demotion chain is unchanged,
+    so replay failure escalates through exactly the pre-ABFT ladder."""
     if tag not in _STRUCTURE_HEADS:
         raise ValueError(f"unknown structure tag {tag!r}; options: "
                          f"{sorted(_STRUCTURE_HEADS)}")
-    return _STRUCTURE_HEADS[tag] + ("blocked", "pivot_safe", "ds_refine",
-                                    "numpy_f64")
+    head = _STRUCTURE_HEADS[tag]
+    base = head + ("blocked", "pivot_safe", "ds_refine", "numpy_f64")
+    if abft and tag == "spd":
+        return ("abft_chol",) + base
+    if abft and tag == "dense":
+        return ("abft",) + base
+    # banded / blockdiag engines have no checksum-carrying form; their
+    # O(n*b^2) / batched-small-block cost profiles are the point of the
+    # route, so an ABFT-LU head would defeat the routing — the structured
+    # ladder stays as-is and the 1e-4 gate remains their backstop.
+    return base
 
 
 def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
                     engine: str = "blocked",
                     rungs: Optional[Sequence[str]] = None,
                     panel: Optional[int] = None,
-                    refine_iters: int = 2) -> ResilientResult:
+                    refine_iters: int = 2,
+                    abft: bool = False) -> ResilientResult:
     """Solve ``a @ x = b`` with health gating and ladder escalation.
 
     Returns a :class:`ResilientResult` (``.x`` float64, plus which rung
@@ -252,6 +328,15 @@ def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
     ``rungs`` overrides the ladder (names from ``_RUNG_FNS``); the serving
     layer's degraded lane passes ``("numpy_f64", "rank1")`` — same gating,
     same events, same typed error, different rung order.
+
+    ``abft=True`` protects the solve against SILENT DATA CORRUPTION
+    mid-factorization: rung 0 becomes the checksum-carrying form
+    (gauss_tpu.resilience.abft), which detects a mismatch within one
+    panel group, localizes it, and replays just the affected group from
+    the last verified carry — bit-identical to an uninterrupted run —
+    before the ladder below is ever consulted. ``.sdc`` on the result
+    carries the detection/replay accounting (``.sdc_detected`` is the
+    per-request serving tag).
     """
     a64 = np.asarray(a, dtype=np.float64)
     b64 = np.asarray(b, dtype=np.float64)
@@ -273,19 +358,59 @@ def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
             "non-finite entries in the input operands (NaN/Inf); no "
             "recovery rung can restore a system that was never well-posed",
             trigger="nonfinite_input")
-    ladder = tuple(rungs) if rungs is not None else default_rungs(engine)
+    ladder = (tuple(rungs) if rungs is not None
+              else default_rungs(engine, abft=abft))
     unknown = [r for r in ladder if r not in _RUNG_FNS]
     if unknown:
         raise ValueError(f"unknown ladder rung(s) {unknown}; options: "
                          f"{sorted(_RUNG_FNS)}")
+    has_abft = any(r in _ABFT_RUNGS for r in ladder)
+    sdc_reports: List[dict] = []
+
+    def _collect_sdc(rung: str) -> None:
+        """Stash the just-finished abft rung's report — every later abft
+        rung overwrites the module's thread-local, so the detections of a
+        FAILED abft rung (the interesting ones) must be captured here."""
+        if rung not in _ABFT_RUNGS:
+            return
+        from gauss_tpu.resilience import abft as _abft
+
+        rep = _abft.last_report()
+        if rep is not None:
+            sdc_reports.append(rep.to_dict())
+        _abft.clear_report()
+
+    def _sdc_info() -> Optional[dict]:
+        if not has_abft:
+            return None
+        if not sdc_reports:
+            return None
+        if len(sdc_reports) == 1:
+            return sdc_reports[0]
+        out = dict(sdc_reports[-1])
+        out["engine"] = "+".join(r["engine"] for r in sdc_reports)
+        for key in ("detections", "replays"):
+            out[key] = sum(r[key] for r in sdc_reports)
+        out["escalated"] = any(r["escalated"] for r in sdc_reports)
+        out["max_err"] = max(r["max_err"] for r in sdc_reports)
+        for key in ("detect_groups", "detect_cols", "detect_latency_s"):
+            out[key] = [v for r in sdc_reports for v in r[key]]
+        return out
+
+    if has_abft:
+        from gauss_tpu.resilience import abft as _abft
+
+        _abft.clear_report()
 
     escalations: List[Tuple[str, str]] = []
     for i, rung in enumerate(ladder):
         try:
             x, fac = _RUNG_FNS[rung](a64, b64, panel, refine_iters)
             ok, trigger, rel = _gate(a64, b64, x, factors=fac, gate=gate)
+            _collect_sdc(rung)
         except Exception as e:  # noqa: BLE001 — a rung failing IS the signal
             ok, trigger, rel = False, f"exception:{type(e).__name__}", None
+            _collect_sdc(rung)
         if ok:
             if i > 0:
                 obs.counter("resilience.recovered")
@@ -295,7 +420,8 @@ def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
             return ResilientResult(x=np.asarray(x, dtype=np.float64),
                                    rung=rung, rung_index=i, attempts=i + 1,
                                    rel_residual=rel,
-                                   escalations=escalations)
+                                   escalations=escalations,
+                                   sdc=_sdc_info())
         escalations.append((rung, trigger))
         obs.counter("resilience.escalations")
         obs.emit("recovery", trigger=trigger, rung=rung, rung_index=i,

@@ -57,6 +57,16 @@ class ServeConfig:
     supervised_handoff: bool = False  # route oversized single-RHS solves
     #                                   through the fleet supervisor
     fleet_workers: int = 2          # world size for the supervised lane
+    abft: bool = False              # checksum-carrying (ABFT) solves on the
+    #                                 single-request lanes (handoff): silent
+    #                                 data corruption is detected within one
+    #                                 panel group and repaired by localized
+    #                                 replay (gauss_tpu.resilience.abft);
+    #                                 results that saw a detection carry
+    #                                 sdc_detected=True. The batched bucket
+    #                                 lane keeps its vmapped executables and
+    #                                 relies on verify_gate (documented in
+    #                                 docs/RESILIENCE.md)
     structure_aware: bool = False   # detect/accept structure tags, batch by
     #                                 (bucket, tag), and give Gershgorin-
     #                                 certified SPD batches the half-price
@@ -91,6 +101,10 @@ class ServeResult:
     retry_after_s: Optional[float] = None
     error: Optional[str] = None
     rel_residual: Optional[float] = None
+    #: True when an ABFT-protected lane detected (and repaired) silent
+    #: data corruption while serving this request — the per-request SDC
+    #: status tag (ServeConfig.abft).
+    sdc_detected: bool = False
 
     @property
     def ok(self) -> bool:

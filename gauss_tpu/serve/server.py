@@ -490,6 +490,7 @@ class SolverServer:
 
         cfg = self.config
         lane = "handoff"
+        sdc_detected = False
         try:
             # The trace context stamps every event emitted below us —
             # solve_handoff's route decision, fleet supervision events —
@@ -506,6 +507,21 @@ class SolverServer:
                         req.a.astype(np.float64), req.b.astype(np.float64),
                         workers=cfg.fleet_workers, panel=cfg.panel,
                         refine_iters=max(2, cfg.refine_steps)).x
+                elif cfg.abft and blocked.fits_single_chip(req.n):
+                    # ABFT-protected single-chip lane: the checksum-
+                    # carrying ladder detects mid-solve corruption within
+                    # one panel group and repairs it by localized replay;
+                    # the request is tagged when that happened.
+                    from gauss_tpu.resilience import recover
+
+                    obs.emit("route", tool="serve_handoff", lane="abft",
+                             n=req.n)
+                    rr = recover.solve_resilient(
+                        req.a.astype(np.float64), req.b.astype(np.float64),
+                        abft=True, panel=cfg.panel,
+                        refine_iters=max(2, cfg.refine_steps))
+                    x = rr.x
+                    sdc_detected = rr.sdc_detected
                 else:
                     x = blocked.solve_handoff(
                         req.a.astype(np.float64), req.b.astype(np.float64),
@@ -518,7 +534,8 @@ class SolverServer:
                          trace=req.trace_id, status=STATUS_FAILED, lane=lane,
                          error=f"{type(e).__name__}: {e}"[:200])
             return
-        self._finish(req, np.asarray(x), lane=lane, bucket_n=None)
+        self._finish(req, np.asarray(x), lane=lane, bucket_n=None,
+                     sdc_detected=sdc_detected)
 
     def _serve_numpy(self, req: ServeRequest) -> None:
         """Degraded host lane, through the SAME recovery ladder the solver
@@ -553,7 +570,7 @@ class SolverServer:
         self._finish(req, x, lane="numpy", bucket_n=None)
 
     def _finish(self, req: ServeRequest, x: np.ndarray, lane: str,
-                bucket_n: Optional[int]) -> None:
+                bucket_n: Optional[int], sdc_detected: bool = False) -> None:
         rel = None
         if self.config.verify_gate is not None:
             from gauss_tpu.verify import checks
@@ -574,12 +591,16 @@ class SolverServer:
         queue_s = time.perf_counter() - req.t_submit
         if not req.resolve(ServeResult(status=STATUS_OK, x=x, lane=lane,
                                        bucket_n=bucket_n, queue_s=queue_s,
-                                       rel_residual=rel)):
+                                       rel_residual=rel,
+                                       sdc_detected=sdc_detected)):
             return  # cancelled mid-compute: the client owns the terminal
         self.requests_served += 1
         obs.counter("serve.served")
+        if sdc_detected:
+            obs.counter("serve.sdc_detected")
         obs.histogram("serve.latency_s", queue_s)
         obs.emit("serve_request", id=req.id, n=req.n, k=req.k,
                  trace=req.trace_id, status=STATUS_OK, lane=lane,
                  bucket_n=bucket_n, latency_s=round(queue_s, 6),
-                 rel_residual=rel)
+                 rel_residual=rel,
+                 **({"sdc_detected": True} if sdc_detected else {}))

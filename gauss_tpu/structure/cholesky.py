@@ -58,11 +58,15 @@ class BlockedCholesky(NamedTuple):
           both substitution sweeps run as GEMM chains (cf. BlockedLU.linv).
     min_diag: min over the diagonal of L; <= 0 means not SPD (NaN folds
           to 0 so the witness is always comparable).
+    abft_err: only set by the ``abft=True`` checksum-carrying form — the
+          per-panel column-checksum mismatch magnitudes plus the final
+          ``e^T A = (e^T L) L^T`` identity (cf. BlockedLU.abft_err).
     """
 
     m: object
     linv: object
     min_diag: object
+    abft_err: object = None
 
 
 def _chol_panel(d, panel: int, dtype):
@@ -85,18 +89,143 @@ def _chol_panel(d, panel: int, dtype):
 
 
 def cholesky_factor_blocked(a, panel: int | None = None,
-                            gemm_precision: str = "highest"):
+                            gemm_precision: str = "highest",
+                            abft: bool = False):
     """Flat-fori blocked Cholesky (jitted; masked full-size updates).
 
     Returns a :class:`BlockedCholesky`; never raises on non-SPD input —
     check ``min_diag`` (the host entries :func:`cholesky_factor` /
     :func:`solve_spd_refined` do, and raise :class:`NotSPDError`).
+
+    ``abft``: carry the Huang-Abraham column-checksum row (for Cholesky
+    the update is ``c' = c - (c1 @ L11^-T) @ L21^T`` — the symmetric
+    analog of the LU rider, see ``core.blocked``'s ABFT block) and verify
+    the trailing block after every panel; mismatch magnitudes return in
+    ``BlockedCholesky.abft_err`` ((nb + 1,), last entry the whole-factor
+    ``e^T A = (e^T L) L^T`` identity). The factor arrays are bit-identical
+    to ``abft=False``, and the off path traces the pre-ABFT program.
     """
     return _cholesky_factor_fori(a, panel=panel,
-                                 gemm_precision=gemm_precision)
+                                 gemm_precision=gemm_precision, abft=abft)
 
 
-def _factor_impl(a, panel, gemm_precision, unrolled: bool):
+def _chol_panel_step(m, min_diag, kb, panel: int, prec, crow=None):
+    """One panel of the flat (masked) blocked Cholesky: factor the diagonal
+    block at ``kb``, install L11/L21, apply the self-masking SYRK trailing
+    update — and, when an ABFT checksum row ``crow`` rides along, its
+    symmetric-rider update plus the trailing-block verification. Returns
+    ``(m, min_diag, linv, crow, err)`` (``crow``/``err`` None when off).
+    Single source for the fori body below and the host-stepped ABFT
+    runner (gauss_tpu.resilience.abft) — they must stay in numerical
+    lockstep; ``kb`` may be traced (fori) or static (runner)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    dtype = m.dtype
+    npad = m.shape[0]
+    rows = jnp.arange(npad)
+    d = lax.dynamic_slice(m, (kb, kb), (panel, panel))
+    l11, linv, mind = _chol_panel(d, panel, dtype)
+    min_diag = jnp.minimum(min_diag, mind)
+    # L21 = A21 @ L11^-T, masked to the rows below the panel; the masked
+    # operand makes the SYRK update self-masking (the outer product is
+    # zero outside the trailing block).
+    colblk = lax.dynamic_slice(m, (0, kb), (npad, panel))
+    below = (rows >= kb + panel)[:, None]
+    l21 = jnp.dot(jnp.where(below, colblk, jnp.zeros((), dtype)),
+                  linv.T, precision=prec)
+    in_panel = ((rows >= kb) & (rows < kb + panel))[:, None]
+    l11_full = jnp.zeros((npad, panel), dtype)
+    l11_full = lax.dynamic_update_slice(l11_full, l11, (kb, 0))
+    colblk = jnp.where(in_panel, l11_full,
+                       jnp.where(below, l21, colblk))
+    m = lax.dynamic_update_slice(m, colblk, (0, kb))
+    m = m - jnp.dot(l21, l21.T, precision=prec)
+    err = None
+    if crow is not None:
+        # Symmetric checksum rider: s = c1 @ L11^-T is e^T [L11; L21]
+        # (the checksum row's "multipliers"), and the trailing checksum
+        # update is s @ L21^T — the rider of the SYRK above. The check
+        # reads the symmetrized-from-lower trailing view (what the
+        # algorithm reads; see _csum_sym_init).
+        c1 = lax.dynamic_slice(crow, (0, kb), (1, panel))
+        s = jnp.dot(c1, linv.T, precision=prec)
+        crow = crow - jnp.dot(s, l21.T, precision=prec)
+        err, _ = _csum_sym_trailing_err(m, crow, kb + panel)
+        # Panel-column identity: c1 == (e^T [L11; L21]) @ L11^T — exact in
+        # the corruption, where the trailing check only sees panel-column
+        # corruption through L11^-T-attenuated propagation (cf.
+        # core.blocked._csum_group_col_err).
+        el = jnp.sum(jnp.where((rows >= kb)[:, None], colblk,
+                               jnp.zeros((), dtype)), axis=0)
+        pred = jnp.dot(el[None, :], l11.T, precision=prec)
+        gdiff = pred[0] - c1[0]
+        gdiff = jnp.where(jnp.isnan(gdiff), jnp.inf, jnp.abs(gdiff))
+        err = jnp.maximum(err, jnp.max(gdiff))
+    return m, min_diag, linv, crow, err
+
+
+def _csum_sym_init(m):
+    """Initial Cholesky checksum row: column sums of the SYMMETRIZED-from-
+    lower view ``tril(m) + tril(m, -1)^T`` — the matrix the factorization
+    actually reads (potrf never touches the strict upper triangle). On a
+    symmetric operand this equals the plain column sums to rounding; on an
+    asymmetric one it keeps the checksum consistent with the computation,
+    so a non-SPD operand fails as NotSPD / residual-gate demotion exactly
+    like the plain engine instead of masquerading as unrepairable SDC."""
+    import jax.numpy as jnp
+
+    npad = m.shape[0]
+    rows = jnp.arange(npad)
+    lower = rows[:, None] >= rows[None, :]
+    lt = jnp.where(lower, m, jnp.zeros((), m.dtype))
+    strict = jnp.where(rows[:, None] > rows[None, :], m,
+                       jnp.zeros((), m.dtype))
+    return (jnp.sum(lt, axis=0) + jnp.sum(strict, axis=1))[None, :]
+
+
+def _csum_sym_trailing_err(m, crow, split):
+    """Trailing-block checksum check over the symmetrized-from-lower view
+    (cf. core.blocked._csum_trailing_err; ``split`` may be traced). A flip
+    in the trailing LOWER triangle perturbs two column sums at its own
+    magnitude; the never-read strict upper triangle is — correctly —
+    invisible (dead memory)."""
+    import jax.numpy as jnp
+
+    npad = m.shape[0]
+    rows = jnp.arange(npad)
+    live = rows >= split
+    live2 = live[:, None] & live[None, :]
+    lower = rows[:, None] >= rows[None, :]
+    lt = jnp.where(live2 & lower, m, jnp.zeros((), m.dtype))
+    strict = jnp.where(live2 & (rows[:, None] > rows[None, :]), m,
+                       jnp.zeros((), m.dtype))
+    colsum = jnp.sum(lt, axis=0) + jnp.sum(strict, axis=1)
+    diff = jnp.where(live, colsum - crow[0], jnp.zeros((), m.dtype))
+    diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+    return jnp.max(diff), jnp.argmax(diff)
+
+
+def _csum_final_err_chol(m, crow0):
+    """The post-factor identity ``e^T A = (e^T L) @ L^T`` — the symmetric
+    analog of core.blocked._csum_final_err_lu (column sums of the padded
+    SPD operand vs the L-column-sum-weighted rows of L^T)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    npad = m.shape[0]
+    rows = jnp.arange(npad)
+    lower = rows[:, None] >= rows[None, :]
+    lt = jnp.where(lower, m, jnp.zeros((), m.dtype))
+    el = jnp.sum(lt, axis=0)
+    pred = jnp.dot(el[None, :], lt.T, precision=lax.Precision.HIGHEST)
+    diff = pred[0] - crow0[0]
+    diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+    return jnp.max(diff), jnp.argmax(diff)
+
+
+def _factor_impl(a, panel, gemm_precision, unrolled: bool,
+                 abft: bool = False):
     import jax.numpy as jnp
     from jax import lax
 
@@ -116,6 +245,10 @@ def _factor_impl(a, panel, gemm_precision, unrolled: bool):
     dtype = m.dtype
 
     if unrolled:
+        if abft:
+            raise ValueError("abft=True is supported on the flat fori form "
+                             "(cholesky_factor_blocked) and the host-stepped "
+                             "ABFT runner, not the unrolled trace form")
         min_diag = jnp.asarray(jnp.inf, dtype)
         linvs = []
         for kb in range(0, npad, panel):
@@ -133,36 +266,33 @@ def _factor_impl(a, panel, gemm_precision, unrolled: bool):
                     trail - jnp.dot(l21, l21.T, precision=prec))
         return BlockedCholesky(m=m, linv=jnp.stack(linvs), min_diag=min_diag)
 
-    rows = jnp.arange(npad)
-
     def outer(k, carry):
-        m, min_diag, linvs = carry
+        if abft:
+            m, min_diag, linvs, crow, errs = carry
+        else:
+            m, min_diag, linvs = carry
         kb = k * panel
-        d = lax.dynamic_slice(m, (kb, kb), (panel, panel))
-        l11, linv, mind = _chol_panel(d, panel, dtype)
-        min_diag = jnp.minimum(min_diag, mind)
-        # L21 = A21 @ L11^-T, masked to the rows below the panel; the
-        # masked operand makes the SYRK update self-masking (the outer
-        # product is zero outside the trailing block).
-        colblk = lax.dynamic_slice(m, (0, kb), (npad, panel))
-        below = (rows >= kb + panel)[:, None]
-        l21 = jnp.dot(jnp.where(below, colblk, jnp.zeros((), dtype)),
-                      linv.T, precision=prec)
-        in_panel = ((rows >= kb) & (rows < kb + panel))[:, None]
-        l11_full = jnp.zeros((npad, panel), dtype)
-        l11_full = lax.dynamic_update_slice(l11_full, l11, (kb, 0))
-        colblk = jnp.where(in_panel, l11_full,
-                           jnp.where(below, l21, colblk))
-        m = lax.dynamic_update_slice(m, colblk, (0, kb))
-        m = m - jnp.dot(l21, l21.T, precision=prec)
-        # The panel's own rows/cols met a zero operand above, so only the
-        # trailing block actually changed — restore nothing.
+        m, min_diag, linv, crow, err = _chol_panel_step(
+            m, min_diag, kb, panel, prec,
+            crow=crow if abft else None)
+        # The panel's own rows/cols met a zero operand in the step, so only
+        # the trailing block actually changed — restore nothing.
         linvs = lax.dynamic_update_slice(linvs, linv[None], (k, 0, 0))
+        if abft:
+            errs = lax.dynamic_update_slice(errs, err[None], (k,))
+            return m, min_diag, linvs, crow, errs
         return m, min_diag, linvs
 
-    m, min_diag, linvs = lax.fori_loop(
-        0, nb, outer, (m, jnp.asarray(jnp.inf, dtype),
-                       jnp.zeros((nb, panel, panel), dtype)))
+    init = (m, jnp.asarray(jnp.inf, dtype),
+            jnp.zeros((nb, panel, panel), dtype))
+    if abft:
+        crow0 = _csum_sym_init(m)
+        m, min_diag, linvs, _, errs = lax.fori_loop(
+            0, nb, outer, init + (crow0, jnp.zeros((nb,), dtype)))
+        fe, _ = _csum_final_err_chol(m, crow0)
+        return BlockedCholesky(m=m, linv=linvs, min_diag=min_diag,
+                               abft_err=jnp.concatenate([errs, fe[None]]))
+    m, min_diag, linvs = lax.fori_loop(0, nb, outer, init)
     return BlockedCholesky(m=m, linv=linvs, min_diag=min_diag)
 
 
@@ -176,13 +306,15 @@ def _get_jitted(unrolled: bool):
         import jax
 
         fn = jax.jit(partial(_factor_impl, unrolled=unrolled),
-                     static_argnames=("panel", "gemm_precision"))
+                     static_argnames=("panel", "gemm_precision", "abft"))
         _JITTED[unrolled] = fn
     return fn
 
 
-def _cholesky_factor_fori(a, panel=None, gemm_precision="highest"):
-    return _get_jitted(False)(a, panel=panel, gemm_precision=gemm_precision)
+def _cholesky_factor_fori(a, panel=None, gemm_precision="highest",
+                          abft=False):
+    return _get_jitted(False)(a, panel=panel, gemm_precision=gemm_precision,
+                              abft=abft)
 
 
 def cholesky_factor_blocked_unrolled(a, panel: int | None = None,

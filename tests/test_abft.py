@@ -1,0 +1,373 @@
+"""ABFT: checksum-carrying solves detect, localize, and repair SDC.
+
+Covers the chaos campaign's new on-device ``sdc_bitflip`` phase end to
+end: every injected corruption is detected by the checksum invariant,
+localized to the offending panel group, and recovered via the localized
+replay rung (bit-identical to an uninterrupted ABFT run) or ladder
+escalation — plus the ABFT-off bit-identity / zero-overhead contract and
+the GEMM single-element correction."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gauss_tpu.core import blocked
+from gauss_tpu.io import synthetic
+from gauss_tpu.resilience import abft, abftcheck, inject, recover
+from gauss_tpu.structure import cholesky
+
+
+def _dd_system(seed, n, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    a[np.arange(n), np.arange(n)] += np.asarray(n, dtype)
+    return a, rng.standard_normal(n).astype(dtype)
+
+
+def _assert_fields_equal(f0, f1, fields):
+    for f in fields:
+        assert np.array_equal(np.asarray(getattr(f0, f)),
+                              np.asarray(getattr(f1, f))), f
+
+
+LU_FIELDS = ("m", "perm", "min_abs_pivot", "linv", "uinv")
+CHOL_FIELDS = ("m", "linv", "min_diag")
+
+
+# -- checksum invariant + abft-off bit-identity ----------------------------
+
+def test_flat_lu_abft_invariant_and_bit_identity():
+    a, _ = _dd_system(0, 96)
+    f0 = blocked.lu_factor_blocked(a, panel=16)
+    f1 = blocked.lu_factor_blocked(a, panel=16, abft=True)
+    assert f0.abft_err is None
+    _assert_fields_equal(f0, f1, LU_FIELDS)
+    errs = np.asarray(f1.abft_err)
+    assert errs.shape == (7,)  # nb + final identity
+    tol = abft.default_tol(96, np.float32, 96.0)
+    assert float(errs.max()) < tol
+
+
+def test_chunked_lu_abft_invariant_and_bit_identity():
+    a, b = _dd_system(1, 96)
+    f0 = blocked.lu_factor_blocked_chunked(a, panel=16, chunk=2)
+    f1 = blocked.lu_factor_blocked_chunked(a, panel=16, chunk=2, abft=True)
+    assert f0.abft_err is None
+    _assert_fields_equal(f0, f1, LU_FIELDS)
+    assert np.asarray(f1.abft_err).shape == (4,)  # 3 groups + final
+    x = blocked.lu_solve(f1, jnp.asarray(b))
+    rel = (np.linalg.norm(a @ np.asarray(x) - b)
+           / np.linalg.norm(b))
+    assert rel < 1e-4
+
+
+def test_chol_flat_abft_invariant_and_bit_identity():
+    a = synthetic.spd_matrix(96).astype(np.float32)
+    f0 = cholesky.cholesky_factor_blocked(a, panel=16)
+    f1 = cholesky.cholesky_factor_blocked(a, panel=16, abft=True)
+    assert f0.abft_err is None
+    _assert_fields_equal(f0, f1, CHOL_FIELDS)
+    assert float(np.asarray(f1.abft_err).max()) < 1e-3
+
+
+def test_chol_unrolled_rejects_abft():
+    a = synthetic.spd_matrix(32).astype(np.float32)
+    with pytest.raises(ValueError, match="flat fori form"):
+        cholesky._factor_impl(a, 16, "highest", unrolled=True, abft=True)
+
+
+def test_host_stepped_runners_match_jitted_forms():
+    a, _ = _dd_system(2, 64)
+    fac, rep = abft.lu_factor_abft(a, panel=16, chunk=2)
+    ref = blocked.lu_factor_blocked_chunked(a, panel=16, chunk=2)
+    _assert_fields_equal(fac, ref, LU_FIELDS)
+    assert rep.detections == 0 and rep.replays == 0
+    aspd = synthetic.spd_matrix(64).astype(np.float32)
+    cfac, crep = abft.cholesky_factor_abft(aspd, panel=16)
+    cref = cholesky.cholesky_factor_blocked(aspd, panel=16)
+    _assert_fields_equal(cfac, cref, CHOL_FIELDS)
+    assert crep.detections == 0
+
+
+# -- the corruption primitive ----------------------------------------------
+
+def test_flip_bit_roundtrip():
+    a, _ = _dd_system(3, 16)
+    m = jnp.asarray(a)
+    m2 = abft.flip_bit(m, 3, 5, 30)
+    assert not np.array_equal(np.asarray(m2), a)
+    m3 = abft.flip_bit(m2, 3, 5, 30)
+    assert np.array_equal(np.asarray(m3), a)  # XOR is its own inverse
+    diff = np.argwhere(np.asarray(m2) != a)
+    assert diff.tolist() == [[3, 5]]
+
+
+def test_sdc_bitflip_kind_parses():
+    plan = inject.FaultPlan.parse(
+        "abft.lu.group=sdc_bitflip:skip=1:max=1")
+    assert plan.specs[0].kind == "sdc_bitflip"
+    assert plan.specs[0].site == "abft.lu.group"
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        inject.FaultSpec(site="x", kind="sdc_flip")
+
+
+# -- detect -> localize -> replay ------------------------------------------
+
+def test_lu_detects_localizes_and_replays():
+    a, b = _dd_system(4, 64)
+    clean, _ = abft.lu_factor_abft(a, panel=16, chunk=1)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=1, skip=2)],
+        seed=7)
+    with inject.plan(plan) as ap:
+        fac, rep = abft.lu_factor_abft(a, panel=16, chunk=1)
+    assert ap.stats()["triggered"] == 1
+    assert rep.detections >= 1 and rep.replays >= 1
+    assert not rep.escalated
+    assert 2 in rep.detect_groups  # localized to the faulted group
+    _assert_fields_equal(fac, clean, LU_FIELDS)  # bit-identical repair
+
+
+def test_lu_last_group_fault_caught_by_final_identity():
+    a, _ = _dd_system(5, 64)
+    clean, _ = abft.lu_factor_abft(a, panel=16, chunk=1)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=1, skip=3)],
+        seed=5)
+    with inject.plan(plan):
+        fac, rep = abft.lu_factor_abft(a, panel=16, chunk=1)
+    assert rep.detections >= 1 and not rep.escalated
+    assert 3 in rep.detect_groups
+    _assert_fields_equal(fac, clean, LU_FIELDS)
+
+
+def test_lu_persistent_corruption_is_typed():
+    a, _ = _dd_system(6, 64)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=None,
+        skip=1)], seed=3)
+    with inject.plan(plan):
+        with pytest.raises(abft.SDCUnrecoverableError) as ei:
+            abft.lu_factor_abft(a, panel=16, chunk=1)
+    assert ei.value.group == 1
+    assert ei.value.magnitude > 0
+
+
+def test_chol_detects_and_replays():
+    a = synthetic.spd_matrix(64).astype(np.float32)
+    clean, _ = abft.cholesky_factor_abft(a, panel=16)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_CHOL, kind="sdc_bitflip", max_triggers=1, skip=2)],
+        seed=11)
+    with inject.plan(plan):
+        fac, rep = abft.cholesky_factor_abft(a, panel=16)
+    assert rep.detections >= 1 and not rep.escalated
+    _assert_fields_equal(fac, clean, CHOL_FIELDS)
+
+
+def test_chol_not_spd_stays_typed_under_abft():
+    # Symmetric but indefinite — the same input class the plain engine
+    # rejects with its typed witness; the checksum machinery (computed
+    # over the symmetrized-from-lower view the algorithm reads) must not
+    # reclassify it as unrepairable SDC.
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((32, 32)).astype(np.float32)
+    a = (a + a.T) / 2  # indefinite with overwhelming probability
+    b = rng.standard_normal(32).astype(np.float32)
+    with pytest.raises(cholesky.NotSPDError):
+        abft.solve_chol_abft(a, b, panel=16)
+
+
+# -- the ladder integration ------------------------------------------------
+
+def test_ladders_gain_abft_heads():
+    assert recover.default_rungs("blocked", abft=True)[0] == "abft"
+    assert recover.default_rungs("blocked", abft=True)[1:] == \
+        recover.default_rungs("blocked")
+    assert recover.structured_rungs("spd", abft=True)[0] == "abft_chol"
+    assert recover.structured_rungs("spd", abft=True)[1:] == \
+        recover.structured_rungs("spd")
+    # engines with no checksum form keep their ladder untouched
+    assert recover.structured_rungs("banded", abft=True) == \
+        recover.structured_rungs("banded")
+
+
+def test_solve_resilient_replay_rung_and_sdc_tag():
+    a, b = _dd_system(8, 128)
+    a64, b64 = a.astype(np.float64), b.astype(np.float64)
+    res0 = recover.solve_resilient(a64, b64, abft=True, panel=16)
+    assert res0.rung == "abft" and not res0.sdc_detected
+    assert res0.sdc is not None and res0.sdc["detections"] == 0
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=1, skip=1)],
+        seed=4)
+    with inject.plan(plan):
+        res = recover.solve_resilient(a64, b64, abft=True, panel=16)
+    assert res.rung == "abft" and res.rung_index == 0
+    assert res.sdc_detected and res.sdc["replays"] >= 1
+    # replay-recovered solve bit-identical to the uninterrupted one
+    assert np.array_equal(res.x, res0.x)
+
+
+def test_solve_resilient_escalates_past_failed_replay():
+    a, b = _dd_system(9, 128)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=None)],
+        seed=4)
+    with inject.plan(plan):
+        res = recover.solve_resilient(a.astype(np.float64),
+                                      b.astype(np.float64),
+                                      abft=True, panel=16)
+    assert res.rung_index > 0            # the full ladder served
+    assert res.escalations[0][0] == "abft"
+    assert res.sdc_detected              # the failed rung's report kept
+    rel = (np.linalg.norm(a.astype(np.float64) @ res.x - b)
+           / np.linalg.norm(b))
+    assert rel < 1e-4
+
+
+# -- abft matmul -----------------------------------------------------------
+
+def test_abft_matmul_clean_and_corrected():
+    rng = np.random.default_rng(10)
+    a = rng.standard_normal((48, 32)).astype(np.float32)
+    b = rng.standard_normal((32, 40)).astype(np.float32)
+    c0, info0 = abft.abft_matmul(a, b)
+    assert info0["detections"] == 0
+    assert np.array_equal(np.asarray(c0),
+                          np.asarray(abft.abft_matmul(a, b)[0]))
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_MATMUL, kind="sdc_bitflip", max_triggers=1)],
+        seed=9)
+    with inject.plan(plan):
+        c1, info = abft.abft_matmul(a, b)
+    assert info["detections"] == 1
+    assert info["corrected"] or info["recomputed"]
+    dev = float(np.max(np.abs(np.asarray(c1) - np.asarray(c0))))
+    assert dev <= info["tol"]
+
+
+# -- obs + regress plumbing ------------------------------------------------
+
+def test_sdc_summarize_section():
+    from gauss_tpu import obs
+    from gauss_tpu.obs import summarize
+
+    a, b = _dd_system(11, 64)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=1, skip=1)],
+        seed=2)
+    with obs.run(tool="test_sdc") as rec:
+        with inject.plan(plan):
+            abft.lu_factor_abft(a, panel=16, chunk=1)
+    events = rec.events
+    sd = summarize.sdc_summary(events)
+    assert sd["detections"]["total"] >= 1
+    assert sd["detections"]["by_engine"].get("lu", 0) >= 1
+    assert sd["injected"]["total"] >= 1
+    assert sd["max_magnitude"] > 0
+    run_id = events[0]["run"]
+    text = summarize.summarize_run(events, run_id)
+    assert "sdc (abft checksum detections):" in text
+    assert summarize.run_summary(events, run_id)["sdc"] == sd
+    # the replay shows up as an abft_replay recovery in the resilience
+    # section, the detection as a health gauge for the live plane
+    rs = summarize.resilience_summary(events)
+    assert rs["recoveries"]["by_rung"].get("abft_replay", 0) >= 1
+    assert any(ev.get("type") == "health" and ev.get("sdc_detected")
+               for ev in events)
+
+
+def test_regress_ingests_abft_campaign(tmp_path):
+    import json
+
+    from gauss_tpu.obs import regress
+
+    summary = {"kind": "abft_campaign",
+               "sdc": {"cases": 10, "wall_s": 5.0, "escalated": 1,
+                       "mean_detect_latency_s": 0.01},
+               "identity": {"plain_s_per_solve": 0.001,
+                            "overhead_ratio": 3.0}}
+    p = tmp_path / "abft.json"
+    p.write_text(json.dumps(summary))
+    recs = regress.ingest_file(p)
+    metrics = {r["metric"]: r["value"] for r in recs}
+    assert metrics["abft:s_per_case"] == 0.5
+    assert metrics["abft:plain_s_per_solve"] == 0.001
+    assert metrics["abft:overhead_ratio"] == 3.0
+    assert metrics["abft:escalation_rate"] == 0.1
+    assert metrics["abft:detect_latency_s"] == 0.01
+
+
+# -- serve + dist threading ------------------------------------------------
+
+def test_serve_abft_tags_sdc_detected():
+    from gauss_tpu.serve import ServeConfig, SolverServer
+
+    a, b = _dd_system(12, 128)
+    cfg = ServeConfig(ladder=(32, 64), panel=16, abft=True,
+                      verify_gate=1e-4)
+    plan = inject.FaultPlan([inject.FaultSpec(
+        site=abft.SITE_LU, kind="sdc_bitflip", max_triggers=1, skip=1)],
+        seed=2)
+    with inject.plan(plan) as ap:
+        with SolverServer(cfg) as srv:
+            res = srv.solve(a, b, timeout=180)
+    assert ap.stats()["triggered"] == 1
+    assert res.ok and res.lane == "handoff"
+    assert res.sdc_detected
+    # abft off: field defaults False
+    with SolverServer(ServeConfig(ladder=(32, 64), panel=16)) as srv:
+        res2 = srv.solve(a, b, timeout=180)
+    assert res2.ok and not res2.sdc_detected
+
+
+def test_dist_blocked_abft_bit_identical():
+    from gauss_tpu.dist import gauss_dist_blocked as gdb
+    from gauss_tpu.dist.mesh import make_mesh
+
+    a, b = _dd_system(13, 64, dtype=np.float64)
+    mesh = make_mesh()
+    x0 = gdb.gauss_solve_dist_blocked_refined(a, b, mesh=mesh, panel=8)
+    x1 = gdb.gauss_solve_dist_blocked_refined(a, b, mesh=mesh, panel=8,
+                                              abft=True)
+    assert np.array_equal(x0, x1)
+    rel = np.linalg.norm(a @ x1 - b) / np.linalg.norm(b)
+    assert rel < 1e-9
+
+
+# -- the campaign runner ---------------------------------------------------
+
+def test_abftcheck_case_runner_invariant():
+    cache = {}
+    outcomes = [abftcheck.run_sdc_case(i, 99, 1e-4, clean_cache=cache)
+                for i in range(8)]
+    summ = abftcheck.summarize_sdc_cases(outcomes, 1.0)
+    assert summ["missed"] == 0
+    assert summ["violations"] == 0
+    assert summ["detect_rate"] == 1.0
+    replayed = [o for o in outcomes if o["outcome"] == "replayed"]
+    assert replayed and all(o["bit_identical"] for o in replayed)
+    assert all(o["localized"] for o in replayed)
+
+
+@pytest.mark.slow
+def test_abftcheck_cli_smoke(tmp_path):
+    out = tmp_path / "summary.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "gauss_tpu.resilience.abftcheck",
+         "--cases", "12", "--seed", "77", "--matmul-cases", "2",
+         "--summary-json", str(out)],
+        capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "invariant HOLDS" in r.stdout
+    import json
+
+    summary = json.loads(out.read_text())
+    assert summary["kind"] == "abft_campaign"
+    assert summary["invariant_ok"]
+    assert summary["identity"]["bit_identical"]

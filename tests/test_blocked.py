@@ -365,6 +365,34 @@ def test_auto_panel_no_ceiling():
     assert blocked.panel_fits_vmem(2048, 256)
 
 
+def test_explicit_pallas_mosaic_failure_reraises_sizing_hint():
+    """ADVICE r5 #2: where the VMEM probe table is incomplete, a raw
+    Mosaic scoped-VMEM compile failure on an EXPLICIT pallas request must
+    re-raise as the documented sizing ValueError (original chained) — the
+    clear-error contract holds outside the probe table too."""
+    from gauss_tpu.core import blocked
+
+    assert blocked._looks_like_scoped_vmem_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Ran out of memory in memory space vmem"))
+    assert blocked._looks_like_scoped_vmem_error(RuntimeError(
+        "Mosaic failed: exceeds available scoped vmem"))
+    assert not blocked._looks_like_scoped_vmem_error(RuntimeError("boom"))
+
+    @blocked._reraise_scoped_vmem
+    def fake_factor(a, panel_impl="auto"):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Ran out of memory in memory space vmem "
+            "while compiling the panel kernel")
+
+    with pytest.raises(ValueError, match="scoped VMEM") as ei:
+        fake_factor(np.eye(4, dtype=np.float32), panel_impl="pallas")
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    # auto-mode failures pass through untouched (auto never requests the
+    # kernel past the table; a raw error there is a different bug)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        fake_factor(np.eye(4, dtype=np.float32), panel_impl="auto")
+
+
 def test_resolve_panel_impl_vmem_fallback(monkeypatch):
     import jax
 

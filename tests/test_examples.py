@@ -48,6 +48,16 @@ def test_resilient_solve_runs():
     assert "bit-identical to uninterrupted: True" in r.stdout
 
 
+def test_abft_solve_runs():
+    r = _run(["examples/abft_solve.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean:      rung=abft detections=0" in r.stdout
+    assert "replays=1 localized to group(s) [1]" in r.stdout
+    assert "bit-identical to clean: True" in r.stdout
+    assert "persistent: served by rung=blocked" in r.stdout
+    assert "corrected=True" in r.stdout
+
+
 def test_structured_solve_runs():
     r = _run(["examples/structured_solve.py"])
     assert r.returncode == 0, r.stdout + r.stderr
