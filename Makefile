@@ -27,16 +27,28 @@ bench:
 
 # The observability gate (CI-callable): the regression sentinel against the
 # committed history (the latest BENCH records must stay inside the epoch-
-# noise band), then a live --metrics-out run smoke-tested through the
-# machine-readable summarizer and the Chrome-trace exporter.
+# noise band), the RATCHET leg (the committed record gated against the
+# best-ever baseline with its tightened per-metric ceiling — the same
+# evaluate_ratchet path bench.py --regress applies to fresh headlines, so
+# the ratchet gate is exercised in CI), then a live --metrics-out run
+# smoke-tested through the machine-readable summarizer and the
+# Chrome-trace exporter, and finally the DOCTOR gate: the smoke run's
+# span stream diffed against the committed best-prior epoch — the
+# host_group_step / hook_sync leaves that absorbed 93% of the r3->r5
+# regression (reports/doctor_r3_vs_r5.json) must NOT reappear on the
+# plain (hooks-off) path.
 obs-check:
 	$(PYTHON) -m gauss_tpu.obs.regress check BENCH_r04.json BENCH_r05.json \
+	  --history reports/history.jsonl
+	$(PYTHON) -m gauss_tpu.obs.regress check BENCH_r03.json --ratchet \
 	  --history reports/history.jsonl
 	rm -f $(OBS_SMOKE)
 	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.cli.gauss_internal -s 64 -t 2 \
 	  --backend tpu-unblocked --verify --metrics-out $(OBS_SMOKE)
 	$(PYTHON) -m gauss_tpu.obs.summarize $(OBS_SMOKE) --json > /dev/null
 	$(PYTHON) -m gauss_tpu.obs.trace $(OBS_SMOKE) -o $(OBS_SMOKE).trace.json
+	$(PYTHON) -m gauss_tpu.obs.doctor reports/doctor_r3like.jsonl \
+	  $(OBS_SMOKE) --forbid host_group_step,hook_sync > /dev/null
 
 # The serving gate (CI-callable): a CPU smoke load through the batched
 # serving layer — 50 mixed-size requests over small buckets, every solution

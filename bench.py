@@ -69,6 +69,7 @@ def _bench(rec, tuned: bool = False, tune_compare: bool = False) -> None:
     import jax.numpy as jnp
 
     from gauss_tpu import obs
+    from gauss_tpu.core import blocked as _blocked
     from gauss_tpu.io import synthetic
     from gauss_tpu.tune import apply as tune_apply
     from gauss_tpu.utils.profiling import PhaseTimer
@@ -180,6 +181,17 @@ def _bench(rec, tuned: bool = False, tune_compare: bool = False) -> None:
         "panel": panel,
         "tune_source": ("store" if panel == tuned_panel and tuned_panel
                         else "seed"),
+        # PR-10 provenance: which reclaim machinery the measured route
+        # actually engages on THIS backend/size. "fused" is the auto
+        # resolution of the panel+trailing kernel (True on TPU while the
+        # fused working set fits VMEM — kernels.panel_fused_pallas; always
+        # False on CPU, where the plain path never routes through
+        # interpret-mode kernels); "donated" is whether the one-shot solve
+        # entry points donate the factor operand at this shape (they do
+        # whenever n is a panel multiple — resolve_factor(donate=True)).
+        "fused": bool(_blocked._use_fused("auto", N, panel,
+                                          -(-N // panel) * panel)),
+        "donated": bool(N % panel == 0),
     }
     if compare is not None:
         record["tune_compare"] = compare
@@ -274,6 +286,10 @@ if __name__ == "__main__":
             verdicts.append(regress.evaluate(
                 f"{record['metric']}:refined", record["refined_value"],
                 history))
+            refined_ratchet = regress.evaluate_ratchet(
+                f"{record['metric']}:refined", record["refined_value"])
+            if refined_ratchet is not None:
+                verdicts.append(refined_ratchet)
         print(regress.format_verdicts(verdicts), file=sys.stderr)
         if any(v["status"] == "out-of-band" for v in verdicts):
             sys.exit(1)

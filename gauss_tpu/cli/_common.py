@@ -197,10 +197,15 @@ def _solve_tpu_blocked(a64, b64, nthreads, refine_iters, panel, refine_tol):
     # Warm up compile at the target shape through solve_refined itself: the
     # jit cache keys on the call-site kwarg signature, so warming the inner
     # functions directly with a different kwarg set would still recompile
-    # (measured: +1.7 s) inside the timed span.
+    # (measured: +1.7 s) inside the timed span. The warmup passes STAGED
+    # a_dev/b_dev exactly like the timed call below — a caller-staged
+    # operand selects the NON-donating factorization (solve_refined only
+    # donates operands it created itself), and warming the donating twin
+    # would leave the timed route cold.
     with obs.compile_span("tpu_blocked_warmup", n=n):
-        blocked.solve_refined(np.eye(n), np.zeros(n), panel=panel,
-                              iters=refine_iters)
+        w_a, w_b = np.eye(n), np.zeros(n)
+        blocked.solve_refined(w_a, w_b, panel=panel, iters=refine_iters,
+                              a_dev=_stage(w_a)[0], b_dev=_stage(w_b)[0])
 
     a_dev, b_dev = _stage(a64, b64)
     if obs.active() is not None:

@@ -115,6 +115,74 @@ def panel_fits_vmem(n: int, panel: int, itemsize: int = 4) -> bool:
     return fits
 
 
+def fused_fits_vmem(h: int, panel: int, ct: int | None = None,
+                    itemsize: int = 4) -> bool:
+    """Whether the FUSED panel+trailing kernel's working set fits scoped
+    VMEM for an (h, panel) panel step: the pipeline keeps
+    ``FUSED_WORKSET_TILES`` trailing (h, ct) tiles live next to the
+    aliased transposed panel and its (panel, h) multiplier/pivot scratch
+    pair (``FUSED_WORKSET_PANELS`` panel-width blocks), plus the classic
+    kernel's per-row bookkeeping overhead. Both the budget and the tile
+    width consult the tuned store (op ``panel_fused``) like the classic
+    panel budget does."""
+    from gauss_tpu.tune import apply as _tune
+
+    npad = -(-h // panel) * panel
+    if ct is None:
+        ct = int(_tune.override("panel_fused", h, "ct")
+                 or _tspace.FUSED_CT_SEED)
+    ct = max(panel, (ct // panel) * panel)
+    overhead = PANEL_VMEM_ROW_OVERHEAD.get(
+        panel, 220 if panel >= 64 else _tspace.narrow_panel_overhead(panel))
+    est = npad * ((_tspace.FUSED_WORKSET_TILES * ct
+                   + _tspace.FUSED_WORKSET_PANELS * panel) * itemsize
+                  + overhead)
+    budget = int(_tune.override("panel_fused", h, "vmem_budget")
+                 or PANEL_VMEM_BUDGET)
+    fits = est <= budget
+    from gauss_tpu.obs import compile as _obs_compile
+
+    _obs_compile.record_vmem_estimate(
+        "panel_fused", n=h, panel=panel, ct=ct, itemsize=itemsize,
+        bytes=est, budget=budget, fits=fits)
+    return fits
+
+
+def _use_fused(panel_impl: str, h: int, panel: int, wtot: int,
+               itemsize: int = 4, carried: bool = False,
+               zero_pivot_safe: bool = False) -> bool:
+    """Whether a panel step runs the fused panel+trailing kernel
+    (kernels.panel_fused_pallas). ``panel_impl='fused'`` forces it (with
+    the explicit-request sizing contract: a clear ValueError on a real TPU
+    when the working set cannot fit — never a raw Mosaic error);
+    ``'auto'`` selects it on TPU when :func:`fused_fits_vmem` approves;
+    ``'jax'``/``'pallas'`` never do.
+
+    ``carried=True`` (an ABFT checksum rider is active) deterministically
+    falls back to the UNFUSED pair: the fused kernel does not thread the
+    carry, and the checksum verification is defined against the unfused
+    trailing math — the fallback keeps ``abft=True`` factors bit-identical
+    to the unfused forms the invariant was validated on (the explicit
+    fused-vs-ABFT contract; tested). ``zero_pivot_safe`` likewise pins the
+    stock-JAX panel (only it implements the guarded division)."""
+    if panel_impl not in ("auto", "fused"):
+        return False
+    if zero_pivot_safe or carried or wtot <= panel:
+        return False
+    if panel_impl == "fused":
+        if (jax.default_backend() == "tpu"
+                and not fused_fits_vmem(h, panel, itemsize=itemsize)):
+            raise ValueError(
+                f"panel_impl='fused' requested but the (h={h}, "
+                f"panel={panel}) fused working set exceeds the VMEM "
+                f"budget; use panel_impl='auto' (unfused pair there), a "
+                f"narrower trailing tile (tune.space panel_fused/ct), or "
+                f"a narrower panel")
+        return True
+    return (jax.default_backend() == "tpu" and panel >= 64
+            and fused_fits_vmem(h, panel, itemsize=itemsize))
+
+
 def auto_panel(n: int, itemsize: int = 4) -> int:
     """Measured-best panel width: 256 while its kernel block fits the
     scoped budget (~12.4k — the end-to-end winner there: fewer XLA glue
@@ -358,10 +426,11 @@ def _reraise_scoped_vmem(fn):
         except ValueError:
             raise
         except Exception as e:
-            if (kwargs.get("panel_impl") == "pallas"
+            if (kwargs.get("panel_impl") in ("pallas", "fused")
                     and _looks_like_scoped_vmem_error(e)):
                 raise ValueError(
-                    "panel_impl='pallas': Mosaic ran out of scoped VMEM "
+                    f"panel_impl={kwargs.get('panel_impl')!r}: Mosaic ran "
+                    "out of scoped VMEM "
                     "compiling the panel kernel — this (h, panel, group "
                     "width) context is outside the measured probe table "
                     "(PANEL_VMEM_ROW_OVERHEAD / PANEL64_MIN_SLICE_W). Use "
@@ -376,6 +445,13 @@ def _reraise_scoped_vmem(fn):
 
 def _resolve_panel_impl(panel_impl, n: int | None = None,
                         panel: int | None = None, itemsize: int = 4):
+    if panel_impl == "fused":
+        # The fused panel+trailing selection happens upstream (_use_fused);
+        # paths that reach THIS resolver with "fused" either fell back
+        # (ABFT carry, VMEM reject in auto mode) or never integrated the
+        # fused kernel (the phased diagnostic factorizer) — they resolve
+        # the remaining panel-factor choice as "auto".
+        panel_impl = "auto"
     if panel_impl == "auto":
         # The Pallas VMEM-resident panel kernel uses TPU-only Mosaic
         # features; it is the fast path on real TPUs — when its block fits
@@ -572,19 +648,19 @@ def _csum_final_err_lu(m, crow0):
     return jnp.max(diff), jnp.argmax(diff)
 
 
-@_reraise_scoped_vmem
-@partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision",
-                                   "swap_impl", "zero_pivot_safe", "abft"))
-def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
-                      panel_impl: str = "auto",
-                      gemm_precision: str = "highest",
-                      swap_impl: str = "gather",
-                      zero_pivot_safe: bool = False,
-                      abft: bool = False) -> BlockedLU:
+def _lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
+                       panel_impl: str = "auto",
+                       gemm_precision: str = "highest",
+                       swap_impl: str = "gather",
+                       zero_pivot_safe: bool = False,
+                       abft: bool = False) -> BlockedLU:
     """Blocked LU with partial pivoting; one fori_loop over column panels.
 
     panel_impl: "jax" (stock fori_loop rank-1 updates), "pallas" (the
-    VMEM-resident kernel from kernels.panel_pallas), or "auto".
+    VMEM-resident kernel from kernels.panel_pallas), "fused" (the
+    panel+trailing kernel from kernels.panel_fused_pallas — factor and
+    trailing update in ONE launch), or "auto" (fused on TPU while its
+    working set fits VMEM, then pallas, then jax).
     gemm_precision: MXU precision for the trailing updates. Default "highest"
     (6-pass f32 emulation): measured on v5e, "high" (bf16x3) saves only ~4%
     wall-clock but costs ~50x residual accuracy on random matrices and stalls
@@ -623,12 +699,39 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     panel = _resolve_panel(n, panel, itemsize)
     if zero_pivot_safe:
         panel_impl = "jax"
+        use_fused = False
     else:
+        use_fused = _use_fused(panel_impl, n, panel,
+                               -(-n // panel) * panel, itemsize,
+                               carried=abft)
         panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
     nb = npad // panel
     dtype = m.dtype
+
+    def outer_fused(k, carry):
+        """The fused step: factor + trailing update in one kernel launch
+        (never traced with the ABFT rider — _use_fused falls back). The
+        pivot rows come back holding U12 and the live rows the updated
+        trailing block, so only the permutation gather, the panel install,
+        and the lu_solve diagonal-block inverses remain at XLA level."""
+        from gauss_tpu.kernels.panel_fused_pallas import \
+            panel_trailing_fused_pallas
+
+        m, perm, min_piv, linvs, uinvs = carry
+        kb = k * panel
+        p, ipiv, perm_local, mp, m_upd = panel_trailing_fused_pallas(
+            m, kb, kb, panel=panel)
+        min_piv = jnp.minimum(min_piv, mp)
+        m = m_upd[perm_local]
+        perm = perm[perm_local]
+        m = lax.dynamic_update_slice(m, p, (0, kb))
+        d = lax.dynamic_slice(m, (kb, kb), (panel, panel))
+        linv_k, uinv_k = _diag_block_invs(d, panel, dtype)
+        linvs = lax.dynamic_update_slice(linvs, linv_k[None], (k, 0, 0))
+        uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (k, 0, 0))
+        return m, perm, min_piv, linvs, uinvs
 
     def outer(k, carry):
         if abft:
@@ -719,17 +822,30 @@ def lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
         return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                          linv=linvs, uinv=uinvs,
                          abft_err=jnp.concatenate([errs, fe[None]]))
-    m, perm, min_piv, linvs, uinvs = lax.fori_loop(0, nb, outer, init)
+    m, perm, min_piv, linvs, uinvs = lax.fori_loop(
+        0, nb, outer_fused if use_fused else outer, init)
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=linvs, uinv=uinvs)
 
 
-@_reraise_scoped_vmem
-@partial(jax.jit, static_argnames=("panel", "panel_impl", "gemm_precision"))
-def lu_factor_blocked_unrolled(a: jax.Array,
-                               panel: int | None = DEFAULT_PANEL,
-                               panel_impl: str = "auto",
-                               gemm_precision: str = "highest") -> BlockedLU:
+_LU_FACTOR_STATICS = ("panel", "panel_impl", "gemm_precision", "swap_impl",
+                      "zero_pivot_safe", "abft")
+lu_factor_blocked = _reraise_scoped_vmem(
+    jax.jit(_lu_factor_blocked, static_argnames=_LU_FACTOR_STATICS))
+#: The donating twin: same trace, ``a``'s buffer donated so XLA reuses it
+#: for the factor instead of holding operand + factor + transients live
+#: (one full matrix copy less on the hot path). Callers must OWN the
+#: operand buffer (it is invalidated on backends that honor donation —
+#: including CPU on jax >= 0.4.x); resolve_factor(donate=True) routes here.
+lu_factor_blocked_donating = _reraise_scoped_vmem(
+    jax.jit(_lu_factor_blocked, static_argnames=_LU_FACTOR_STATICS,
+            donate_argnums=(0,)))
+
+
+def _lu_factor_blocked_unrolled(a: jax.Array,
+                                panel: int | None = DEFAULT_PANEL,
+                                panel_impl: str = "auto",
+                                gemm_precision: str = "highest") -> BlockedLU:
     """Blocked LU with the panel loop unrolled at trace time.
 
     Identical math and factor layout to :func:`lu_factor_blocked`, but the
@@ -751,6 +867,7 @@ def lu_factor_blocked_unrolled(a: jax.Array,
         raise ValueError(f"expected square matrix, got {a.shape}")
     itemsize = jnp.dtype(a.dtype).itemsize
     panel = _resolve_panel(n, panel, itemsize)
+    impl_req = panel_impl
     panel_impl = _resolve_panel_impl(panel_impl, n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
@@ -761,6 +878,25 @@ def lu_factor_blocked_unrolled(a: jax.Array,
 
     for kb in range(0, npad, panel):
         tail = npad - kb
+        # Fused panel+trailing step, resolved PER PANEL on the shrinking
+        # live height (like the chunked route's per-group resolution):
+        # factor, U12, and the trailing update leave the kernel as one
+        # launch; only the permutation gather and the panel install remain.
+        if _use_fused(impl_req, tail, panel, npad - kb, itemsize):
+            from gauss_tpu.kernels.panel_fused_pallas import \
+                panel_trailing_fused_pallas
+
+            live = m[kb:]
+            p, ipiv, perm_local, mp, live_upd = panel_trailing_fused_pallas(
+                live, kb, 0, panel=panel)
+            min_piv = jnp.minimum(min_piv, mp)
+            live = live_upd[perm_local]
+            perm = perm.at[kb:].set(perm[kb:][perm_local])
+            live = live.at[:, kb:kb + panel].set(p)
+            linvs.append(_diag_block_linv(live[:panel, kb:kb + panel],
+                                          panel, dtype))
+            m = m.at[kb:].set(live)
+            continue
         # The live column block: rows kb.. only — earlier rows are finished U.
         p = m[kb:, kb:kb + panel]
         if panel_impl == "pallas":
@@ -807,6 +943,15 @@ def lu_factor_blocked_unrolled(a: jax.Array,
     uinvs = jax.vmap(lambda d: _diag_block_uinv(d, panel, dtype))(diags)
     return BlockedLU(m=m, perm=perm, min_abs_pivot=min_piv,
                      linv=jnp.stack(linvs), uinv=uinvs)
+
+
+_UNROLLED_STATICS = ("panel", "panel_impl", "gemm_precision")
+lu_factor_blocked_unrolled = _reraise_scoped_vmem(
+    jax.jit(_lu_factor_blocked_unrolled, static_argnames=_UNROLLED_STATICS))
+#: Donating twin (see lu_factor_blocked_donating).
+lu_factor_blocked_unrolled_donating = _reraise_scoped_vmem(
+    jax.jit(_lu_factor_blocked_unrolled, static_argnames=_UNROLLED_STATICS,
+            donate_argnums=(0,)))
 
 
 # Blockwise lu_solve trace form: unrolled below this many blocks (every
@@ -927,15 +1072,12 @@ def lu_solve(factors: BlockedLU, b: jax.Array,
     return x[:, 0] if was_vector else x
 
 
-@_reraise_scoped_vmem
-@partial(jax.jit, static_argnames=("panel", "chunk", "panel_impl",
-                                   "gemm_precision", "abft"))
-def lu_factor_blocked_chunked(a: jax.Array,
-                              panel: int | None = DEFAULT_PANEL,
-                              chunk: int = CHUNK_DEFAULT,
-                              panel_impl: str = "auto",
-                              gemm_precision: str = "highest",
-                              abft: bool = False) -> BlockedLU:
+def _lu_factor_blocked_chunked(a: jax.Array,
+                               panel: int | None = DEFAULT_PANEL,
+                               chunk: int = CHUNK_DEFAULT,
+                               panel_impl: str = "auto",
+                               gemm_precision: str = "highest",
+                               abft: bool = False) -> BlockedLU:
     """Blocked LU with the panel loop unrolled in GROUPS of ``chunk`` panels.
 
     The middle point between :func:`lu_factor_blocked` (one fori_loop, flat
@@ -1009,6 +1151,15 @@ def lu_factor_blocked_chunked(a: jax.Array,
                      abft_err=abft_err)
 
 
+_CHUNKED_STATICS = ("panel", "chunk", "panel_impl", "gemm_precision", "abft")
+lu_factor_blocked_chunked = _reraise_scoped_vmem(
+    jax.jit(_lu_factor_blocked_chunked, static_argnames=_CHUNKED_STATICS))
+#: Donating twin (see lu_factor_blocked_donating).
+lu_factor_blocked_chunked_donating = _reraise_scoped_vmem(
+    jax.jit(_lu_factor_blocked_chunked, static_argnames=_CHUNKED_STATICS,
+            donate_argnums=(0,)))
+
+
 def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
                   panel_impl: str, gemm_prec, crow=None):
     """One group of the chunked factorization: factor (up to) ``chunk``
@@ -1045,6 +1196,15 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
     w = gpanels * panel          # group block width (static)
     rt = gh - w                  # right-of-group trailing width (static)
     grp = m[gs:, gs:gs + w]      # (gh, w) group column block
+    # Fused panel+trailing resolution is PER GROUP too: within a group the
+    # panel's trailing update covers the group's own (gh, w) column block,
+    # so the fused kernel's working set is the group height times the
+    # trailing tile — the right-of-group deferred GEMM below is untouched.
+    # An active ABFT rider (crow) deterministically falls back to the
+    # unfused pair (see _use_fused), keeping the checksum math — and the
+    # abft=True bit-identity contract — on the path it was validated on.
+    fused_g = _use_fused(panel_impl, gh, panel, w, itemsize,
+                         carried=crow is not None)
     # Panel-impl resolution is PER GROUP on the group height; explicit
     # "jax"/"pallas" requests stay global. Narrow panel-64 groups
     # additionally drop to the stock-JAX panel in auto mode: slicing
@@ -1069,8 +1229,8 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
     narrow64 = panel <= 64 and w < PANEL64_MIN_SLICE_W
     wide128 = (panel == 128 and w == 2048
                and gh * (2 * panel * itemsize + 128) > PANEL_VMEM_BUDGET)
-    if impl_g == "pallas" and (narrow64 or wide128):
-        if panel_impl == "auto":
+    if impl_g == "pallas" and (narrow64 or wide128) and not fused_g:
+        if panel_impl in ("auto", "fused"):
             impl_g = "jax"
         elif jax.default_backend() == "tpu":
             raise ValueError(
@@ -1082,6 +1242,24 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
     def body(j, carry, gh=gh, w=w, panel_impl=impl_g):
         grp, gperm, min_piv, linvs, uinvs = carry
         kb = j * panel           # panel offset WITHIN the group
+        if fused_g:
+            # One launch: factor + in-group trailing update (pivot rows
+            # return holding U12); only the permutation gather, the panel
+            # install, and the diagonal-block inverses remain here.
+            from gauss_tpu.kernels.panel_fused_pallas import \
+                panel_trailing_fused_pallas
+
+            p, ipiv, perm_local, mp, grp_upd = panel_trailing_fused_pallas(
+                grp, kb, kb, panel=panel)
+            min_piv = jnp.minimum(min_piv, mp)
+            grp = grp_upd[perm_local]
+            gperm = gperm[perm_local]
+            grp = lax.dynamic_update_slice(grp, p, (0, kb))
+            d = lax.dynamic_slice(grp, (kb, kb), (panel, panel))
+            linv_k, uinv_k = _diag_block_invs(d, panel, dtype)
+            linvs = lax.dynamic_update_slice(linvs, linv_k[None], (j, 0, 0))
+            uinvs = lax.dynamic_update_slice(uinvs, uinv_k[None], (j, 0, 0))
+            return grp, gperm, min_piv, linvs, uinvs
         p, ipiv, perm_local, mp = _factor_panel(grp, kb, gh, panel,
                                                 panel_impl)
         if perm_local is None:
@@ -1307,28 +1485,81 @@ MAX_CHUNK = 32  # escalation ceiling: chunk-32 at panel 128 (the round-5
 # (W = 4096 at panel 128, chunk 32).
 
 
-def resolve_factor(n: int, unroll):
+def resolve_factor(n: int, unroll, *, donate: bool = False,
+                   checkpoint_path=None, abft: bool = False):
     """The factorization for (size, unroll policy): "auto" picks fully
-    unrolled on TPU up to UNROLL_MAX_N (true triangular work; measured
-    6.1 -> 3.9 ms at n=2048 on v5e), group-chunked above it (triangular at
-    group granularity, bounded compile payload; 121 -> 59 ms at n=8192).
+    unrolled up to UNROLL_MAX_N (true triangular work; measured
+    6.1 -> 3.9 ms at n=2048 on v5e, and 1.43 -> 0.66 s on the CPU proxy —
+    the PR-10 reclaim measurement: the flat form's masked full-size GEMMs
+    cost ~2x the FLOPs, which a CPU pays linearly), group-chunked above it
+    (triangular at group granularity, bounded compile payload;
+    121 -> 59 ms at n=8192). Sub-1024 systems on non-TPU backends keep the
+    flat one-traced-body form — at test-mesh sizes compile time dominates
+    and the per-panel trace payload buys nothing.
     The chunked form's compile payload scales with its GROUP count (each
     group is one traced fori body at a distinct size; panels inside a group
     are a loop, not a trace), so when chunk=4 would exceed MAX_CHUNK_GROUPS
     the chunk ESCALATES (8, then 16) before falling back to the flat
     fori_loop — measured round 3: n=16384 runs 1.39 s on the flat route vs
     0.59 s chunked-8, memplus (17758) 1.91 s flat vs 0.82 s chunked-8.
-    The flat fori_loop remains the route past chunk-16's reach and on CPU
-    (compile time matters more than FLOPs there). True/False force
-    unrolled/fori; "chunked" forces the middle.
+    The flat fori_loop remains the route past chunk-16's reach and below
+    n=1024 off-TPU. True/False force unrolled/fori; "chunked" forces the
+    middle.
 
     A tuned store (gauss_tpu.tune) overrides the CHUNK starting point per
     n-bucket — the escalation cap still applies on top (a tuned chunk can
     never produce a group count the tunneled compiler is known to choke
-    on); panel tuning rides through auto_panel."""
+    on); panel tuning rides through auto_panel.
+
+    **The fast-path contract** (ROADMAP perf item, reclaimed in PR 10):
+    with the keyword defaults — no checkpoint path, no ABFT carry — the
+    returned callable is ONE fully-jitted program: no host-stepped group
+    loop, no per-group device sync, and no hook callsites (io_callback /
+    pure_callback or any other host primitive) anywhere in its traced
+    jaxpr. Fault-injection and obs consults happen at trace/entry time
+    only, so hooks cost nothing unless enabled (tested:
+    tests/test_fused.py asserts the jaxpr is callback-free).
+
+    ``donate=True`` selects the buffer-donating twin: the operand's buffer
+    is handed to XLA for reuse (one matrix copy less live). Only for
+    callers that OWN the operand — it is invalidated on backends that
+    honor donation, including CPU. ``checkpoint_path`` routes to the
+    host-stepped checkpointed factorization (the ONLY host-stepped route;
+    its per-group steps donate their carry internally). ``abft=True``
+    selects the checksum-carrying jitted form — still one program, with
+    the rider verified on device; the host-stepped replay runner lives in
+    resilience.abft. checkpoint_path and abft are mutually exclusive.
+    """
+    if checkpoint_path is not None:
+        if abft:
+            raise ValueError("checkpoint_path and abft are mutually "
+                             "exclusive; the ABFT runner keeps its own "
+                             "in-memory carry (resilience.abft)")
+        from gauss_tpu.resilience.checkpoint import \
+            lu_factor_blocked_chunked_checkpointed
+
+        return partial(lu_factor_blocked_chunked_checkpointed,
+                       path=checkpoint_path)
+
+    def pick(fn):
+        if abft:
+            if fn is lu_factor_blocked_unrolled:
+                # The unrolled form carries no checksum rider; the flat
+                # fori form is the single-program checksum carrier at
+                # unrolled sizes.
+                fn = lu_factor_blocked
+            base = partial(fn, abft=True)
+            return base
+        if donate:
+            fn = _DONATING.get(fn, fn)
+        return fn
+
     if unroll == "auto":
-        if jax.default_backend() != "tpu":
-            return lu_factor_blocked
+        if jax.default_backend() != "tpu" and n < 1024:
+            # Tiny systems: one traced fori body; the unrolled form's
+            # per-panel programs buy nothing at sizes where the whole
+            # solve is microseconds (and the test meshes live here).
+            return pick(lu_factor_blocked)
         if n > UNROLL_MAX_N:
             from gauss_tpu.tune import apply as _tune
 
@@ -1339,7 +1570,7 @@ def resolve_factor(n: int, unroll):
             while -(-nb // chunk) > MAX_CHUNK_GROUPS and chunk < MAX_CHUNK:
                 chunk *= 2
             if -(-nb // chunk) > MAX_CHUNK_GROUPS:
-                return lu_factor_blocked
+                return pick(lu_factor_blocked)
             # Panel-128 chunk-16 (W=2048 groups) inflates the aliased
             # kernel's scoped overhead at the top sizes (27.3 M at
             # n=34048, 16.3 M at 32768) and would push the tallest
@@ -1352,15 +1583,24 @@ def resolve_factor(n: int, unroll):
             if panel == 128 and chunk == 16:
                 chunk = 32
             if chunk == CHUNK_DEFAULT:
-                return lu_factor_blocked_chunked
-            return partial(lu_factor_blocked_chunked, chunk=chunk)
-        return lu_factor_blocked_unrolled
+                return pick(lu_factor_blocked_chunked)
+            return partial(pick(lu_factor_blocked_chunked), chunk=chunk)
+        return pick(lu_factor_blocked_unrolled)
     if unroll == "chunked":
-        return lu_factor_blocked_chunked
+        return pick(lu_factor_blocked_chunked)
     if isinstance(unroll, str):
         raise ValueError(f"unknown unroll {unroll!r}; options: "
                          "(True, False, 'auto', 'chunked')")
-    return lu_factor_blocked_unrolled if unroll else lu_factor_blocked
+    return pick(lu_factor_blocked_unrolled if unroll else lu_factor_blocked)
+
+
+#: non-donating entry point -> its buffer-donating twin (resolve_factor's
+#: donate=True routing).
+_DONATING = {
+    lu_factor_blocked: lu_factor_blocked_donating,
+    lu_factor_blocked_chunked: lu_factor_blocked_chunked_donating,
+    lu_factor_blocked_unrolled: lu_factor_blocked_unrolled_donating,
+}
 
 
 @_reraise_scoped_vmem
@@ -1407,11 +1647,19 @@ def solve_refined(a: np.ndarray, b: np.ndarray, panel: int | None = None,
     """
     a64 = np.asarray(a, dtype=np.float64)
     b64 = np.asarray(b, dtype=np.float64)
-    if a_dev is None:
+    n = len(b64)
+    created_a = a_dev is None
+    if created_a:
         a_dev = jnp.asarray(a64, dtype=dtype)
     if b_dev is None:
         b_dev = jnp.asarray(b64, dtype=dtype)
-    factor = resolve_factor(len(b64), unroll)
+    # Donate the factor operand when WE created it this call (a caller-
+    # staged a_dev may be reused across that caller's reps) and the shape
+    # is already a panel multiple (a padded donation is unusable and would
+    # warn) — one full matrix copy less live inside the factorization.
+    donate = created_a and n % _resolve_panel(
+        n, panel, jnp.dtype(dtype).itemsize) == 0
+    factor = resolve_factor(n, unroll, donate=donate)
     fac = factor(a_dev, panel=panel, panel_impl=panel_impl)
     x = np.asarray(lu_solve(fac, b_dev), dtype=np.float64)
     tol_eff = tol * min(1.0, float(np.linalg.norm(b64))) if tol > 0.0 else 0.0

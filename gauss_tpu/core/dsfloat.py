@@ -261,7 +261,7 @@ def ds_residual(at: DS, x: DS, b: DS) -> DS:
     return ds_add(b, ds_neg(ax))
 
 
-@partial(jax.jit, static_argnames=("iters", "solve_fn"))
+@partial(jax.jit, static_argnames=("iters", "solve_fn"), donate_argnums=(3,))
 def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None) -> DS:
     """On-device iterative refinement with double-single residuals.
 
@@ -274,6 +274,12 @@ def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None) -> DS:
     ``blocked.lu_solve``). The structure engines thread their own — e.g.
     ``structure.cholesky.cholesky_solve`` — so every factorization family
     shares ONE double-single refinement implementation.
+
+    ``x0``'s buffer is DONATED (it seeds the solution carry and is dead in
+    the caller by contract — every call site passes the fresh initial
+    solve); on backends that honor donation the refine loop reuses it
+    instead of allocating a new carry per entry. Inline-traced calls (the
+    bench chains) are unaffected — donation only applies at top level.
     Each iteration: r = b - A x (double-single), d = solve_fn(fac, r.hi +
     r.lo collapsed to f32 — the correction only needs f32 relative
     accuracy), and a double-single solution update. The whole loop compiles
@@ -298,7 +304,8 @@ DS_REFINE_STEPS = 6
 
 def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
                   iters: int = DS_REFINE_STEPS, unroll="auto",
-                  gemm_precision: str = "highest") -> "tuple[DS, object]":
+                  gemm_precision: str = "highest",
+                  donate: bool = False) -> "tuple[DS, object]":
     """One jittable f32 factor + solve + double-single refinement pass.
 
     ``a`` is the f32 matrix (factor operand); ``at_ds``/``b_ds`` the
@@ -309,10 +316,16 @@ def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
     point shared by :func:`solve_ds` and the bench timing chain
     (bench.slope.gauss_solve_once_ds) — what gets timed is exactly what
     gets verified.
+
+    ``donate=True`` hands ``a``'s buffer to the factorization
+    (resolve_factor's donating twin) — only for callers that own it;
+    :func:`solve_ds` opts in for the operand it stages itself, the bench
+    chains (where the call is traced inline and donation is moot) and the
+    staged-operand timing paths do not.
     """
     from gauss_tpu.core import blocked
 
-    factor = blocked.resolve_factor(a.shape[0], unroll)
+    factor = blocked.resolve_factor(a.shape[0], unroll, donate=donate)
     fac = factor(a, panel=panel, gemm_precision=gemm_precision)
     x0 = blocked.lu_solve(fac, b_ds.hi)
     return refine_ds(fac, at_ds, b_ds, x0, iters=iters), fac
@@ -331,6 +344,13 @@ def solve_ds(a, b, iters: int = DS_REFINE_STEPS, panel: int | None = None,
     """
     a64 = np.asarray(a, np.float64)
     b64 = np.asarray(b, np.float64)
+    n = len(b64)
+    from gauss_tpu.core.blocked import _resolve_panel
+
+    # The f32 factor operand is staged HERE and dead after the factor —
+    # donate it (unpadded shapes only; a padded donation is unusable).
+    donate = n % _resolve_panel(n, panel) == 0
     x, fac = solve_once_ds(jnp.asarray(a64, jnp.float32), to_ds(a64.T),
-                           to_ds(b64), panel, iters=iters, unroll=unroll)
+                           to_ds(b64), panel, iters=iters, unroll=unroll,
+                           donate=donate)
     return ds_to_f64(x), fac

@@ -1,0 +1,356 @@
+"""Fused panel-factor + trailing-update kernel — the single-chip record path.
+
+The blocked factorization's inner step is two device launches today: the
+VMEM-resident panel factor (kernels.panel_pallas) writes the factored
+(h, panel) block back to HBM, and the trailing update reads it right back —
+as the L21 operand of the masked trailing GEMM plus the L11^-1-based U12
+solve (core.blocked._install_and_update). That HBM round-trip between the
+launches, and the XLA glue steps around it, are pure overhead at the sizes
+where the whole working set pipelines through VMEM anyway — and the doctor
+diff (reports/doctor_r3_vs_r5.json) charges the n=2048 regression to
+exactly this class of between-launch host/HBM traffic.
+
+This module fuses the two into ONE kernel:
+
+- **Grid step 0** runs the panel factor — the *same* step loop as
+  ``panel_pallas._factor_body`` (shared code, so the factored panel is
+  bit-identical to ``panel_factor_pallas`` at a matching ``seg``) — and
+  additionally records each step's multiplier lane vector and pivot
+  one-hot into persistent (panel, h) VMEM scratch.
+- **Every grid step** then updates one (h, ct) trailing column tile from
+  that scratch: per ``fseg``-wide segment of the panel, the pivot-row
+  values are extracted with one-hot dots, the segment's unit-triangular
+  coupling is inverted by the factored Neumann series (the deferred-update
+  scheme of panel_pallas, commuting factors of powers of one nilpotent
+  matrix), and the rank-``fseg`` update lands as MXU dots. Sequential
+  elimination applied segment-at-a-time: the pivot rows come out holding
+  U12 and the live rows A22 - L21 @ U12 — the entire
+  ``_install_and_update`` trailing math — without the factored panel ever
+  leaving VMEM.
+
+The factored panel's L/U values therefore feed the trailing GEMM in the
+same grid; the only HBM traffic is one streamed read+write of the trailing
+block (which the unfused GEMM pays too). Tiles left of the panel pass
+through untouched; row permutations stay logical (the done-mask scheme of
+panel_pallas) and are applied by the caller as one gather, as before.
+
+**The unfused pair** (the fallback when :func:`core.blocked.fused_fits_vmem`
+rejects the working set, and the bit-identity reference): the classic
+``panel_factor_pallas`` launch followed by :func:`trailing_update_pallas`,
+a second kernel applying the identical trailing math from the multiplier/
+pivot rows reconstructed — exactly, gathers and selects only — from the
+factored panel (:func:`reconstruct_mult_pt`). Fused and unfused share
+``_factor_body`` and ``_trailing_tile_update`` verbatim, so their outputs
+are bit-identical at matching (seg, fseg, ct) tiles (tested).
+
+Tile/segment axes (``ct``, ``fseg``, ``seg``) are declared in
+``tune.space`` (op ``panel_fused``) and consulted through ``tune.apply`` —
+seeded with the shipped constants, swept per (n-bucket, dtype, device
+kind) by ``gauss-tune``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gauss_tpu.kernels.matmul_pallas import _auto_interpret
+from gauss_tpu.kernels.panel_pallas import _factor_body
+from gauss_tpu.tune.space import FUSED_CT_SEED, FUSED_FSEG_SEED
+
+
+def _trailing_tile_update(t, mult_ref, pt_ref, *, panel, fseg, dtype):
+    """Apply the panel's recorded eliminations to one (h, ct) row-major
+    trailing tile ``t`` (rows on sublanes, columns on lanes), segment by
+    segment. Shared VERBATIM by the fused kernel and
+    :func:`trailing_update_pallas` — the bit-identity contract.
+
+    Per segment [s0, s1): with M the (w, h) multiplier rows and P the
+    (w, h) pivot one-hots, U0 = P @ T extracts the pivot-row values (exact
+    at HIGHEST against one-hot operands), L^T = P M^T is the strictly-lower
+    step coupling (L^T[j, i] = M[i, p_j], i < j), and the sequential
+    pivot-row recurrence U = U0 - L^T U inverts as
+    U = (I - L^T)(I + L^T^2)(I + L^T^4)... U0 — powers of one nilpotent
+    matrix commute, so the factored Neumann series is exact in exact
+    arithmetic and order-free. The tile then takes T - M^T U on live rows
+    and the U values themselves — scattered exactly through the one-hots —
+    on the segment's pivot rows (which sequential elimination retires:
+    later segments' M is zero there, so they are never touched again)."""
+    hi = lax.Precision.HIGHEST
+    dn_row = (((1,), (0,)), ((), ()))   # (w, h) x (h, ct) -> (w, ct)
+    dn_lan = (((1,), (1,)), ((), ()))   # (w, h) x (w, h) contract h -> (w, w)
+    dn_col = (((0,), (0,)), ((), ()))   # (w, h) x (w, ct) contract w -> (h, ct)
+    for s0 in range(0, panel, fseg):
+        s1 = min(s0 + fseg, panel)
+        w = s1 - s0
+        ms = mult_ref[pl.ds(s0, w), :]                        # (w, h)
+        ps = pt_ref[pl.ds(s0, w), :]                          # (w, h)
+        u = lax.dot_general(ps, t, dn_row, precision=hi,
+                            preferred_element_type=dtype)     # U0 (w, ct)
+        lpt = lax.dot_general(ps, ms, dn_lan, precision=hi,
+                              preferred_element_type=dtype)   # L^T (w, w)
+        e = 1
+        p2 = None
+        while e < w:
+            term = lpt if e == 1 else p2
+            corr = jnp.dot(term, u, precision=hi,
+                           preferred_element_type=dtype)
+            u = u - corr if e == 1 else u + corr
+            if e * 2 < w:
+                p2 = jnp.dot(term, term, precision=hi,
+                             preferred_element_type=dtype)
+            e *= 2
+        upd = lax.dot_general(ms, u, dn_col, precision=hi,
+                              preferred_element_type=dtype)   # L21-weighted
+        uset = lax.dot_general(ps, u, dn_col, precision=hi,
+                               preferred_element_type=dtype)  # U rows placed
+        sel = lax.dot_general(ps, jnp.ones((w, 1), dtype), dn_col,
+                              precision=hi,
+                              preferred_element_type=dtype)   # (h, 1) 0/1
+        t = jnp.where(sel > 0, uset, t - upd)
+    return t
+
+
+def _fused_kernel(scal_ref, pt_in_ref, blk_ref, out_ref, ipiv_ref, inv_ref,
+                  minpiv_ref, chosen_ref, blkout_ref, done_ref, mult_ref,
+                  ptv_ref, *, h, panel, ct, seg, fseg):
+    col0 = scal_ref[0]     # panel's column offset within the block
+    kbrow = scal_ref[1]    # panel's diagonal row offset
+    i = pl.program_id(0)
+    dtype = blk_ref.dtype
+
+    @pl.when(i == 0)
+    def _factor():
+        _factor_body(kbrow, pt_in_ref, out_ref, ipiv_ref, inv_ref,
+                     minpiv_ref, chosen_ref, done_ref, mult_ref, ptv_ref,
+                     h=h, panel=panel, seg=seg, defer=False, record=True)
+
+    # Columns at or left of the panel pass through (L multipliers of
+    # earlier panels, and the panel's own columns — installed factored by
+    # the caller); columns right of it take the recorded eliminations.
+    lanes = lax.broadcasted_iota(jnp.int32, (1, ct), 1)
+    gcol = i * ct + lanes
+    live = gcol >= col0 + panel
+
+    @pl.when((i + 1) * ct > col0 + panel)
+    def _update():
+        t0 = blk_ref[:]
+        t = _trailing_tile_update(t0, mult_ref, ptv_ref, panel=panel,
+                                  fseg=fseg, dtype=dtype)
+        blkout_ref[:] = jnp.where(live, t, t0)
+
+    @pl.when((i + 1) * ct <= col0 + panel)
+    def _copy():
+        blkout_ref[:] = blk_ref[:]
+
+
+def _resolve_tiles(h: int, wtot: int, panel: int, dtype,
+                   ct, seg, fseg):
+    """Resolve the fused kernel's (ct, seg, fseg) — explicit values are
+    honored verbatim; None consults the tuned store (op ``panel_fused``,
+    keyed by the block height) and falls back to the tune.space seeds.
+    ``ct`` is clamped to a panel multiple that divides the block width (a
+    panel-multiple width always admits ct=panel)."""
+    from gauss_tpu.tune import apply as _tune
+
+    dt = str(jnp.dtype(dtype))
+    if ct is None:
+        ct = int(_tune.override("panel_fused", h, "ct", dtype=dt)
+                 or FUSED_CT_SEED)
+    if seg is None:
+        from gauss_tpu.kernels.panel_pallas import DEFAULT_SEG
+
+        seg = int(_tune.override("panel_fused", h, "seg", dtype=dt)
+                  or DEFAULT_SEG)
+    if fseg is None:
+        fseg = int(_tune.override("panel_fused", h, "fseg", dtype=dt)
+                   or FUSED_FSEG_SEED)
+    ct = max(panel, (min(ct, wtot) // panel) * panel)
+    if wtot % ct:
+        ct = panel
+    return ct, min(max(1, seg), panel), min(max(1, fseg), panel)
+
+
+@partial(jax.jit, static_argnames=("panel", "ct", "seg", "fseg",
+                                   "interpret"))
+def panel_trailing_fused_pallas(block, col0, kbrow, *, panel: int,
+                                ct: int | None = None,
+                                seg: int | None = None,
+                                fseg: int | None = None,
+                                interpret: bool | None = None):
+    """Factor the (h, panel) column block of ``block`` whose columns start
+    at ``col0`` and whose diagonal sits at row ``kbrow``, AND apply its
+    eliminations to every column right of it — one kernel launch.
+
+    Returns ``(p, ipiv, perm_local, min_abs_pivot, block_upd)``: the
+    factored panel already row-permuted (getrf layout, as
+    ``panel_factor_pallas`` returns it), the pivot-choice sequence, the
+    permutation as gather indices, the singularity witness, and the full
+    (h, wtot) block with every trailing column updated — pivot rows
+    holding U12, live rows holding A22 - L21 @ U12 — in ORIGINAL row
+    order (apply ``perm_local`` as one gather, then install ``p``).
+    Columns at or left of ``col0 + panel`` come back untouched.
+
+    ``ct``/``seg``/``fseg`` resolve through the tuned store (tune.space op
+    ``panel_fused``) when None. ``col0``/``kbrow`` may be traced."""
+    interpret = _auto_interpret(interpret)
+    h, wtot = block.shape
+    if panel > wtot:
+        raise ValueError(f"panel ({panel}) exceeds the block width "
+                         f"({wtot}); the fused kernel factors a column "
+                         f"block of the operand")
+    dtype = block.dtype
+    ct, seg, fseg = _resolve_tiles(h, wtot, panel, dtype, ct, seg, fseg)
+    scal = jnp.stack([jnp.asarray(col0, jnp.int32),
+                      jnp.asarray(kbrow, jnp.int32)])
+    # The transposed panel operand, standalone (the optimization barrier
+    # keeps the slice+transpose from fusing into the aliased call — the
+    # panel_pallas VMEM double-count lesson).
+    p_t = lax.optimization_barrier(
+        lax.dynamic_slice(block, (jnp.asarray(0, jnp.int32),
+                                  jnp.asarray(col0, jnp.int32)),
+                          (h, panel)).T)
+    block = lax.optimization_barrier(block)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(wtot // ct,),
+        in_specs=[
+            pl.BlockSpec((panel, h), lambda i, s: (0, 0)),
+            pl.BlockSpec((h, ct), lambda i, s: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((panel, h), lambda i, s: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((h, 1), lambda i, s: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((h, 1), lambda i, s: (0, 0)),
+            pl.BlockSpec((h, ct), lambda i, s: (0, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, h), jnp.int32),      # done mask
+            pltpu.VMEM((panel, h), dtype),      # recorded multipliers
+            pltpu.VMEM((panel, h), dtype),      # recorded pivot one-hots
+        ],
+    )
+    out_t, ipiv, inv, minpiv, chosen, block_upd = pl.pallas_call(
+        partial(_fused_kernel, h=h, panel=panel, ct=ct, seg=seg, fseg=fseg),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((panel, h), dtype),
+            jax.ShapeDtypeStruct((panel,), jnp.int32),
+            jax.ShapeDtypeStruct((h, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1,), dtype),
+            jax.ShapeDtypeStruct((h, 1), jnp.int32),
+            jax.ShapeDtypeStruct((h, wtot), dtype),
+        ],
+        # The transposed panel aliases its factored output (the
+        # panel_pallas scheme) and the block aliases its updated output:
+        # the trailing stream is in-place, one read + one write of HBM.
+        # Operand indices count the scalar-prefetch argument.
+        input_output_aliases={1: 0, 2: 5},
+        interpret=interpret,
+    )(scal, p_t, block)
+    perm_local = _perm_from_inv(inv, chosen, jnp.asarray(kbrow, jnp.int32),
+                                h, panel)
+    return out_t.T[perm_local], ipiv, perm_local, minpiv[0], block_upd
+
+
+def _perm_from_inv(inv, chosen, kbrow, h: int, panel: int):
+    """Gather indices from the kernel's inverse-position vector — the same
+    rank-fill scheme as panel_factor_pallas (unchosen rows keep their
+    original relative order after the chosen pivots)."""
+    rows = jnp.arange(h, dtype=jnp.int32)
+    unch = (rows >= kbrow) & (chosen[:, 0] == 0)
+    rank = jnp.cumsum(unch.astype(jnp.int32))
+    inv = jnp.where(unch, kbrow + panel - 1 + rank, inv[:, 0])
+    return jnp.zeros((h,), jnp.int32).at[inv].set(rows)
+
+
+# -- the unfused pair: reconstruction + standalone trailing kernel ---------
+
+
+def reconstruct_mult_pt(p_perm, ipiv, perm_local, kbrow, panel: int):
+    """The (panel, h) multiplier rows and pivot one-hots of a factored
+    panel, reconstructed EXACTLY (gathers, comparisons, and selects only —
+    no arithmetic) from ``panel_factor_pallas`` outputs.
+
+    Row ``r`` of the original panel was retired at step
+    ``inv[r] - kbrow`` when chosen (``inv`` is the inverse of
+    ``perm_local``); its stored value in column j is the multiplier the
+    kernel computed at step j exactly when the row was still live there
+    (``inv[r] > kbrow + j``), and zero otherwise — the same zero the
+    kernel's done-mask wrote."""
+    h = p_perm.shape[0]
+    rows = jnp.arange(h, dtype=jnp.int32)
+    inv = jnp.zeros((h,), jnp.int32).at[perm_local].set(rows)
+    p_raw = p_perm[inv]                                      # original order
+    steps = jnp.asarray(kbrow, jnp.int32) + jnp.arange(panel,
+                                                       dtype=jnp.int32)
+    live = inv[None, :] > steps[:, None]                     # (panel, h)
+    mult = jnp.where(live, p_raw.T, jnp.zeros((), p_perm.dtype))
+    pt = (ipiv[:, None] == rows[None, :]).astype(p_perm.dtype)
+    return mult, pt
+
+
+def _trailing_kernel(scal_ref, mult_ref, pt_ref, blk_ref, blkout_ref, *,
+                     h, panel, ct, fseg):
+    col0 = scal_ref[0]
+    i = pl.program_id(0)
+    dtype = blk_ref.dtype
+    lanes = lax.broadcasted_iota(jnp.int32, (1, ct), 1)
+    live = i * ct + lanes >= col0 + panel
+
+    @pl.when((i + 1) * ct > col0 + panel)
+    def _update():
+        t0 = blk_ref[:]
+        t = _trailing_tile_update(t0, mult_ref, pt_ref, panel=panel,
+                                  fseg=fseg, dtype=dtype)
+        blkout_ref[:] = jnp.where(live, t, t0)
+
+    @pl.when((i + 1) * ct <= col0 + panel)
+    def _copy():
+        blkout_ref[:] = blk_ref[:]
+
+
+@partial(jax.jit, static_argnames=("ct", "fseg", "interpret"))
+def trailing_update_pallas(block, mult, pt, col0, *, ct: int | None = None,
+                           fseg: int | None = None,
+                           interpret: bool | None = None):
+    """The trailing half of the pair, as its own launch: apply the
+    (panel, h) recorded eliminations ``mult``/``pt`` (from
+    :func:`reconstruct_mult_pt`) to every column of ``block`` right of
+    ``col0 + panel``. Identical tile math to the fused kernel (shared
+    ``_trailing_tile_update``), so fused == factor-launch + this launch,
+    bit for bit, at matching (ct, fseg) — the round-trip between the two
+    launches is exactly what the fused form deletes."""
+    interpret = _auto_interpret(interpret)
+    h, wtot = block.shape
+    panel = mult.shape[0]
+    dtype = block.dtype
+    ct, _, fseg = _resolve_tiles(h, wtot, panel, dtype, ct, 1, fseg)
+    scal = jnp.asarray(col0, jnp.int32).reshape(1)
+    block = lax.optimization_barrier(block)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(wtot // ct,),
+        in_specs=[
+            pl.BlockSpec((panel, h), lambda i, s: (0, 0)),
+            pl.BlockSpec((panel, h), lambda i, s: (0, 0)),
+            pl.BlockSpec((h, ct), lambda i, s: (0, i)),
+        ],
+        out_specs=[pl.BlockSpec((h, ct), lambda i, s: (0, i))],
+        scratch_shapes=[],
+    )
+    (out,) = pl.pallas_call(
+        partial(_trailing_kernel, h=h, panel=panel, ct=ct, fseg=fseg),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((h, wtot), dtype)],
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(scal, mult, pt, block)
+    return out

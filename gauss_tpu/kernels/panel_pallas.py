@@ -51,9 +51,22 @@ from jax.experimental.pallas import tpu as pltpu
 from gauss_tpu.kernels.matmul_pallas import _auto_interpret
 
 
-def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
-                  chosen_ref, done_ref, *refs, h, panel, seg, defer):
-    kb = kb_ref[0]
+def _factor_body(kb, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
+                 chosen_ref, done_ref, mult_ref, pt_ref, *, h, panel, seg,
+                 defer, record=False):
+    """The panel-factor step loop, shared VERBATIM by :func:`_panel_kernel`
+    and the fused panel+trailing kernel (kernels.panel_fused_pallas) — one
+    op sequence, so the two kernels' factor outputs are bit-identical at
+    matching (seg, defer) configs. ``kb`` is the already-read scalar row
+    offset of the diagonal.
+
+    ``record=True`` (the fused kernel's mode, classic segments only)
+    additionally stores every step's multiplier lane vector and pivot
+    one-hot into the (panel, h) ``mult_ref``/``pt_ref`` scratch — pure
+    extra stores, the factor arithmetic is untouched — which the fused
+    kernel's trailing phase then applies as rank-``fseg`` MXU updates
+    without the factored panel ever leaving VMEM."""
+    assert not (defer and record)
     out_ref[:] = t_ref[:]
     lanes = lax.broadcasted_iota(jnp.int32, (1, h), 1)
     inv_ref[:] = lax.broadcasted_iota(jnp.int32, (h, 1), 0)
@@ -64,7 +77,6 @@ def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
     dtype = out_ref.dtype
     zero = jnp.zeros((), dtype)
     neg_inf = jnp.asarray(-jnp.inf, dtype)
-    mult_ref, pt_ref = refs if defer else (None, None)
 
     # The per-step tile passes only need the LIVE columns j..panel — columns
     # left of j hold finished L multipliers and receive no further updates.
@@ -131,6 +143,11 @@ def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
                 jl = j - s0
                 mult_ref[pl.ds(jl, 1), :] = mult
                 pt_ref[pl.ds(jl, 1), :] = lane_p.astype(dtype)
+            elif record:
+                # Full-panel bookkeeping for the fused kernel's trailing
+                # phase — stores only; the factor values are unchanged.
+                mult_ref[pl.ds(j, 1), :] = mult
+                pt_ref[pl.ds(j, 1), :] = lane_p.astype(dtype)
             upd = jnp.where(subs > j, u, zero)  # only original columns > j
             # Column-j store: done lanes (U above the diagonal) and the pivot
             # lane (the diagonal) keep their values; live lanes take
@@ -187,6 +204,14 @@ def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
         lax.fori_loop(s0, s1, make_step(s0, s1), 0)
         if defer and s1 < panel:
             deferred_update(s0, s1)
+
+
+def _panel_kernel(kb_ref, t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
+                  chosen_ref, done_ref, *refs, h, panel, seg, defer):
+    mult_ref, pt_ref = refs if defer else (None, None)
+    _factor_body(kb_ref[0], t_ref, out_ref, ipiv_ref, inv_ref, minpiv_ref,
+                 chosen_ref, done_ref, mult_ref, pt_ref, h=h, panel=panel,
+                 seg=seg, defer=defer)
 
 
 # Sub-panel segment width; see _panel_kernel (64 best on v5e). The value

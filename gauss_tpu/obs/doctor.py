@@ -148,6 +148,23 @@ def format_diff(diff: Dict[str, Any], top: Optional[int] = None) -> str:
     return "\n".join(lines)
 
 
+def forbidden_phases(diff: Dict[str, Any], forbid: List[str]
+                     ) -> List[Dict[str, Any]]:
+    """The CANDIDATE-side (B) phases from ``forbid`` that actually ran —
+    the CI gate's payload. A forbidden name matches a phase exactly or as
+    a dotted/segmented prefix (``host_group_step`` catches
+    ``host_group_step.factor`` too)."""
+    hits = []
+    for p in diff["phases"]:
+        name = p["phase"]
+        for f in forbid:
+            if p["b_calls"] and (name == f or name.startswith(f + ".")
+                                 or name.startswith(f + "/")):
+                hits.append(p)
+                break
+    return hits
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m gauss_tpu.obs.doctor",
@@ -162,6 +179,13 @@ def main(argv=None) -> int:
                    help="phases to show in text mode (0 = all; default 12)")
     p.add_argument("-o", "--out", default=None, metavar="PATH",
                    help="also write the JSON diff here")
+    p.add_argument("--forbid", default=None, metavar="PHASES",
+                   help="comma-separated phase names that must NOT appear "
+                        "in the candidate (B) stream; exit 1 when any ran. "
+                        "The plain-path CI gate: host_group_step/hook_sync "
+                        "leaves reappearing on the hooks-off path is the "
+                        "exact regression shape PRs 4-5 introduced and "
+                        "PR 10 reclaimed (reports/doctor_r3_vs_r5.json)")
     args = p.parse_args(argv)
     try:
         a = load_profile(args.run_a)
@@ -181,6 +205,19 @@ def main(argv=None) -> int:
         print(json.dumps(diff, indent=1, sort_keys=True))
     else:
         print(format_diff(diff, args.top or None))
+    if args.forbid:
+        forbid = [f.strip() for f in args.forbid.split(",") if f.strip()]
+        hits = forbidden_phases(diff, forbid)
+        if hits:
+            for h in hits:
+                print(f"doctor: FORBIDDEN phase '{h['phase']}' ran "
+                      f"{h['b_calls']} time(s) ({h['b_s'] * 1e3:.3f} ms) in "
+                      f"the candidate stream — a host-stepped/hook leaf is "
+                      f"back on the plain path", file=sys.stderr)
+            return 1
+        print(f"doctor: forbidden-phase gate clean "
+              f"({', '.join(forbid)} absent from candidate)",
+              file=sys.stderr)
     return 0
 
 

@@ -98,8 +98,26 @@ class BatchedExecutable:
             def solve_one(fac, b):
                 return blocked.lu_solve(fac, b)
 
-        self._factor = jax.jit(jax.vmap(factor_one))
-        self._solve = jax.jit(jax.vmap(solve_one))
+        # Buffer donation on both lanes: the factor's matrix stack and the
+        # solve's right-hand-side stack are freshly-staged host arrays on
+        # every call (warmup identities, per-batch `.astype` copies — see
+        # solve()), dead the moment the dispatch lands, so XLA reuses
+        # their device buffers instead of holding a copy per step — the
+        # copy-per-step the doctor diff shows riding along hook_sync. The
+        # factors (arg 0 of _solve) are NOT donated: refinement reuses
+        # them across every step of a batch. A bucket narrower than its
+        # resolved panel pads inside the factor (output shape differs —
+        # the donation would be unusable and warn), so only panel-multiple
+        # buckets donate the factor operand; the solve output matches its
+        # RHS shape at every bucket.
+        from gauss_tpu.core.blocked import _resolve_panel
+
+        p_res = _resolve_panel(key.bucket_n, panel,
+                               np.dtype(key.dtype).itemsize)
+        fac_donate = (0,) if key.bucket_n % p_res == 0 else ()
+        self._factor = jax.jit(jax.vmap(factor_one),
+                               donate_argnums=fac_donate)
+        self._solve = jax.jit(jax.vmap(solve_one), donate_argnums=(1,))
         # Compile at the exact serving shape now (identity systems), so the
         # one-time cost lands on the miss that created the entry — never
         # inside a later request's compute window.

@@ -152,7 +152,48 @@ def _measure_matmul(n: int, dtype: str, params: Dict[str, Any], seed: int,
     return best
 
 
-_MEASURERS = {"lu_factor": _measure_lu_factor, "matmul": _measure_matmul}
+def _measure_panel_fused(n: int, dtype: str, params: Dict[str, Any],
+                         seed: int, reps: int,
+                         prune_s: Optional[float]) -> Optional[float]:
+    """Best-of-``reps`` seconds for ONE fused panel+trailing launch
+    (kernels.panel_fused_pallas) at the candidate (ct, seg, fseg) tiles —
+    the first (tallest) panel step of an (n, n) block, the step whose
+    shape dominates the factorization. Interpret-mode on non-TPU
+    backends: sweepable anywhere, honest only on real hardware (like the
+    panel kernel itself)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.kernels.panel_fused_pallas import \
+        panel_trailing_fused_pallas
+    from gauss_tpu.utils.timing import timed
+
+    a64, _ = _seeded_system(n, seed)
+    a = jnp.asarray(a64, dtype=jnp.dtype(dtype))
+    panel = min(blocked.auto_panel(n, np.dtype(dtype).itemsize), n)
+    kw = {k: int(v) for k, v in params.items()
+          if k in ("ct", "seg", "fseg") and v is not None}
+
+    def run_once():
+        return panel_trailing_fused_pallas(a, 0, 0, panel=panel, **kw)[4]
+
+    with obs.compile_span("tune_candidate", op="panel_fused", n=n, **kw):
+        jax.block_until_ready(run_once())
+    best = None
+    for r in range(max(1, reps)):
+        t, _ = timed(run_once, warmup=0, reps=1)
+        best = t if best is None else min(best, t)
+        if r == 0 and prune_s is not None and t > prune_s:
+            obs.emit("tune_sweep", event="pruned", op="panel_fused", n=n,
+                     params=params, first_rep_s=round(t, 6),
+                     prune_s=round(prune_s, 6))
+            return None
+    return best
+
+
+_MEASURERS = {"lu_factor": _measure_lu_factor, "matmul": _measure_matmul,
+              "panel_fused": _measure_panel_fused}
 
 
 def _concrete_lu_factor(n: int, dtype: str,

@@ -53,6 +53,30 @@ NARROW_PANEL_OVERHEAD_SCALE = 55_000
 #: (kernels.panel_pallas.DEFAULT_SEG; 64 measured best on v5e).
 PANEL_SEG_SEED = 64
 
+#: fused panel+trailing kernel (kernels.panel_fused_pallas): trailing
+#: column-tile width. The fused kernel streams the trailing block through
+#: VMEM in (h, ct) tiles while the factored panel's multipliers stay
+#: resident; ct trades per-tile MXU occupancy against the tile's VMEM
+#: slice. Seeded at one 256-column tile (two MXU tiles wide — the same
+#: traffic argument as the 512-wide matmul output tiles, halved because
+#: the multiplier scratch shares the budget).
+FUSED_CT_SEED = 256
+
+#: fused kernel trailing-apply segment width: the rank at which the
+#: recorded multiplier rows are applied to each trailing tile (one
+#: Neumann-series chain per segment — the deferred-update scheme of
+#: kernels.panel_pallas, applied across the whole trailing block).
+#: 32 is the deferred form's measured saddle on v5e (panel_pallas
+#: defer_seg); the fused kernel inherits it as its seed.
+FUSED_FSEG_SEED = 32
+
+#: fused-kernel VMEM working-set model: bytes-per-row multiplier on the
+#: column footprint (pipeline-buffered trailing tiles + the aliased
+#: transposed panel + the (panel, h) multiplier/pivot scratch pair), plus
+#: the per-row bookkeeping overhead shared with the classic panel kernel.
+FUSED_WORKSET_TILES = 3   # trailing-tile copies the pipeline keeps live
+FUSED_WORKSET_PANELS = 3  # aliased panel block + mult + pt scratch
+
 #: Pallas matmul tile grid (bm, bn, bk)
 #: (kernels.matmul_pallas defaults; sweep_mm_tiles r4 on v5e).
 MM_TILE_SEED = (512, 512, 1024)
@@ -114,6 +138,16 @@ SPACES: Dict[str, Tuple[Axis, ...]] = {
     ),
     # the VMEM-resident panel kernel (TPU-only; CPU sweeps skip it)
     "panel_kernel": (
+        Axis("seg", PANEL_SEG_SEED, (32, 128)),
+        Axis("vmem_budget", PANEL_VMEM_BUDGET_SEED, (), sweep_default=False),
+    ),
+    # the fused panel+trailing kernel (kernels.panel_fused_pallas): the
+    # trailing tile and apply-segment widths the sweep tries per
+    # (n-bucket, dtype, device kind); the budget axis is declared for
+    # operator-set per-hardware recalibration, like panel_kernel's.
+    "panel_fused": (
+        Axis("ct", FUSED_CT_SEED, (128, 512)),
+        Axis("fseg", FUSED_FSEG_SEED, (16, 64)),
         Axis("seg", PANEL_SEG_SEED, (32, 128)),
         Axis("vmem_budget", PANEL_VMEM_BUDGET_SEED, (), sweep_default=False),
     ),

@@ -555,7 +555,23 @@ def test_resolve_factor_policy(monkeypatch):
         assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
         assert f.keywords["chunk"] == 32
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
-    assert blocked.resolve_factor(24576, "auto") is blocked.lu_factor_blocked
+    # PR-10 reclaim: off-TPU backends share the size policy — true
+    # triangular work wins wherever FLOPs are paid linearly (measured
+    # 1.43 -> 0.66 s at n=2048 on the CPU proxy); only sub-1024 systems
+    # keep the flat one-traced-body form (test-mesh sizes, where compile
+    # time dominates).
+    assert blocked.resolve_factor(512, "auto") is blocked.lu_factor_blocked
+    assert (blocked.resolve_factor(2048, "auto")
+            is blocked.lu_factor_blocked_unrolled)
+    f = blocked.resolve_factor(24576, "auto")
+    assert getattr(f, "func", f) is blocked.lu_factor_blocked_chunked
+    assert f.keywords["chunk"] == 8
+    # The donating twins ride the same policy (resolve_factor's
+    # fast-path contract): same route, buffer-donating executable.
+    assert (blocked.resolve_factor(512, "auto", donate=True)
+            is blocked.lu_factor_blocked_donating)
+    assert (blocked.resolve_factor(2048, "auto", donate=True)
+            is blocked.lu_factor_blocked_unrolled_donating)
 
 
 def test_gauss_solve_blocked_multi_rhs_shapes(rng):

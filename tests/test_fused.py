@@ -1,0 +1,404 @@
+"""PR 10 — fused panel+trailing kernel, buffer donation, and the
+compiled-out-hooks fast path.
+
+Covers the reclaim contracts: fused-vs-unfused bit-identity at matching
+tiles, 1e-4 residuals across the (panel, chunk, n) grid including the
+non-multiple-of-panel edge, donated-buffer inspection on the jitted
+factor/solve steps, the callback-free plain-path jaxpr, the fused-vs-ABFT
+deterministic fallback, the doctor forbidden-phase CI gate, and the
+tightened regression ratchet.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gauss_tpu.core import blocked  # noqa: E402
+from gauss_tpu.kernels import panel_fused_pallas as pf  # noqa: E402
+from gauss_tpu.kernels.panel_pallas import panel_factor_pallas  # noqa: E402
+from gauss_tpu.verify import checks  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(258458)
+
+
+def _system(rng, n):
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n).astype(np.float32)
+
+
+@pytest.mark.parametrize("h,panel,kb,ct,seg,fseg", [
+    (96, 16, 32, 16, 8, 8),      # mid-block panel, small tiles
+    (96, 16, 0, 32, 16, 4),      # first panel, wider tiles
+    (64, 32, 0, 64, 32, 32),     # single-segment apply (fseg == panel)
+    (80, 16, 64, 16, 4, 16),     # last panel: trailing empty, copies only
+])
+def test_fused_bit_identical_to_unfused_pair(rng, h, panel, kb, ct, seg,
+                                             fseg):
+    """The fused kernel == the unfused pair (panel_factor_pallas launch +
+    trailing_update_pallas launch) BIT FOR BIT at matching tiles — the
+    fusion deletes the HBM round-trip between the launches, never a bit
+    of the math (shared _factor_body / _trailing_tile_update)."""
+    block = jnp.asarray(rng.standard_normal((h, h)).astype(np.float32))
+    p, ipiv, perm, mp, upd = pf.panel_trailing_fused_pallas(
+        block, kb, kb, panel=panel, ct=ct, seg=seg, fseg=fseg)
+    p2, ipiv2, perm2, mp2 = panel_factor_pallas(block[:, kb:kb + panel],
+                                                kb, seg=seg)
+    mult, pt = pf.reconstruct_mult_pt(p2, ipiv2, perm2, kb, panel)
+    upd2 = pf.trailing_update_pallas(block, mult, pt, kb, ct=ct, fseg=fseg)
+    np.testing.assert_array_equal(np.asarray(p), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(ipiv), np.asarray(ipiv2))
+    np.testing.assert_array_equal(np.asarray(perm), np.asarray(perm2))
+    assert float(mp) == float(mp2)
+    np.testing.assert_array_equal(np.asarray(upd), np.asarray(upd2))
+    # Columns at or left of the panel pass through untouched.
+    np.testing.assert_array_equal(np.asarray(upd)[:, :kb + panel],
+                                  np.asarray(block)[:, :kb + panel])
+
+
+def test_fused_trailing_matches_xla_reference(rng):
+    """The fused trailing update reproduces _install_and_update's
+    L11^-1-based U12 + masked GEMM to f32 rounding (different float
+    association, same math) — the 1e-4 gate's foundation."""
+    from jax import lax
+
+    h, panel, kb = 96, 16, 32
+    block = jnp.asarray(rng.standard_normal((h, h)).astype(np.float32))
+    p, ipiv, perm, mp, upd = pf.panel_trailing_fused_pallas(
+        block, kb, kb, panel=panel, ct=16, seg=8, fseg=8)
+    ref, _, _ = blocked._install_and_update(
+        block[perm], kb, h, panel, p, lax.Precision.HIGHEST, jnp.float32)
+    fused_m = jnp.asarray(upd)[perm].at[:, kb:kb + panel].set(p)
+    np.testing.assert_allclose(np.asarray(fused_m), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("n,panel,chunk", [
+    (96, 16, 2), (100, 16, 2),   # non-multiple-of-panel edge
+    (64, 32, 1),                 # single-panel groups (fused skipped)
+    (130, 32, 2), (96, 48, 2),   # panel not dividing n
+])
+def test_fused_factor_routes_residual(rng, n, panel, chunk):
+    """panel_impl='fused' through all three factorization forms: every
+    route must clear the 1e-4 residual gate, including the padded edge."""
+    a, b = _system(rng, n)
+    a64, b64 = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    routes = [
+        blocked.lu_factor_blocked(jnp.asarray(a), panel=panel,
+                                  panel_impl="fused"),
+        blocked.lu_factor_blocked_unrolled(jnp.asarray(a), panel=panel,
+                                           panel_impl="fused"),
+        blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=panel,
+                                          chunk=chunk, panel_impl="fused"),
+    ]
+    for fac in routes:
+        x = np.asarray(blocked.lu_solve(fac, jnp.asarray(b)), np.float64)
+        assert checks.residual_norm(a64, x, b64) < 1e-4
+
+
+def test_fused_checkpointed_matches_oneshot(rng, tmp_path):
+    """The checkpointed path shares _factor_group, so a fused chunked
+    factorization and its checkpointed twin stay bit-identical."""
+    from gauss_tpu.resilience import checkpoint as ckpt
+
+    a, _ = _system(rng, 96)
+    f1 = blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=16,
+                                           chunk=2, panel_impl="fused")
+    f2 = ckpt.lu_factor_blocked_chunked_checkpointed(
+        a, str(tmp_path / "ck.npz"), panel=16, chunk=2,
+        panel_impl="fused")
+    for fld in ("m", "perm", "min_abs_pivot", "linv", "uinv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f1, fld)),
+                                      np.asarray(getattr(f2, fld)))
+
+
+def test_abft_falls_back_to_unfused_deterministically(rng):
+    """abft=True + panel_impl='fused': the checksum rider deterministically
+    pins the UNFUSED pair (the fused kernel does not thread the carry), so
+    the abft factor stays bit-identical to the unfused abft=False form and
+    the rider still verifies — the fused-vs-ABFT contract (ISSUE 10), and
+    the zero-overhead sentinel's bit-identity foundation."""
+    a, _ = _system(rng, 96)
+    fab = blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=16,
+                                            chunk=2, panel_impl="fused",
+                                            abft=True)
+    ref = blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=16,
+                                            chunk=2, panel_impl="auto")
+    np.testing.assert_array_equal(np.asarray(fab.m), np.asarray(ref.m))
+    np.testing.assert_array_equal(np.asarray(fab.perm), np.asarray(ref.perm))
+    assert float(jnp.max(fab.abft_err)) < 1e-2  # healthy run: noise only
+    # And the resolver itself: an ABFT carry always rejects the fused form.
+    assert blocked._use_fused("fused", 2048, 128, 2048, carried=True) is False
+    assert blocked._use_fused("auto", 2048, 128, 2048, carried=True) is False
+
+
+def test_use_fused_routing(monkeypatch):
+    """The selection contract: TPU-only in auto mode, VMEM-gated, explicit
+    'fused' forces (with the clear sizing error past the budget on real
+    TPUs), zero_pivot_safe and narrow trailing always fall back."""
+    # CPU auto never selects the fused kernel (the plain CPU path is
+    # measured without interpret-mode kernels).
+    assert blocked._use_fused("auto", 2048, 128, 2048) is False
+    # Explicit request runs anywhere (interpret mode off-TPU).
+    assert blocked._use_fused("fused", 96, 16, 96) is True
+    assert blocked._use_fused("jax", 2048, 128, 2048) is False
+    assert blocked._use_fused("pallas", 2048, 128, 2048) is False
+    assert blocked._use_fused("fused", 96, 16, 96,
+                              zero_pivot_safe=True) is False
+    assert blocked._use_fused("fused", 96, 16, 16) is False  # no trailing
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert blocked._use_fused("auto", 2048, 128, 2048) is True
+    assert blocked._use_fused("auto", 2048, 256, 2048) is True
+    # Past the budget: auto falls back, explicit raises the sizing error.
+    monkeypatch.setattr(blocked, "PANEL_VMEM_BUDGET", 1_000_000)
+    assert blocked._use_fused("auto", 2048, 128, 2048) is False
+    with pytest.raises(ValueError, match="fused working set"):
+        blocked._use_fused("fused", 2048, 128, 2048)
+
+
+def test_fused_tiles_consult_tuned_store(monkeypatch):
+    """The tile/segment axes resolve through tune.apply (op panel_fused)
+    — the PR-7 single-source rule: sweep winners override the seeds."""
+    from gauss_tpu.tune import apply as tapply
+    from gauss_tpu.tune import space as tspace
+
+    seen = []
+
+    def fake_override(op, n, name, dtype="float32", engine="blocked"):
+        seen.append((op, name))
+        return {"ct": 32, "seg": 8, "fseg": 4}.get(name)
+
+    monkeypatch.setattr(tapply, "override", fake_override)
+    ct, seg, fseg = pf._resolve_tiles(96, 96, 16, jnp.float32, None, None,
+                                      None)
+    assert (ct, seg, fseg) == (32, 8, 4)
+    assert ("panel_fused", "ct") in seen
+    # Explicit values are honored verbatim, no consult.
+    seen.clear()
+    ct, seg, fseg = pf._resolve_tiles(96, 96, 16, jnp.float32, 16, 8, 8)
+    assert (ct, seg, fseg) == (16, 8, 8) and not seen
+    # The axes are declared in the swept space with the shipped seeds.
+    names = {ax.name: ax.seed for ax in tspace.space_for("panel_fused")}
+    assert names["ct"] == tspace.FUSED_CT_SEED
+    assert names["fseg"] == tspace.FUSED_FSEG_SEED
+    assert names["seg"] == tspace.PANEL_SEG_SEED
+
+
+def _jaxpr_primitives(jaxpr, acc):
+    for eqn in jaxpr.eqns:
+        acc.add(eqn.primitive.name)
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                _jaxpr_primitives(v.jaxpr, acc)
+            elif isinstance(v, (list, tuple)):
+                for w in v:
+                    if hasattr(w, "jaxpr"):
+                        _jaxpr_primitives(w.jaxpr, acc)
+    return acc
+
+
+@pytest.mark.parametrize("unroll", ["auto", True, False, "chunked"])
+def test_plain_path_jaxpr_free_of_hook_callsites(rng, unroll):
+    """resolve_factor's fast-path contract: with no checkpoint path and no
+    ABFT carry, the selected factorization traces to a jaxpr with NO host
+    callsites — no io_callback/pure_callback/debug primitives anywhere.
+    Hooks cost nothing unless enabled."""
+    a, _ = _system(rng, 64)
+    factor = blocked.resolve_factor(64, unroll)
+    jaxpr = jax.make_jaxpr(lambda x: factor(x, panel=16))(jnp.asarray(a))
+    prims = _jaxpr_primitives(jaxpr.jaxpr, set())
+    forbidden = {p for p in prims
+                 if "callback" in p or p.startswith("debug_")}
+    assert not forbidden, f"hook callsites on the plain path: {forbidden}"
+
+
+def test_resolve_factor_fastpath_routing(tmp_path):
+    """The extended resolve_factor contract: checkpoint_path routes to the
+    (only) host-stepped form, abft to the checksum-carrying single
+    program, and the two refuse to combine."""
+    from functools import partial as _p
+
+    from gauss_tpu.resilience.checkpoint import \
+        lu_factor_blocked_chunked_checkpointed
+
+    f = blocked.resolve_factor(256, "auto",
+                               checkpoint_path=str(tmp_path / "c.npz"))
+    assert isinstance(f, _p)
+    assert f.func is lu_factor_blocked_chunked_checkpointed
+    f = blocked.resolve_factor(256, "auto", abft=True)
+    assert isinstance(f, _p) and f.keywords.get("abft") is True
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        blocked.resolve_factor(256, "auto", checkpoint_path="x", abft=True)
+
+
+def test_donation_marked_in_lowering_and_honored(rng):
+    """Donation asserted two ways: the lowered module carries the
+    input-output alias attribute, and on a backend that honors donation
+    (CPU, jax >= 0.4.x) the donated operand buffer is actually consumed.
+    The undonated twin leaves its operand alive."""
+    a, _ = _system(rng, 64)
+    low = blocked.lu_factor_blocked_donating.lower(jnp.asarray(a), panel=16)
+    assert "tf.aliasing_output" in low.as_text()
+    # And in the compiled executable: the input/output alias survives to
+    # the backend (jax.jit(...).lower(...).compile() inspection).
+    compiled = low.compile()
+    assert any("alias" in t.lower() for t in compiled.as_text().split("\n")
+               if "input_output" in t.lower() or "alias" in t.lower())
+    op = jnp.asarray(a)
+    blocked.lu_factor_blocked_donating(op, panel=16)
+    assert op.is_deleted()
+    op2 = jnp.asarray(a)
+    blocked.lu_factor_blocked(op2, panel=16)
+    assert not op2.is_deleted()
+
+
+def test_refine_ds_donates_x0(rng):
+    """The ds-refine loop donates its solution seed (the fresh initial
+    solve every call site passes)."""
+    from gauss_tpu.core import dsfloat
+
+    a, b = _system(rng, 64)
+    a64 = np.asarray(a, np.float64)
+    fac = blocked.lu_factor_blocked(jnp.asarray(a), panel=16)
+    b_ds = dsfloat.to_ds(np.asarray(b, np.float64))
+    x0 = blocked.lu_solve(fac, b_ds.hi)
+    x = dsfloat.refine_ds(fac, dsfloat.to_ds(a64.T), b_ds, x0, iters=2)
+    assert x0.is_deleted()
+    x64 = dsfloat.ds_to_f64(x)
+    assert checks.residual_norm(a64, x64, np.asarray(b, np.float64)) < 1e-4
+
+
+def test_serve_executables_donate(rng):
+    """The serve cache's factor/solve lanes donate their freshly-staged
+    operand stacks (matrix stack on factor, RHS stack on solve) and still
+    refine through the retained factors."""
+    from gauss_tpu.serve.cache import BatchedExecutable, CacheKey
+
+    key = CacheKey(bucket_n=32, nrhs=1, batch=2, dtype="float32",
+                   engine="blocked", refine_steps=1)
+    exe = BatchedExecutable(key)
+    a = np.stack([_system(rng, 32)[0].astype(np.float64)
+                  for _ in range(2)])
+    b = rng.standard_normal((2, 32, 1))
+    x = exe.solve(a, b)
+    r = np.linalg.norm(np.einsum("bij,bjk->bik", a, x) - b)
+    assert r < 1e-4
+    # The solve lane's lowering carries the donation alias at every
+    # bucket; the factor lane donates only at panel-multiple buckets
+    # (a padded donation would be unusable).
+    fac = exe._factor(a.astype(np.float32))
+    low = exe._solve.lower(fac, b.astype(np.float32))
+    assert "tf.aliasing_output" in low.as_text()
+
+
+def test_checkpoint_group_step_donates(rng, tmp_path):
+    """The host-stepped checkpoint route donates its per-group carry (the
+    copy-per-step kill) and stays bit-identical to the one-shot chunked
+    program — kill/resume semantics untouched (tier-1 resilience tests
+    cover the kill path)."""
+    from gauss_tpu.resilience import checkpoint as ckpt
+
+    a, _ = _system(rng, 96)
+    f1 = ckpt.lu_factor_blocked_chunked_checkpointed(
+        a, str(tmp_path / "ck.npz"), panel=16, chunk=2)
+    f2 = blocked.lu_factor_blocked_chunked(jnp.asarray(a), panel=16,
+                                           chunk=2)
+    for fld in ("m", "perm", "min_abs_pivot", "linv", "uinv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f1, fld)),
+                                      np.asarray(getattr(f2, fld)))
+
+
+def test_doctor_forbidden_phase_gate():
+    """The CI gate: host_group_step/hook_sync present in the candidate
+    stream exits 1; a clean candidate exits 0."""
+    from gauss_tpu.obs import doctor
+
+    r3 = os.path.join(REPO, "reports", "doctor_r3like.jsonl")
+    r5 = os.path.join(REPO, "reports", "doctor_r5like.jsonl")
+    assert doctor.main([r3, r5, "--forbid", "host_group_step,hook_sync",
+                        "--json"]) == 1
+    assert doctor.main([r3, r3, "--forbid", "host_group_step,hook_sync",
+                        "--json"]) == 0
+    # The matcher also catches dotted descendants.
+    diff = {"phases": [{"phase": "host_group_step.factor", "b_calls": 3,
+                        "b_s": 0.1}]}
+    assert doctor.forbidden_phases(diff, ["host_group_step"])
+
+
+def test_ratchet_tightened_ceiling():
+    """The reclaimed record's tightened per-metric ceiling: an r5-class
+    1.4-1.5x 'hooks tax' regression now FAILS the ratchet instead of
+    hiding under the generic 1.5x epoch envelope; the refined metric is
+    ratcheted too."""
+    from gauss_tpu.obs import regress
+
+    best = regress.RATCHET_BASELINES["gauss_n2048_wallclock"]
+    assert regress.RATCHET_CEILINGS["gauss_n2048_wallclock"] < \
+        regress.RATCHET_MAX_RATIO
+    bad = regress.evaluate_ratchet("gauss_n2048_wallclock", best * 1.45)
+    assert bad["status"] == "out-of-band"
+    ok = regress.evaluate_ratchet("gauss_n2048_wallclock", best * 1.3)
+    assert ok["status"] == "ok"
+    refined = regress.evaluate_ratchet(
+        "gauss_n2048_wallclock:refined",
+        regress.RATCHET_BASELINES["gauss_n2048_wallclock:refined"] * 1.2)
+    assert refined["status"] == "ok"
+
+
+def test_regress_check_ratchet_flag():
+    """`regress check --ratchet` applies the ratchet gate in CI: the
+    committed record round passes; a synthetic slow record fails."""
+    import json
+
+    from gauss_tpu.obs import regress
+
+    hist = os.path.join(REPO, "reports", "history.jsonl")
+    r03 = os.path.join(REPO, "BENCH_r03.json")
+    assert regress.main(["check", r03, "--ratchet", "--history", hist]) == 0
+    slow = os.path.join(REPO, "reports", "doctor_r3like.jsonl")  # unused
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump({"parsed": {"metric": "gauss_n2048_wallclock",
+                              "value": 0.00225, "unit": "s"}}, f)
+        bad_path = f.name
+    try:
+        # 2.25 ms is inside the median band (the r5 norm) but past the
+        # tightened 1.35x ratchet ceiling — exactly the regression shape
+        # the reclaim forbids from ever becoming normal again.
+        assert regress.main(["check", bad_path, "--ratchet",
+                             "--history", hist]) == 1
+        assert regress.main(["check", bad_path, "--history", hist]) == 0
+    finally:
+        os.unlink(bad_path)
+
+
+def test_reclaim_epochs_in_history():
+    """The reclaim run's measured CPU-proxy epochs are committed history
+    (regress-ingestable) and sit at or below the PR-6 post-guard mark."""
+    from gauss_tpu.obs import regress
+
+    hist = regress.load_history(
+        os.path.join(REPO, "reports", "history.jsonl"))
+    vals = [r["value"] for r in hist
+            if r["metric"] == "reclaim:gauss_n2048_cpu_plain_s_per_solve"]
+    assert len(vals) >= 3
+    assert min(vals) <= 1.3749
+
+
+def test_bench_provenance_helpers():
+    """bench.py's fused/donated provenance fields reflect the actual
+    routing primitives (False/True on CPU respectively at the headline
+    shape)."""
+    assert blocked._use_fused("auto", 2048, 256, 2048) is False  # CPU
+    assert 2048 % 256 == 0  # the donated condition at the headline shape
