@@ -14,6 +14,16 @@ ABFT_SMOKE ?= /tmp/gauss_abft_check
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
 	structure-check tune-check live-check abft-check clean
 
+# The timing-gated gates (obs/serve/structure/tune/faults/live/abft-check)
+# are regress-gated through obs.regress noise bands calibrated on an
+# UNCONTENDED box: running them concurrently — with each other, or with
+# the test suite — pushes s_per_case / s_per_solve out of band and fails
+# gates on scheduler contention, not code (documented on this box; the
+# ISSUE-11 ordering note). .NOTPARALLEL keeps `make -j obs-check
+# serve-check ...` serial within one make invocation; don't run several
+# make processes against these targets at once either.
+.NOTPARALLEL:
+
 all: native
 
 native:
@@ -36,12 +46,19 @@ bench:
 # span stream diffed against the committed best-prior epoch — the
 # host_group_step / hook_sync leaves that absorbed 93% of the r3->r5
 # regression (reports/doctor_r3_vs_r5.json) must NOT reappear on the
-# plain (hooks-off) path.
+# plain (hooks-off) path. The throughput leg (ISSUE 11) runs a fresh
+# batched solves/sec epoch at the smallest record size and gates it
+# against the 3 committed epochs in reports/history.jsonl AND the
+# throughput ratchet (RATCHET_BASELINES/RATCHET_CEILINGS) — both records,
+# latency and throughput, are regress-gated from PR 11 on. Best-of-reps
+# timing: only a systematic slowdown fails, not one noisy rep.
 obs-check:
 	$(PYTHON) -m gauss_tpu.obs.regress check BENCH_r04.json BENCH_r05.json \
 	  --history reports/history.jsonl
 	$(PYTHON) -m gauss_tpu.obs.regress check BENCH_r03.json --ratchet \
 	  --history reports/history.jsonl
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.bench.throughput --ns 256 \
+	  --batch 8 --reps 2 --seed 258458 --regress-check
 	rm -f $(OBS_SMOKE)
 	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.cli.gauss_internal -s 64 -t 2 \
 	  --backend tpu-unblocked --verify --metrics-out $(OBS_SMOKE)

@@ -192,6 +192,16 @@ def _bench(rec, tuned: bool = False, tune_compare: bool = False) -> None:
         "fused": bool(_blocked._use_fused("auto", N, panel,
                                           -(-N // panel) * panel)),
         "donated": bool(N % panel == 0),
+        # ISSUE-11 provenance: the measured configuration's precision
+        # axis, next to the PR-10 routing fields — the headline chain is
+        # f32 storage with NO refinement (the internal system is exact in
+        # one f32 solve), the refined leg runs DS_REFINE_STEPS
+        # double-single rounds; mixed-precision epochs (the lowered path,
+        # bench.throughput --dtype, grid --dtype cells) carry their own
+        # dtype so history rows never mix precision classes silently.
+        "dtype": "float32",
+        "refine_steps": 0,
+        "refined_steps": dsfloat.DS_REFINE_STEPS,
     }
     if compare is not None:
         record["tune_compare"] = compare

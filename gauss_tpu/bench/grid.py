@@ -53,6 +53,11 @@ DIST_NOTE = "virtual CPU mesh (scaling shape + correctness; NOT ICI)"
 # verification, not per-op speed (the note says which device ran).
 DIST_DEVICE = "cpu"
 
+#: --dtype: the gauss device-span cells' storage dtype (ISSUE 11 — the
+#: lowered bf16/bf16x3 paths refined back to the 1e-4 bar). Module-global
+#: like DIST_DEVICE; "float32" is the pre-existing path exactly.
+GRID_DTYPE = "float32"
+
 
 @dataclass
 class Cell:
@@ -65,6 +70,12 @@ class Cell:
     reference_s: Optional[float]
     span: str = "reference"   # "reference" parity span or "device" slope span
     note: str = ""            # provenance, e.g. external dataset source
+    #: storage dtype of the timed configuration (the --dtype column):
+    #: rides into the JSON cells, the obs ``cell`` events, and the
+    #: history metric name (obs.regress._cell_metric appends "@<dtype>"
+    #: for lowered cells), so mixed-precision epochs are distinguishable
+    #: in history.jsonl and can never pollute an f32 baseline.
+    dtype: str = "float32"
 
     @property
     def speedup(self) -> Optional[float]:
@@ -125,12 +136,19 @@ def _gauss_device_cell(a64, b64, refine_steps: int, backend: str = "tpu"):
 
 
 def _gauss_device_cell_ds(a64, b64, refine_steps: int | None = None,
-                          gemm_precision: str = "highest"):
+                          gemm_precision: str = "highest",
+                          factor_dtype: str | None = None):
     """Device-span external cell: f32 factor + double-single on-device
     refinement (core.dsfloat), slope-timed; returns
     (seconds, x_float64, (k_small, k_large, is_slope)) of exactly the timed
     configuration. The single measurement recipe shared with
-    bench.precision — the K policy must not fork."""
+    bench.precision — the K policy must not fork.
+
+    ``factor_dtype``: the --dtype column — a lowered storage name
+    ("bfloat16" / "bf16x3", core.lowered) threads through the SAME timed
+    chain (dsfloat.solve_once_ds casts the factor operand / swaps the
+    split-GEMM), so a lowered cell is measured and verified under the
+    identical slope protocol as the f32 ones."""
     import jax.numpy as jnp
 
     from gauss_tpu.bench import slope
@@ -147,10 +165,12 @@ def _gauss_device_cell_ds(a64, b64, refine_steps: int | None = None,
     panel = auto_panel(n)
     x = dsfloat.ds_to_f64(
         slope.gauss_solve_once_ds(a, at_ds, b_ds, panel, refine_steps,
-                                  gemm_precision=gemm_precision))
+                                  gemm_precision=gemm_precision,
+                                  factor_dtype=factor_dtype))
     make_chain, args = slope.ds_solver_chain(a, at_ds, b_ds, panel,
                                              refine_steps,
-                                             gemm_precision=gemm_precision)
+                                             gemm_precision=gemm_precision,
+                                             factor_dtype=factor_dtype)
     # Very large systems: per-solve seconds dwarf the jitter floor, so a
     # K=(1,2) pair keeps full slope validity while holding the chain's
     # compile payload and run count down (the memplus lesson, r2 -> r3).
@@ -203,6 +223,21 @@ def _run_gauss_internal(ctx, n: int, backend: str, nthreads: int,
         _no_device_span_notice("gauss-internal", n, backend,
                                "no device-span implementation")
     if span == "device" and backend in DEVICE_SPAN_GAUSS:
+        if GRID_DTYPE != "float32" and backend == "tpu":
+            # The --dtype column: the lowered factor (bf16 storage /
+            # bf16x3 split-GEMM) is NOT exact on the internal system the
+            # way f32 is, so the timed chain includes the double-single
+            # refinement that brings it back to the bar — the honest
+            # price of the lowered configuration, slope-timed and
+            # verified as one unit.
+            seconds, x_dev, _ = _gauss_device_cell_ds(
+                a, b, factor_dtype=GRID_DTYPE)
+            res_dev = checks.residual_norm(a, x_dev, b)
+            return Cell("gauss-internal", str(n), backend, seconds,
+                        res_dev < RESIDUAL_BAR, res_dev,
+                        baselines.reference_seconds("gauss-internal", n,
+                                                    backend),
+                        span="device", dtype=GRID_DTYPE)
         # The internal system solves exactly in one f32 factor+solve
         # (measured residual 0.0 at every reference size), so the timed
         # chain runs no refinement — and is verified as-is. The
@@ -265,13 +300,14 @@ def _run_gauss_external(ctx, name: str, backend: str, nthreads: int,
         # (VERDICT round 1 weak #2). The timed chain includes the refinement
         # steps, and the cell verifies that exact configuration — no
         # reference-span solve runs.
-        seconds, x_dev, _ = _gauss_device_cell_ds(a, b)
+        fdt = None if GRID_DTYPE == "float32" else GRID_DTYPE
+        seconds, x_dev, _ = _gauss_device_cell_ds(a, b, factor_dtype=fdt)
         err_dev = checks.max_rel_error(x_dev, x_true)
         return Cell("gauss-external", name, backend, seconds,
                     err_dev < RESIDUAL_BAR, err_dev,
                     baselines.reference_seconds("gauss-external", name,
                                                 backend), span="device",
-                    note=note)
+                    note=note, dtype=GRID_DTYPE)
     # The external flavor's policy is partial pivoting
     # (gauss_external_input.c:125-150) on EVERY backend — without the
     # explicit argument, resolve_pivoting would hand tpu-unblocked the
@@ -659,7 +695,7 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                 obs.emit("cell", suite=cell.suite, key=cell.key,
                          backend=cell.backend, seconds=cell.seconds,
                          verified=cell.verified, span=cell.span,
-                         note=cell.note)
+                         note=cell.note, dtype=cell.dtype)
                 cells.append(cell)
     return cells
 
@@ -745,6 +781,18 @@ def main(argv=None) -> int:
                         "(tunnel dispatch dominates here); 'device' measures "
                         "per-op seconds by the K-chain slope method with "
                         "operands device-resident (bench.slope)")
+    p.add_argument("--dtype", choices=("float32", "bfloat16", "bf16x3"),
+                   default="float32",
+                   help="storage dtype for the gauss device-span tpu cells "
+                        "(the mixed-precision column, core.lowered): "
+                        "lowered cells run the SAME slope protocol with "
+                        "the double-single refinement that brings the "
+                        "lowered factor back to the 1e-4 bar included in "
+                        "the timed chain; cells are stamped with the "
+                        "dtype (JSON + obs events) and enter history as "
+                        "distinct '...@<dtype>' metrics, so "
+                        "mixed-precision epochs never pollute an f32 "
+                        "baseline (requires --span device)")
     p.add_argument("--json", dest="json_path", default=None,
                    help="also write cells as a JSON array to this path")
     p.add_argument("--metrics-out", metavar="PATH", default=None,
@@ -774,8 +822,12 @@ def main(argv=None) -> int:
                         "real TPU, pass -t 1 to prove the shard_map "
                         "programs lower and run on actual hardware")
     args = p.parse_args(argv)
-    global DIST_DEVICE
+    global DIST_DEVICE, GRID_DTYPE
     DIST_DEVICE = args.dist_device
+    if args.dtype != "float32" and args.span != "device":
+        p.error("--dtype lowers the gauss device-span tpu cells; add "
+                "--span device (the reference span has no lowered path)")
+    GRID_DTYPE = args.dtype
 
     if args.keys and args.suite == "all":
         p.error("--keys requires a single --suite (sizes and dataset names "
@@ -853,7 +905,7 @@ def main(argv=None) -> int:
         verdicts = [
             regress.evaluate(regress._cell_metric(
                 {"suite": c.suite, "key": c.key, "backend": c.backend,
-                 "span": c.span}), c.seconds, history)
+                 "span": c.span, "dtype": c.dtype}), c.seconds, history)
             for c in all_cells if c.verified]
         print(regress.format_verdicts(verdicts))
         if any(v["status"] == "out-of-band" for v in verdicts):

@@ -113,22 +113,27 @@ def gauss_solve_once(a, b, panel: int, refine_steps: int = 0,
 
 
 def gauss_solve_once_ds(a, at_ds, b_ds, panel: int, refine_steps: int,
-                        unroll="auto", gemm_precision: str = "highest"):
-    """One f32 factor + solve + double-single on-device refinement — the
+                        unroll="auto", gemm_precision: str = "highest",
+                        factor_dtype: "str | None" = None):
+    """One factor + solve + double-single on-device refinement — the
     external-suite device-span configuration (VERDICT round 1 #3: the f32
     refinement floor failed memplus; double-single residuals clear the 1e-4
     bar fully on device). Thin timing-chain wrapper over the single
-    assembly point, core.dsfloat.solve_once_ds."""
+    assembly point, core.dsfloat.solve_once_ds. ``factor_dtype``: the
+    lowered storage axis (bfloat16 / bf16x3 — the grid --dtype column);
+    None is the f32 path, unchanged."""
     from gauss_tpu.core import dsfloat
 
     x, _ = dsfloat.solve_once_ds(a, at_ds, b_ds, panel, iters=refine_steps,
                                  unroll=unroll,
-                                 gemm_precision=gemm_precision)
+                                 gemm_precision=gemm_precision,
+                                 factor_dtype=factor_dtype)
     return x
 
 
 def ds_solver_chain(a, at_ds, b_ds, panel: int, refine_steps: int,
-                    unroll="auto", gemm_precision: str = "highest"
+                    unroll="auto", gemm_precision: str = "highest",
+                    factor_dtype: "str | None" = None
                     ) -> Tuple[Callable[[int], Callable], tuple]:
     """Chain factory for the ds-refined solve. The factor operand is
     perturbed per iteration (defeats CSE); the residual operands stay fixed,
@@ -148,7 +153,8 @@ def ds_solver_chain(a, at_ds, b_ds, panel: int, refine_steps: int,
                 a_i = a_ + xc[0] * jnp.asarray(PERTURB, a_.dtype)
                 x = gauss_solve_once_ds(a_i, DS(at_hi, at_lo),
                                         DS(b_hi, b_lo), panel, refine_steps,
-                                        unroll, gemm_precision)
+                                        unroll, gemm_precision,
+                                        factor_dtype)
                 return x.hi + x.lo
 
             x = lax.fori_loop(0, k, body, x0)

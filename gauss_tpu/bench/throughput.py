@@ -1,0 +1,244 @@
+"""Batched solves-per-second — the THROUGHPUT record (ISSUE 11).
+
+The latency record (``bench.py``) measures ONE solve as fast as the chip
+can run it; the serving fleet's economics are the other axis — how many
+independent systems per second one chip sustains when they arrive as
+batches. This leg measures exactly that, on exactly the machinery that
+serves them: a ``vmap``-batched blocked factor+solve executable from the
+serve :class:`~gauss_tpu.serve.cache.ExecutableCache` (the MAGMA-batched
+execution shape, host-f64 refinement rounds included — the number a
+capacity planner can divide traffic by), at n ∈ {256, 1024, 2048}.
+
+Protocol: the executable is built (and compiled) through the cache —
+compile lands in the build span, never the timed window — then one
+untimed warm dispatch, then ``reps`` timed dispatches of the SAME seeded
+batch with the best-of taken (noise only ever adds time; the tuner's
+discipline). Every member solution is verified at the 1e-4 relative
+gate; a leg with ANY unverified member reports ``verified: false`` and
+is excluded from history — a fast wrong answer must never become a
+baseline.
+
+Records enter ``reports/history.jsonl`` as
+``tput:<dtype>/n<N>/b<B>/s_per_solve`` (throughput inverted, so the
+regression sentinel's slow-side gate applies) and ratchet via
+``obs.regress.RATCHET_BASELINES`` / ``RATCHET_CEILINGS`` exactly like
+the latency record — from this PR on, BOTH records are regress-gated.
+The ``--dtype`` axis runs the same protocol over the lowered executables
+(``bfloat16`` / ``bf16x3`` — core.lowered), making the mixed-precision
+throughput claim a measured, gated artifact rather than a datasheet
+multiplication.
+
+CLI (one epoch per invocation; commit 3 seeded epochs for a baseline)::
+
+    JAX_PLATFORMS=cpu python -m gauss_tpu.bench.throughput \
+        --ns 256,1024,2048 --batch 8 --history --regress-check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_NS = (256, 1024, 2048)
+DEFAULT_BATCH = 8
+DEFAULT_REPS = 3
+VERIFY_GATE = 1e-4
+
+
+def _batch_systems(n: int, batch: int, seed: int,
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """A deterministic batch of DISTINCT diagonally-dominant systems
+    (one seeded generator per member — a batch of copies would let a
+    pathological cache effect flatter the number)."""
+    a = np.empty((batch, n, n), dtype=np.float64)
+    b = np.empty((batch, n, 1), dtype=np.float64)
+    for i in range(batch):
+        rng = np.random.default_rng(seed + 7919 * i + n)
+        a[i] = rng.standard_normal((n, n))
+        a[i, np.arange(n), np.arange(n)] += float(n)
+        b[i] = rng.standard_normal((n, 1))
+    return a, b
+
+
+def measure_throughput(ns: Sequence[int] = DEFAULT_NS,
+                       batch: int = DEFAULT_BATCH,
+                       dtype: str = "float32", refine_steps: int = 1,
+                       reps: int = DEFAULT_REPS, seed: int = 258458,
+                       run_id: Optional[str] = None) -> Dict:
+    """Run the batched-throughput legs; returns the ``throughput_bench``
+    summary (regress-ingestable)."""
+    from gauss_tpu import obs
+    from gauss_tpu.serve.cache import CacheKey, ExecutableCache
+    from gauss_tpu.verify import checks
+
+    cache = ExecutableCache(capacity=max(8, len(ns)))
+    legs: List[Dict] = []
+    for n in ns:
+        key = CacheKey(bucket_n=int(n), nrhs=1, batch=int(batch),
+                       dtype=dtype, engine="blocked",
+                       refine_steps=int(refine_steps))
+        with obs.span("tput_build", n=int(n), batch=int(batch),
+                      dtype=dtype):
+            exe = cache.get(key)  # compile inside the build span
+        a, b = _batch_systems(int(n), int(batch), seed)
+        x = exe.solve(a, b)  # warm dispatch, untimed
+        rel_max = max(
+            checks.residual_norm(a[i], x[i], b[i], relative=True)
+            for i in range(int(batch)))
+        times = []
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            exe.solve(a, b)
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        leg = {
+            "n": int(n), "batch": int(batch), "dtype": dtype,
+            "refine_steps": int(refine_steps), "reps": int(reps),
+            "batch_s": round(best, 6),
+            "s_per_solve": round(best / batch, 6),
+            "solves_per_s": round(batch / best, 4),
+            "rel_residual_max": float(f"{rel_max:.3e}"),
+            "verified": bool(rel_max <= VERIFY_GATE),
+        }
+        obs.emit("tput_leg", **leg)
+        obs.gauge(f"tput.n{n}.solves_per_s", leg["solves_per_s"])
+        legs.append(leg)
+    return {"kind": "throughput_bench", "ns": [int(n) for n in ns],
+            "batch": int(batch), "dtype": dtype,
+            "refine_steps": int(refine_steps), "reps": int(reps),
+            "seed": int(seed), "legs": legs, "run_id": run_id,
+            "verify_gate": VERIFY_GATE}
+
+
+def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
+    """The (metric, value, unit) records a throughput summary contributes
+    to the regression history — VERIFIED legs only, throughput inverted
+    to seconds-per-solve so the sentinel (and the ratchet) gate the slow
+    side. Metric names carry dtype, n, AND batch: a batch-4 epoch must
+    never pollute a batch-8 baseline."""
+    out = []
+    for leg in summary.get("legs", []):
+        if not leg.get("verified"):
+            continue
+        v = leg.get("s_per_solve")
+        if isinstance(v, (int, float)) and v > 0:
+            out.append((f"tput:{leg['dtype']}/n{leg['n']}/b{leg['batch']}"
+                        f"/s_per_solve", v, "s"))
+    return out
+
+
+def format_summary(summary: Dict) -> str:
+    lines = [f"throughput bench [{summary['dtype']}] batch="
+             f"{summary['batch']} refine_steps={summary['refine_steps']} "
+             f"(best of {summary['reps']})"]
+    for leg in summary["legs"]:
+        state = ("ok" if leg["verified"]
+                 else f"UNVERIFIED (rel {leg['rel_residual_max']:.1e})")
+        lines.append(
+            f"  n={leg['n']:5d}: {leg['solves_per_s']:10.2f} solves/s "
+            f"({leg['s_per_solve'] * 1e3:.3f} ms/solve, batch "
+            f"{leg['batch_s']:.4f} s) [{state}]")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.bench.throughput",
+        description="Batched solves/sec record through the serve "
+                    "executables; regress- and ratchet-gated like the "
+                    "latency headline.")
+    p.add_argument("--ns", default=",".join(str(n) for n in DEFAULT_NS),
+                   help=f"comma-separated sizes (default "
+                        f"{','.join(str(n) for n in DEFAULT_NS)})")
+    p.add_argument("--batch", type=int, default=DEFAULT_BATCH,
+                   help=f"systems per dispatch (default {DEFAULT_BATCH})")
+    p.add_argument("--dtype", choices=("float32", "bfloat16", "bf16x3"),
+                   default="float32",
+                   help="executable storage dtype (the lowered lanes; "
+                        "default float32)")
+    p.add_argument("--refine-steps", type=int, default=1,
+                   help="host-f64 refinement rounds per dispatch "
+                        "(default 1 — the serve default)")
+    p.add_argument("--reps", type=int, default=DEFAULT_REPS,
+                   help=f"timed dispatches, best-of (default "
+                        f"{DEFAULT_REPS})")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--metrics-out", default=None, metavar="PATH")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the summary (regress-ingestable: "
+                        "kind=throughput_bench)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append verified s_per_solve records to the "
+                        "regression history (default "
+                        "reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate this run against the history baselines AND "
+                        "the committed throughput ratchet "
+                        "(RATCHET_BASELINES/RATCHET_CEILINGS; exit 1 "
+                        "when out of band)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from gauss_tpu.utils.env import honor_jax_platforms
+
+    honor_jax_platforms()
+    from gauss_tpu import obs
+
+    ns = [int(n) for n in args.ns.split(",") if n]
+    with obs.run(metrics_out=args.metrics_out, tool="gauss_tput",
+                 ns=args.ns, batch=args.batch, dtype=args.dtype) as rec:
+        summary = measure_throughput(ns, batch=args.batch,
+                                     dtype=args.dtype,
+                                     refine_steps=args.refine_steps,
+                                     reps=args.reps, seed=args.seed,
+                                     run_id=rec.run_id)
+    print(format_summary(summary))
+
+    if args.summary_json:
+        parent = os.path.dirname(args.summary_json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.summary_json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    if any(not leg["verified"] for leg in summary["legs"]):
+        print("throughput: UNVERIFIED leg(s) — excluded from history",
+              file=sys.stderr)
+        rc = 2
+    from gauss_tpu.obs import regress
+
+    records = [{"metric": m, "value": v, "unit": u,
+                "source": f"tput:{summary.get('run_id')}", "kind": "tput"}
+               for m, v, u in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(
+            records, regress.load_history(history_path))
+        for r in records:
+            rv = regress.evaluate_ratchet(r["metric"], r["value"])
+            if rv is not None:
+                verdicts.append(rv)
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = max(rc, 1)
+    if args.history is not None and records and rc == 0:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

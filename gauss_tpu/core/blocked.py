@@ -244,6 +244,79 @@ class BlockedLU(NamedTuple):
     abft_err: jax.Array | None = None
 
 
+# --- The mixed-precision contract (ISSUE 11) ------------------------------
+#
+# A factorization may run with LOWERED storage: bfloat16 operands halve
+# itemsize (panel_fits_vmem / fused_fits_vmem admit ~2x the working set,
+# and every HBM stream moves half the bytes), or f32 storage with the
+# explicit bf16x3 split-GEMM trailing update (core.matmul.dot_bf16x3 — the
+# three-bf16-pass middle rung). The contract that keeps lowered factors
+# refinable back to the 1e-4 gate:
+#
+# - **f32 accumulation.** Every trailing-update GEMM on bf16 operands
+#   accumulates in float32 (``preferred_element_type`` — the MXU's native
+#   bf16-in/f32-out mode) and rounds ONCE on store, so products never lose
+#   the exponent range and the factor's error stays at storage rounding
+#   (~2^-8 relative), not accumulated-dot rounding.
+# - **f32 diagonal-block inverses.** linv/uinv are computed and STORED in
+#   the accumulate dtype: they are O(nb * panel^2) — memory-negligible —
+#   and both lu_solve substitutions and the in-factor U12 solves hinge on
+#   them, so bf16 inverses would square the storage error for free.
+# - **f32 solves.** ``lu_solve`` against a lowered factor computes in the
+#   accumulate dtype and returns float32: refinement corrections only need
+#   f32 relative accuracy, and the substitution chain must not re-round
+#   per block.
+#
+# The float32 path is BIT-IDENTICAL to the pre-contract code: the
+# accumulate dtype of f32 is f32, every ``astype`` is an identity, and
+# ``_gdot`` emits the exact pre-existing ``jnp.dot`` (tests/test_fused.py's
+# bit-identity grid still passes unchanged). Refinement back to 1e-4, the
+# demotion ladder, and the tuned (dtype, refine_steps) axis live in
+# gauss_tpu.core.lowered.
+
+_BF16 = jnp.dtype("bfloat16")
+
+
+def accum_dtype(dtype):
+    """The accumulate dtype of the precision contract: bfloat16 storage
+    accumulates (and stores its diagonal-block inverses) in float32;
+    everything else accumulates in itself."""
+    return jnp.float32 if jnp.dtype(dtype) == _BF16 else jnp.dtype(dtype)
+
+
+def _gdot(x, y, prec, dtype):
+    """One trailing-update GEMM under the precision contract. ``prec`` is
+    a resolved ``lax.Precision`` — or the ``core.matmul.BF16X3`` sentinel,
+    which routes to the explicit three-pass split-GEMM (f32 storage).
+    bf16 storage accumulates in f32 and rounds once to ``dtype`` on the
+    way out; the f32 path is the exact pre-existing ``jnp.dot``."""
+    from gauss_tpu.core.matmul import BF16X3, dot_bf16x3
+
+    if prec == BF16X3:
+        return dot_bf16x3(x, y)
+    if jnp.dtype(dtype) == _BF16:
+        return jnp.dot(x, y, precision=prec,
+                       preferred_element_type=jnp.float32).astype(dtype)
+    return jnp.dot(x, y, precision=prec)
+
+
+def _check_lowered_support(dtype, gemm_prec, abft: bool) -> None:
+    """Typed rejection of the unsupported corners: the ABFT checksum
+    rider's tolerances and verification dots are defined against f32
+    HIGHEST math — a bf16 rider would alarm on storage rounding (and a
+    bf16x3 rider would thread the split through checksum dots it was
+    never validated on). The demotion ladder (core.lowered) never builds
+    these combinations; explicit requests get the clear error."""
+    from gauss_tpu.core.matmul import BF16X3
+
+    if abft and (jnp.dtype(dtype) == _BF16 or gemm_prec == BF16X3):
+        raise ValueError(
+            "abft=True requires float32 storage with a lax.Precision gemm "
+            "(the checksum invariant's tolerances are calibrated against "
+            "f32 HIGHEST math); run the lowered dtype without the rider, "
+            "or the rider at float32")
+
+
 TRI_INV_BASE = 64  # base-case size for the recursive triangular inversions
 
 
@@ -292,15 +365,22 @@ def _strict_lower_mask(panel: int):
 
 def _diag_block_linv(d: jax.Array, panel: int, dtype):
     """Inverse of the unit-lower part of one factored diagonal block ``d``
-    (getrf layout: multipliers strictly below, U on/above)."""
-    l11 = jnp.where(_strict_lower_mask(panel), d, jnp.zeros((), dtype))
-    return unit_lower_inv(l11 + jnp.eye(panel, dtype=dtype))
+    (getrf layout: multipliers strictly below, U on/above). Computed and
+    returned in the ACCUMULATE dtype (f32 for bf16 storage — the
+    precision contract above; identity at f32)."""
+    acc = accum_dtype(dtype)
+    d = d.astype(acc)
+    l11 = jnp.where(_strict_lower_mask(panel), d, jnp.zeros((), acc))
+    return unit_lower_inv(l11 + jnp.eye(panel, dtype=acc))
 
 
 def _diag_block_uinv(d: jax.Array, panel: int, dtype):
-    """Inverse of the upper part of one factored diagonal block ``d``."""
+    """Inverse of the upper part of one factored diagonal block ``d``
+    (accumulate dtype, like :func:`_diag_block_linv`)."""
+    acc = accum_dtype(dtype)
+    d = d.astype(acc)
     return upper_inv(jnp.where(~_strict_lower_mask(panel), d,
-                               jnp.zeros((), dtype)))
+                               jnp.zeros((), acc)))
 
 
 def _diag_block_invs(d: jax.Array, panel: int, dtype):
@@ -528,14 +608,16 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype,
     sub = lax.dynamic_update_slice(sub, p, (0, kb))
 
     # Diagonal-block inverses (TRTRI+GEMM): U12 and lu_solve become GEMMs
-    # instead of substitution chains.
+    # instead of substitution chains. Accumulate dtype (f32 for bf16
+    # storage — the precision contract).
     d = lax.dynamic_slice(sub, (kb, kb), (panel, panel))
     linv_k, uinv_k = _diag_block_invs(d, panel, dtype)
 
     # Block row of U: U12 = L11^-1 A12, masked so finished columns
     # (multipliers left of the panel, the panel itself) stay untouched.
+    # _gdot rounds the f32-accumulated solve once back to storage.
     block_row = lax.dynamic_slice(sub, (kb, 0), (panel, w))
-    solved = jnp.dot(linv_k, block_row, precision=gemm_prec)
+    solved = _gdot(linv_k, block_row, gemm_prec, dtype)
     right = cols >= kb + panel
     block_row = jnp.where(right[None, :], solved, block_row)
     sub = lax.dynamic_update_slice(sub, block_row, (kb, 0))
@@ -546,7 +628,7 @@ def _install_and_update(sub, kb, h: int, panel: int, p, gemm_prec, dtype,
                     lax.dynamic_slice(sub, (0, kb), (h, panel)),
                     jnp.zeros((), dtype))
     u12 = jnp.where(right[None, :], block_row, jnp.zeros((), dtype))
-    sub = sub - jnp.dot(l21, u12, precision=gemm_prec)
+    sub = sub - _gdot(l21, u12, gemm_prec, dtype)
     return sub, linv_k, uinv_k
 
 
@@ -688,7 +770,7 @@ def _lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
-    gemm_prec = resolve_precision(gemm_precision)
+    gemm_prec = resolve_precision(gemm_precision, allow_split=True)
     if swap_impl not in ("gather", "loop"):
         raise ValueError(f"unknown swap_impl {swap_impl!r}; options: ('gather', 'loop')")
     a = jnp.asarray(a)
@@ -696,6 +778,7 @@ def _lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
     itemsize = jnp.dtype(a.dtype).itemsize
+    _check_lowered_support(a.dtype, gemm_prec, abft)
     panel = _resolve_panel(n, panel, itemsize)
     if zero_pivot_safe:
         panel_impl = "jax"
@@ -709,6 +792,7 @@ def _lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
     npad = m.shape[0]
     nb = npad // panel
     dtype = m.dtype
+    inv_dt = accum_dtype(dtype)  # linv/uinv storage (precision contract)
 
     def outer_fused(k, carry):
         """The fused step: factor + trailing update in one kernel launch
@@ -811,8 +895,8 @@ def _lu_factor_blocked(a: jax.Array, panel: int | None = DEFAULT_PANEL,
         return m, perm, min_piv, linvs, uinvs
 
     init = (m, jnp.arange(npad), jnp.asarray(jnp.inf, dtype),
-            jnp.zeros((nb, panel, panel), dtype),
-            jnp.zeros((nb, panel, panel), dtype))
+            jnp.zeros((nb, panel, panel), inv_dt),
+            jnp.zeros((nb, panel, panel), inv_dt))
     if abft:
         crow0 = _csum_init(m)
         init = init + (crow0, jnp.zeros((nb,), dtype))
@@ -860,7 +944,7 @@ def _lu_factor_blocked_unrolled(a: jax.Array,
     """
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
 
-    gemm_prec = resolve_precision(gemm_precision)
+    gemm_prec = resolve_precision(gemm_precision, allow_split=True)
     a = jnp.asarray(a)
     n = a.shape[0]
     if a.shape != (n, n):
@@ -926,13 +1010,12 @@ def _lu_factor_blocked_unrolled(a: jax.Array,
         linv = _diag_block_linv(live[:panel, kb:kb + panel], panel, dtype)
         linvs.append(linv)
         if kb + panel < npad:
-            u12 = jnp.dot(linv, live[:panel, kb + panel:],
-                          precision=gemm_prec)
+            u12 = _gdot(linv, live[:panel, kb + panel:], gemm_prec, dtype)
             live = live.at[:panel, kb + panel:].set(u12)
             l21 = live[panel:, kb:kb + panel]
             trail = live[panel:, kb + panel:]
             live = live.at[panel:, kb + panel:].set(
-                trail - jnp.dot(l21, u12, precision=gemm_prec))
+                trail - _gdot(l21, u12, gemm_prec, dtype))
         m = m.at[kb:].set(live)
 
     # Batched U diagonal-block inverses: one vmapped TRTRI over the nb
@@ -1021,18 +1104,24 @@ def lu_solve(factors: BlockedLU, b: jax.Array,
                          "('auto', 'substitution')")
     m, perm = factors.m, factors.perm
     npad = m.shape[0]
-    b = jnp.asarray(b, dtype=m.dtype)
+    # Solves run in the ACCUMULATE dtype (f32 against a bf16 factor, and
+    # returned in it — refinement corrections only need f32 relative
+    # accuracy, and the substitution chain must not re-round per block;
+    # the precision contract at the top of this module). Identity at f32.
+    cdt = accum_dtype(m.dtype)
+    b = jnp.asarray(b, dtype=cdt)
     was_vector = b.ndim == 1
     b2 = b[:, None] if was_vector else b
     if b2.ndim != 2:
         raise ValueError(f"b must be (n,) or (n, k), got {b.shape}")
     n, k = b2.shape
-    bp = jnp.zeros((npad, k), dtype=m.dtype).at[:n].set(b2)[perm]
+    bp = jnp.zeros((npad, k), dtype=cdt).at[:n].set(b2)[perm]
     if factors.linv is None or method == "substitution":
+        ms = m.astype(cdt)
         y = lax.linalg.triangular_solve(
-            m, bp, left_side=True, lower=True, unit_diagonal=True)
+            ms, bp, left_side=True, lower=True, unit_diagonal=True)
         x = lax.linalg.triangular_solve(
-            m, y, left_side=True, lower=False, unit_diagonal=False)
+            ms, y, left_side=True, lower=False, unit_diagonal=False)
         return x[:n, 0] if was_vector else x[:n]
 
     nb, panel, _ = factors.linv.shape
@@ -1110,7 +1199,7 @@ def _lu_factor_blocked_chunked(a: jax.Array,
     """
     from gauss_tpu.core.matmul import resolve_precision
 
-    gemm_prec = resolve_precision(gemm_precision)
+    gemm_prec = resolve_precision(gemm_precision, allow_split=True)
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     a = jnp.asarray(a)
@@ -1118,6 +1207,7 @@ def _lu_factor_blocked_chunked(a: jax.Array,
     if a.shape != (n, n):
         raise ValueError(f"expected square matrix, got {a.shape}")
     itemsize = jnp.dtype(a.dtype).itemsize
+    _check_lowered_support(a.dtype, gemm_prec, abft)
     panel = _resolve_panel(n, panel, itemsize)
     m = _pad_to_panel(a, panel)
     npad = m.shape[0]
@@ -1275,8 +1365,9 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
         return grp, gperm, min_piv, linvs, uinvs
 
     gperm0 = jnp.arange(gh)
-    linvs0 = jnp.zeros((gpanels, panel, panel), dtype)
-    uinvs0 = jnp.zeros((gpanels, panel, panel), dtype)
+    inv_dt = accum_dtype(dtype)  # precision contract: f32 invs at bf16
+    linvs0 = jnp.zeros((gpanels, panel, panel), inv_dt)
+    uinvs0 = jnp.zeros((gpanels, panel, panel), inv_dt)
     grp, gperm, min_piv, linvs, uinvs = lax.fori_loop(
         0, gpanels, body, (grp, gperm0, min_piv, linvs0, uinvs0))
 
@@ -1320,8 +1411,8 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
         def usolve(x, i, grp=grp):
             rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
             r = lax.dynamic_slice(top, (i * panel, 0), (panel, rt))
-            r = r - jnp.dot(rows, x, precision=gemm_prec)
-            xi = jnp.dot(linvs[i], r, precision=gemm_prec)
+            r = r - _gdot(rows, x, gemm_prec, dtype)
+            xi = _gdot(linvs[i], r, gemm_prec, dtype)
             return lax.dynamic_update_slice(x, xi, (i * panel, 0)), i
 
         u12, _ = lax.scan(usolve, jnp.zeros((w, rt), dtype),
@@ -1340,7 +1431,7 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
             # copies, fine while the byte gate holds.
             def a22_full(rows_idx, l21_full):
                 old = m[gs + rows_idx][:, gs + w:]
-                return old - jnp.dot(l21_full, u12, precision=gemm_prec)
+                return old - _gdot(l21_full, u12, gemm_prec, dtype)
 
             fresh = a22_full(gperm[w:], grp[w:])
             # Writes come LAST: gperm[w:] can name original rows < w,
@@ -1360,15 +1451,15 @@ def _factor_group(m, perm, min_piv, g0: int, panel: int, chunk: int,
                 r0 = w + s * sw
                 old = lax.dynamic_slice(m, (gs + r0, gs + w), (sw, rt))
                 l21 = lax.dynamic_slice(grp, (r0, 0), (sw, w))
-                new = old - jnp.dot(l21, u12, precision=gemm_prec)
+                new = old - _gdot(l21, u12, gemm_prec, dtype)
                 return lax.dynamic_update_slice(m, new, (gs + r0, gs + w))
 
             m = lax.fori_loop(0, nfull, strip_body, m)
             tail = (gh - w) - nfull * sw
             if tail:
                 old = m[gs + w + nfull * sw:gs + gh, gs + w:]
-                new = old - jnp.dot(grp[w + nfull * sw:], u12,
-                                    precision=gemm_prec)
+                new = old - _gdot(grp[w + nfull * sw:], u12, gemm_prec,
+                                  dtype)
                 m = m.at[gs + w + nfull * sw:gs + gh, gs + w:].set(new)
 
     if crow is not None:
@@ -1420,7 +1511,7 @@ def lu_factor_blocked_phased(a: jax.Array, panel: int | None = None,
     from gauss_tpu.kernels.matmul_pallas import resolve_precision
     from gauss_tpu.utils.profiling import PhaseTimer
 
-    gemm_prec = resolve_precision(gemm_precision)
+    gemm_prec = resolve_precision(gemm_precision, allow_split=True)
     pt = PhaseTimer() if timer is None else timer
     a = jnp.asarray(a)
     n = a.shape[0]

@@ -261,12 +261,17 @@ def ds_residual(at: DS, x: DS, b: DS) -> DS:
     return ds_add(b, ds_neg(ax))
 
 
-@partial(jax.jit, static_argnames=("iters", "solve_fn"), donate_argnums=(3,))
-def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None) -> DS:
+@partial(jax.jit, static_argnames=("iters", "solve_fn", "tol",
+                                   "return_iters"), donate_argnums=(3,))
+def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None,
+              tol: float = 0.0, return_iters: bool = False):
     """On-device iterative refinement with double-single residuals.
 
-    fac: a :class:`gauss_tpu.core.blocked.BlockedLU` of A (f32) — or any
-    factorization object ``solve_fn`` knows how to solve against.
+    fac: a :class:`gauss_tpu.core.blocked.BlockedLU` of A — f32, or a
+    LOWERED (bfloat16 / bf16x3-updated) factor: the correction solve runs
+    in the factor's accumulate dtype (``blocked.lu_solve`` precision
+    contract), so one refinement implementation serves every storage
+    dtype on the demotion ladder.
     at:  A transposed, double-single (from :func:`to_ds` of the f64 matrix).
     b:   right-hand side, double-single.
     x0:  initial f32 solve ``lu_solve(fac, b.hi)``.
@@ -274,6 +279,17 @@ def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None) -> DS:
     ``blocked.lu_solve``). The structure engines thread their own — e.g.
     ``structure.cholesky.cholesky_solve`` — so every factorization family
     shares ONE double-single refinement implementation.
+
+    ``tol`` (static): when > 0, an iteration whose double-single residual
+    already satisfies ``||r||_2 <= tol * ||b||_2`` applies NO update (the
+    masked form of early exit — the compiled program still runs ``iters``
+    bodies, but a converged carry stops changing and the iteration count
+    stops advancing). ``return_iters=True`` returns ``(x, used)`` with
+    ``used`` the number of iterations that actually updated — the
+    surfaced count the tuner's refine-steps-vs-dtype measurement needs
+    (gauss_tpu.tune, op "lowered"); with the defaults the return value
+    and the traced program are exactly the pre-existing ones, so every
+    existing caller is unchanged.
 
     ``x0``'s buffer is DONATED (it seeds the solution carry and is dead in
     the caller by contract — every call site passes the fresh initial
@@ -289,11 +305,29 @@ def refine_ds(fac, at: DS, b: DS, x0, iters: int = 3, solve_fn=None) -> DS:
         from gauss_tpu.core.blocked import lu_solve as solve_fn
 
     x = ds_from_f32(x0)
+    if tol <= 0.0 and not return_iters:
+        # The pre-existing trace, bit for bit.
+        for _ in range(iters):
+            r = ds_residual(at, x, b)
+            d = solve_fn(fac, r.hi + r.lo)
+            x = ds_add(x, ds_from_f32(d))
+        return x
+
+    thresh = jnp.asarray(tol, jnp.float32) * jnp.sqrt(
+        jnp.sum(jnp.square(b.hi.astype(jnp.float32))))
+    used = jnp.asarray(0, jnp.int32)
+    active = jnp.asarray(True)
     for _ in range(iters):
         r = ds_residual(at, x, b)
-        d = solve_fn(fac, r.hi + r.lo)
-        x = ds_add(x, ds_from_f32(d))
-    return x
+        rc = r.hi + r.lo
+        rnorm = jnp.sqrt(jnp.sum(jnp.square(rc.astype(jnp.float32))))
+        step = active & (rnorm > thresh) if tol > 0.0 else active
+        d = solve_fn(fac, rc)
+        xn = ds_add(x, ds_from_f32(d))
+        x = DS(jnp.where(step, xn.hi, x.hi), jnp.where(step, xn.lo, x.lo))
+        used = used + step.astype(jnp.int32)
+        active = step
+    return (x, used) if return_iters else x
 
 
 # Default refinement step count: enough for the worst-conditioned reference
@@ -305,7 +339,8 @@ DS_REFINE_STEPS = 6
 def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
                   iters: int = DS_REFINE_STEPS, unroll="auto",
                   gemm_precision: str = "highest",
-                  donate: bool = False) -> "tuple[DS, object]":
+                  donate: bool = False,
+                  factor_dtype: "str | None" = None) -> "tuple[DS, object]":
     """One jittable f32 factor + solve + double-single refinement pass.
 
     ``a`` is the f32 matrix (factor operand); ``at_ds``/``b_ds`` the
@@ -322,9 +357,23 @@ def solve_once_ds(a, at_ds: DS, b_ds: DS, panel: int | None,
     :func:`solve_ds` opts in for the operand it stages itself, the bench
     chains (where the call is traced inline and donation is moot) and the
     staged-operand timing paths do not.
+
+    ``factor_dtype``: an optional LOWERED storage name from
+    ``gauss_tpu.core.lowered.LOWERED_DTYPES`` — "bfloat16" casts the
+    factor operand down (the refinement residual operands stay
+    double-single f32), "bf16x3" keeps f32 storage but runs the trailing
+    updates through the explicit split-GEMM. None/"float32" is the
+    pre-existing path, unchanged. This is the timing-chain hook the
+    bench grid's ``--dtype`` column rides (the timed chain IS the
+    verified configuration).
     """
     from gauss_tpu.core import blocked
 
+    if factor_dtype not in (None, "float32"):
+        if factor_dtype == "bf16x3":
+            gemm_precision = "bf16x3"
+        else:
+            a = jnp.asarray(a).astype(jnp.dtype(factor_dtype))
     factor = blocked.resolve_factor(a.shape[0], unroll, donate=donate)
     fac = factor(a, panel=panel, gemm_precision=gemm_precision)
     x0 = blocked.lu_solve(fac, b_ds.hi)

@@ -1,0 +1,219 @@
+"""Lowered-precision factorization refined back to the 1e-4 gate.
+
+The MXU's native low-precision modes are the one substrate PR 10's record
+path did not exploit: bfloat16 halves itemsize — `panel_fits_vmem` /
+`fused_fits_vmem` admit ~2x the working set and every HBM/VMEM stream
+moves half the bytes — and a single bf16 MXU pass runs ~6x the f32
+(HIGHEST) rate on v5e. This module packages that as a SOLVE with the same
+1e-4 guarantee everything else in the repo carries, in the spirit of
+mixed-precision iterative-refinement LU (Haidar et al.'s tensor-core
+solvers) and Ootomo-style bf16x3 emulated-f32 GEMM:
+
+- **The dtype ladder** (:data:`LOWERED_DTYPES`, cheapest first):
+  ``bfloat16`` (bf16 storage, f32-accumulate trailing updates — the
+  precision contract in ``core.blocked``), ``bf16x3`` (f32 storage, the
+  explicit three-bf16-pass split-GEMM trailing update,
+  ``core.matmul.dot_bf16x3`` — for systems whose conditioning makes plain
+  bf16 refinement too slow or divergent), ``float32`` (the pre-existing
+  path, always the terminal rung).
+- **Refinement back to the gate.** Every lowered factor is refined by the
+  EXISTING double-single machinery (``dsfloat.refine_ds`` — residuals in
+  ~2^-47 arithmetic, corrections through the lowered factor's f32-accuracy
+  solves), with the surfaced iteration count as the convergence
+  measurement. A solve that cannot reach the gate at its refine budget
+  raises the typed :class:`PrecisionNotConvergedError`.
+- **Deterministic demotion.** :func:`solve_lowered_auto` walks the ladder
+  from the tuned starting dtype down to float32 — the same demotion shape
+  as structure mistags (``structure.router``): typed failure, next rung,
+  never a silent wrong answer. The (dtype, refine_steps) starting point is
+  a TUNED axis (``tune.space`` op ``"lowered"``): the seed is float32 —
+  zero behavior change without a store — and an offline ``gauss-tune
+  --ops lowered`` sweep records the cheapest converging pair per
+  (n-bucket, device), which ``solve_auto`` and the serve layer then pick
+  up.
+
+Contraction intuition (why the ladder is shaped this way): one refinement
+step contracts the error by ~(factor relative error) x (condition
+number). bf16 storage rounds the factor at ~4e-3, so well-conditioned
+systems converge in 2-3 steps and cond >~ 1e2 systems stall; bf16x3
+updates land at ~1e-5 — roughly ``lax.Precision.HIGH``'s class — covering
+the mid-conditioned band; float32 + double-single remains the backstop
+that clears the reference's worst matrices (saylr4, cond ~1e6).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.verify import checks
+
+#: the demotion ladder, cheapest first; float32 is always the terminal rung.
+LOWERED_DTYPES = ("bfloat16", "bf16x3", "float32")
+
+#: the acceptance bar every rung refines back to (the reference EPSILON).
+DEFAULT_GATE = 1e-4
+
+#: refine_ds stops updating once the DS residual is under
+#: ``gate * margin * ||b||`` — comfortably inside the gate, so the
+#: surfaced iteration count measures convergence TO the contract, not to
+#: the last representable bit.
+REFINE_TOL_MARGIN = 0.1
+
+#: default refinement budget per dtype (trace-time cap; the masked early
+#: exit stops updating — and counting — once converged). bf16's ~4e-3
+#: factor error needs more headroom than bf16x3's ~1e-5; float32 keeps
+#: the dsfloat default that clears saylr4.
+DEFAULT_REFINE_STEPS = {"bfloat16": 8, "bf16x3": 4, "float32": 6}
+
+
+class PrecisionNotConvergedError(RuntimeError):
+    """A lowered solve could not refine back to the gate at its budget.
+
+    The typed demotion signal: :func:`solve_lowered_auto` catches it and
+    drops one rung down the dtype ladder; the recovery ladder
+    (``resilience.recover``) records it as ``exception:...`` and
+    escalates — either way the caller ends verified or typed, never
+    silently wrong."""
+
+    def __init__(self, dtype: str, refine_steps: int, rel_residual: float,
+                 gate: float):
+        super().__init__(
+            f"lowered dtype {dtype!r} did not reach the {gate:.0e} gate "
+            f"after {refine_steps} refinement step(s) (relative residual "
+            f"{rel_residual:.3e}); demote down LOWERED_DTYPES")
+        self.dtype = dtype
+        self.refine_steps = refine_steps
+        self.rel_residual = rel_residual
+        self.gate = gate
+
+
+def _storage_and_precision(dtype: str):
+    """(jnp storage dtype, gemm_precision) for a ladder dtype name."""
+    import jax.numpy as jnp
+
+    if dtype == "bfloat16":
+        return jnp.bfloat16, "highest"
+    if dtype == "bf16x3":
+        return jnp.float32, "bf16x3"
+    if dtype == "float32":
+        return jnp.float32, "highest"
+    raise ValueError(f"unknown lowered dtype {dtype!r}; options: "
+                     f"{LOWERED_DTYPES}")
+
+
+def default_refine_steps(dtype: str) -> int:
+    try:
+        return DEFAULT_REFINE_STEPS[dtype]
+    except KeyError:
+        raise ValueError(f"unknown lowered dtype {dtype!r}; options: "
+                         f"{LOWERED_DTYPES}") from None
+
+
+def solve_lowered(a, b, dtype: str = "bfloat16",
+                  refine_steps: Optional[int] = None,
+                  panel: Optional[int] = None, unroll="auto",
+                  gate: float = DEFAULT_GATE,
+                  ) -> Tuple[np.ndarray, object, dict]:
+    """One lowered factor + double-single refinement pass, gated.
+
+    Returns ``(x_float64, factors, info)`` — ``info`` carries the dtype,
+    the MEASURED refinement count (how many steps actually updated before
+    the masked early exit), and the final relative residual; these are the
+    provenance fields bench records and the tuner's refine-steps
+    measurement consume. Raises :class:`PrecisionNotConvergedError` when
+    the budget was not enough — demotion is the CALLER's move
+    (:func:`solve_lowered_auto` / the recovery ladder), so a direct call
+    stays an honest single-configuration measurement.
+    """
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked, dsfloat
+
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    n = len(b64)
+    storage, gemm_precision = _storage_and_precision(dtype)
+    if refine_steps is None:
+        refine_steps = default_refine_steps(dtype)
+    itemsize = jnp.dtype(storage).itemsize
+    # The staged operand is owned here and dead after the factor: donate
+    # (panel-multiple shapes only — a padded donation is unusable).
+    donate = n % blocked._resolve_panel(n, panel, itemsize) == 0
+    a_dev = jnp.asarray(a64, storage)
+    factor = blocked.resolve_factor(n, unroll, donate=donate)
+    fac = factor(a_dev, panel=panel, gemm_precision=gemm_precision)
+    at_ds = dsfloat.to_ds(a64.T)
+    b_ds = dsfloat.to_ds(b64)
+    x0 = blocked.lu_solve(fac, b_ds.hi)
+    x, used = dsfloat.refine_ds(fac, at_ds, b_ds, x0, iters=refine_steps,
+                                tol=gate * REFINE_TOL_MARGIN,
+                                return_iters=True)
+    x64 = dsfloat.ds_to_f64(x)
+    used = int(used)
+    rel = checks.residual_norm(a64, x64, b64, relative=True)
+    obs.emit("precision", dtype=dtype, n=n, refine_steps=used,
+             budget=refine_steps, rel_residual=float(f"{rel:.3e}"),
+             converged=bool(rel <= gate))
+    if not rel <= gate:
+        obs.counter("precision.not_converged")
+        raise PrecisionNotConvergedError(dtype, used, rel, gate)
+    return x64, fac, {"dtype": dtype, "refine_steps": used,
+                      "rel_residual": rel}
+
+
+def lowered_params(n: int) -> Tuple[str, Optional[int]]:
+    """The tuned (dtype, refine_steps) starting point for size ``n`` —
+    the ``tune.space`` op ``"lowered"`` consult. The declared seed is
+    ("float32", None): an untuned checkout keeps today's f32 path
+    exactly; only an offline sweep that MEASURED a converging lowered
+    pair on this hardware moves the start down the ladder."""
+    from gauss_tpu.tune import apply as _tune
+
+    p = _tune.params_for("lowered", n)
+    dtype = str(p.get("dtype") or "float32")
+    steps = p.get("refine_steps")
+    return dtype, (int(steps) if steps else None)
+
+
+def lowered_enabled(n: int) -> bool:
+    """Whether the tuned store starts this size below float32 — the
+    routing consult ``solve_auto`` / the recovery ladder use."""
+    return lowered_params(n)[0] != "float32"
+
+
+def solve_lowered_auto(a, b, panel: Optional[int] = None, unroll="auto",
+                       gate: float = DEFAULT_GATE,
+                       ) -> Tuple[np.ndarray, object, dict]:
+    """The ladder walk: start at the tuned (dtype, refine_steps) pair and
+    demote DETERMINISTICALLY down :data:`LOWERED_DTYPES` on every typed
+    convergence failure — the same demotion shape as structure mistags.
+    Returns ``(x_float64, factors, info)`` with ``info["demoted"]`` set
+    when the serving dtype is below the requested start; re-raises the
+    last :class:`PrecisionNotConvergedError` only when even float32 +
+    double-single missed the gate (the recovery ladder's cue to escalate
+    to its own deeper rungs)."""
+    tuned_dtype, tuned_steps = lowered_params(np.shape(a)[0])
+    start = (LOWERED_DTYPES.index(tuned_dtype)
+             if tuned_dtype in LOWERED_DTYPES else len(LOWERED_DTYPES) - 1)
+    last_err: Optional[PrecisionNotConvergedError] = None
+    for dt in LOWERED_DTYPES[start:]:
+        steps = tuned_steps if dt == tuned_dtype else None
+        try:
+            x64, fac, info = solve_lowered(a, b, dtype=dt,
+                                           refine_steps=steps, panel=panel,
+                                           unroll=unroll, gate=gate)
+        except PrecisionNotConvergedError as e:
+            last_err = e
+            obs.counter("precision.demotions")
+            obs.emit("precision", event="demote", from_dtype=dt,
+                     rel_residual=float(f"{e.rel_residual:.3e}"))
+            continue
+        info["demoted"] = dt != tuned_dtype
+        if info["demoted"]:
+            obs.counter("precision.served_demoted")
+        return x64, fac, info
+    assert last_err is not None
+    raise last_err

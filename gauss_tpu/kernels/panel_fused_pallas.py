@@ -80,8 +80,16 @@ def _trailing_tile_update(t, mult_ref, pt_ref, *, panel, fseg, dtype):
     arithmetic and order-free. The tile then takes T - M^T U on live rows
     and the U values themselves — scattered exactly through the one-hots —
     on the segment's pivot rows (which sequential elimination retires:
-    later segments' M is zero there, so they are never touched again)."""
+    later segments' M is zero there, so they are never touched again).
+
+    **Precision contract (ISSUE 11):** at bfloat16 storage every dot
+    accumulates in float32 (``preferred_element_type`` — the MXU's native
+    bf16-in/f32-out mode), the Neumann chain stays in f32, and the tile
+    rounds ONCE per segment on store; the float32 path is bit-identical
+    to the pre-contract code (its accumulate dtype is itself and every
+    cast is an identity)."""
     hi = lax.Precision.HIGHEST
+    acc = jnp.float32 if dtype == jnp.bfloat16 else dtype  # accumulate
     dn_row = (((1,), (0,)), ((), ()))   # (w, h) x (h, ct) -> (w, ct)
     dn_lan = (((1,), (1,)), ((), ()))   # (w, h) x (w, h) contract h -> (w, w)
     dn_col = (((0,), (0,)), ((), ()))   # (w, h) x (w, ct) contract w -> (h, ct)
@@ -91,28 +99,31 @@ def _trailing_tile_update(t, mult_ref, pt_ref, *, panel, fseg, dtype):
         ms = mult_ref[pl.ds(s0, w), :]                        # (w, h)
         ps = pt_ref[pl.ds(s0, w), :]                          # (w, h)
         u = lax.dot_general(ps, t, dn_row, precision=hi,
-                            preferred_element_type=dtype)     # U0 (w, ct)
+                            preferred_element_type=acc)       # U0 (w, ct)
         lpt = lax.dot_general(ps, ms, dn_lan, precision=hi,
-                              preferred_element_type=dtype)   # L^T (w, w)
+                              preferred_element_type=acc)     # L^T (w, w)
         e = 1
         p2 = None
         while e < w:
             term = lpt if e == 1 else p2
             corr = jnp.dot(term, u, precision=hi,
-                           preferred_element_type=dtype)
+                           preferred_element_type=acc)
             u = u - corr if e == 1 else u + corr
             if e * 2 < w:
                 p2 = jnp.dot(term, term, precision=hi,
-                             preferred_element_type=dtype)
+                             preferred_element_type=acc)
             e *= 2
-        upd = lax.dot_general(ms, u, dn_col, precision=hi,
-                              preferred_element_type=dtype)   # L21-weighted
-        uset = lax.dot_general(ps, u, dn_col, precision=hi,
-                               preferred_element_type=dtype)  # U rows placed
+        # Rank-fseg application: storage-dtype operands into the MXU,
+        # f32 accumulation, one rounding on the tile store below.
+        ulow = u.astype(dtype)
+        upd = lax.dot_general(ms, ulow, dn_col, precision=hi,
+                              preferred_element_type=acc)     # L21-weighted
+        uset = lax.dot_general(ps, ulow, dn_col, precision=hi,
+                               preferred_element_type=acc)    # U rows placed
         sel = lax.dot_general(ps, jnp.ones((w, 1), dtype), dn_col,
                               precision=hi,
-                              preferred_element_type=dtype)   # (h, 1) 0/1
-        t = jnp.where(sel > 0, uset, t - upd)
+                              preferred_element_type=acc)     # (h, 1) 0/1
+        t = jnp.where(sel > 0, uset, t.astype(acc) - upd).astype(dtype)
     return t
 
 

@@ -162,6 +162,19 @@ def _rung_blocked(a64, b64, panel, iters):
     return x, fac
 
 
+def _rung_lowered(a64, b64, panel, iters):
+    """Mixed-precision rung 0 (core.lowered): the tuned (dtype,
+    refine_steps) pair — bf16 or bf16x3 MXU storage refined back to the
+    gate — with its OWN deterministic dtype demotion inside the rung; a
+    ladder-visible failure (typed PrecisionNotConvergedError after even
+    float32 missed) escalates to the pre-existing f32 chain below, the
+    same shape as a structure mistag."""
+    from gauss_tpu.core import lowered
+
+    x, fac, _info = lowered.solve_lowered_auto(a64, b64, panel=panel)
+    return x, fac
+
+
 def _rung_pivot_safe(a64, b64, panel, iters):
     import jax.numpy as jnp
 
@@ -259,6 +272,7 @@ def _rung_blockdiag(a64, b64, panel, iters):
 
 _RUNG_FNS: Dict[str, Callable] = {
     "blocked": _rung_blocked,
+    "lowered": _rung_lowered,
     "pivot_safe": _rung_pivot_safe,
     "ds_refine": _rung_ds,
     "rank1": _rung_rank1,
@@ -287,14 +301,26 @@ _STRUCTURE_HEADS: Dict[str, Tuple[str, ...]] = {
 }
 
 
-def structured_rungs(tag: str, abft: bool = False) -> Tuple[str, ...]:
+def structured_rungs(tag: str, abft: bool = False,
+                     lowered: bool = False) -> Tuple[str, ...]:
     """The escalation ladder for a structure tag: the structured engine
     first, then the general-LU demotion rungs.
 
     ``abft=True`` PREPENDS the checksum-carrying engine form where one
     exists (``abft_chol`` ahead of the spd ladder, ``abft`` ahead of the
     others' general-LU rung) — the existing demotion chain is unchanged,
-    so replay failure escalates through exactly the pre-ABFT ladder."""
+    so replay failure escalates through exactly the pre-ABFT ladder.
+
+    ``lowered=True`` (dense tag only — the structured engines' cost
+    profiles are the point of their routes, and the lowered path is an
+    LU) prepends the mixed-precision rung (core.lowered): the tuned
+    bf16/bf16x3 pair refined back to the gate, demoting typed to exactly
+    the pre-existing f32 chain when refinement cannot converge — the
+    router (``structure.router.solve_auto``) sets this from the tuned
+    store consult, so an untuned checkout never changes ladders. The two
+    heads are mutually exclusive by construction: the ABFT checksum rider
+    is defined against f32 math (core.blocked), so ``abft`` wins and
+    ``lowered`` is ignored when both are requested."""
     if tag not in _STRUCTURE_HEADS:
         raise ValueError(f"unknown structure tag {tag!r}; options: "
                          f"{sorted(_STRUCTURE_HEADS)}")
@@ -304,6 +330,8 @@ def structured_rungs(tag: str, abft: bool = False) -> Tuple[str, ...]:
         return ("abft_chol",) + base
     if abft and tag == "dense":
         return ("abft",) + base
+    if lowered and tag == "dense":
+        return ("lowered",) + base
     # banded / blockdiag engines have no checksum-carrying form; their
     # O(n*b^2) / batched-small-block cost profiles are the point of the
     # route, so an ABFT-LU head would defeat the routing — the structured

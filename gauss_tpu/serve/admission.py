@@ -48,6 +48,19 @@ class ServeConfig:
     refine_steps: int = 1           # host-f64 refinement rounds per batch
     panel: Optional[int] = None     # blocked-solver panel (None -> auto)
     engine: str = "blocked"         # batched lane engine label (cache key)
+    dtype: str = "float32"          # batched-lane storage dtype: "float32"
+    #                                 (the pre-existing path), "bfloat16"
+    #                                 (lowered MXU storage, f32-accumulate
+    #                                 contract), or "bf16x3" (f32 storage,
+    #                                 split-GEMM trailing updates). The
+    #                                 choice keys the executable cache —
+    #                                 CacheKey.dtype, so f32 and lowered
+    #                                 executables can never alias — and a
+    #                                 per-request dtype (submit(dtype=) /
+    #                                 the loadgen "dtype:" token) overrides
+    #                                 it per batch. Lowered lanes lean on
+    #                                 refine_steps + verify_gate for the
+    #                                 1e-4 contract (core.lowered)
     max_retries: int = 2            # transient-failure retries per batch
     retry_backoff_s: float = 0.05   # base backoff (doubles per attempt)
     unhealthy_after: int = 3        # consecutive failures that trip fallback
@@ -119,7 +132,8 @@ class ServeRequest:
 
     def __init__(self, a: np.ndarray, b: np.ndarray,
                  deadline_s: Optional[float] = None,
-                 structure: Optional[str] = None):
+                 structure: Optional[str] = None,
+                 dtype: Optional[str] = None):
         from gauss_tpu.obs import requesttrace
 
         with ServeRequest._ids_lock:
@@ -136,6 +150,11 @@ class ServeRequest:
         #: extension bucket padding preserves SPD and bandwidth (tested in
         #: tests/test_structure.py), so a tag survives padding.
         self.structure = structure
+        #: batched-lane storage dtype ("float32" / "bfloat16" / "bf16x3");
+        #: None defers to the server's ServeConfig.dtype at submit. Part
+        #: of the batch compatibility key AND the executable cache key —
+        #: a bf16 batch and an f32 batch can never share an executable.
+        self.dtype = dtype
         self.n = self.a.shape[0]
         if self.a.shape != (self.n, self.n):
             raise ValueError(f"expected square matrix, got {self.a.shape}")

@@ -30,10 +30,23 @@ from gauss_tpu import obs
 from gauss_tpu.resilience import inject as _inject
 
 
+def storage_dtype(key_dtype: str) -> np.dtype:
+    """The numpy staging dtype for a CacheKey.dtype name. "bf16x3" is a
+    GEMM mode, not a storage format — its executables stage float32 and
+    run the split-GEMM trailing updates (core.matmul.dot_bf16x3);
+    "bfloat16" resolves through ml_dtypes (registered by jax)."""
+    return np.dtype("float32" if key_dtype == "bf16x3" else key_dtype)
+
+
 class CacheKey(NamedTuple):
     bucket_n: int
     nrhs: int
     batch: int
+    #: batched-lane precision: "float32", "bfloat16" (lowered storage,
+    #: f32-accumulate contract), or "bf16x3" (f32 storage, split-GEMM
+    #: updates) — core.lowered's ladder names. A key field since PR 3;
+    #: the serve layer now actually varies it (ServeConfig.dtype /
+    #: submit(dtype=)), so lowered and f32 executables cannot alias.
     dtype: str
     engine: str
     refine_steps: int
@@ -76,7 +89,8 @@ class BatchedExecutable:
                                    dtype=key.dtype, engine=key.engine)
             panel = int(panel) if panel else None
         self.panel = panel
-        dtype = np.dtype(key.dtype)
+        dtype = storage_dtype(key.dtype)
+        gemm_precision = "bf16x3" if key.dtype == "bf16x3" else "highest"
 
         if key.structure == "spd":
             # The half-price lane: batched blocked Cholesky. Only
@@ -93,7 +107,8 @@ class BatchedExecutable:
                 return _chol.cholesky_solve(fac, b)
         else:
             def factor_one(a):
-                return blocked.lu_factor_blocked(a, panel=panel)
+                return blocked.lu_factor_blocked(
+                    a, panel=panel, gemm_precision=gemm_precision)
 
             def solve_one(fac, b):
                 return blocked.lu_solve(fac, b)
@@ -112,12 +127,18 @@ class BatchedExecutable:
         # RHS shape at every bucket.
         from gauss_tpu.core.blocked import _resolve_panel
 
-        p_res = _resolve_panel(key.bucket_n, panel,
-                               np.dtype(key.dtype).itemsize)
+        p_res = _resolve_panel(key.bucket_n, panel, dtype.itemsize)
         fac_donate = (0,) if key.bucket_n % p_res == 0 else ()
+        # The solve lane donates its RHS stack only when the output can
+        # actually reuse it: a bf16 factor's solves return float32 (the
+        # lu_solve accumulate contract), so the bf16 RHS buffer is
+        # unusable for the result and the donation would warn per
+        # compile instead of saving a copy.
+        solve_donate = (1,) if key.dtype != "bfloat16" else ()
         self._factor = jax.jit(jax.vmap(factor_one),
                                donate_argnums=fac_donate)
-        self._solve = jax.jit(jax.vmap(solve_one), donate_argnums=(1,))
+        self._solve = jax.jit(jax.vmap(solve_one),
+                              donate_argnums=solve_donate)
         # Compile at the exact serving shape now (identity systems), so the
         # one-time cost lands on the miss that created the entry — never
         # inside a later request's compute window.
@@ -138,9 +159,12 @@ class BatchedExecutable:
         of host-f64 iterative refinement through the SAME batched factors
         recover the f64-residual accuracy the one-shot solvers get from
         ``solve_refined`` (each round: one batched residual + one batched
-        device solve).
+        device solve). Lowered keys ("bfloat16"/"bf16x3") stage at their
+        storage dtype and lean on the same refinement — the f32-accuracy
+        corrections of the lu_solve precision contract make each round
+        contract by ~the factor's storage error.
         """
-        dtype = np.dtype(self.key.dtype)
+        dtype = storage_dtype(self.key.dtype)
         fac = self._factor(a_pad.astype(dtype))
         x = np.asarray(self._solve(fac, b_pad.astype(dtype)),
                        dtype=np.float64)

@@ -34,7 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mix", default="random:100*2,random:200,internal:160",
                    help="weighted workload tokens kind:arg[*weight] "
                         "(kinds: random:<n>, internal:<n>, dat:<path>, "
-                        "dataset:<name>)")
+                        "dataset:<name>, spd:<n>, banded:<n>/<b>, "
+                        "blockdiag:<n>/<k>, dtype:<dt>/<n> — the last "
+                        "drives the lowered bf16/bf16x3 batched lanes)")
     p.add_argument("--requests", type=int, default=50,
                    help="measured request count (default 50)")
     p.add_argument("--warmup", type=int, default=8,
@@ -62,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-capacity", type=int, default=32)
     p.add_argument("--refine-steps", type=int, default=1,
                    help="host-f64 refinement rounds per batch (default 1)")
+    p.add_argument("--dtype", choices=("float32", "bfloat16", "bf16x3"),
+                   default="float32",
+                   help="batched-lane storage dtype default (per-request "
+                        "dtype: mix tokens override it); lowered dtypes "
+                        "key their own cache entries and rely on "
+                        "--refine-steps + the verify gate for the 1e-4 "
+                        "contract (default float32)")
     p.add_argument("--linger", type=float, default=0.0, metavar="S",
                    help="batching linger: wait this long for same-bucket "
                         "company before dispatching (default 0)")
@@ -138,7 +147,7 @@ def main(argv=None) -> int:
         ladder=ladder, max_batch=args.max_batch, max_queue=args.max_queue,
         batch_linger_s=args.linger, cache_capacity=args.cache_capacity,
         refine_steps=args.refine_steps, panel=args.panel,
-        live_port=args.live_port, slo_shed=args.slo_shed)
+        dtype=args.dtype, live_port=args.live_port, slo_shed=args.slo_shed)
     cfg = LoadgenConfig(
         mix=args.mix, requests=args.requests, warmup=args.warmup,
         mode=args.mode, concurrency=args.concurrency, rate=args.rate,

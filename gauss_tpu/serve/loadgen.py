@@ -25,6 +25,13 @@ Workload mixes are comma-separated weighted tokens::
   blockdiag_matrix), so a mix can drive the structure-aware serving
   lanes (``ServeConfig(structure_aware=True)``) and the chaos campaign
   end to end; ``<b>``/``<k>`` default to 1 / n // 8.
+- ``dtype:<dt>/<n>`` — a diagonally-dominant random system (like
+  ``random:<n>``) submitted with a per-request storage dtype
+  (``bfloat16`` / ``bf16x3`` / ``float32`` — core.lowered's ladder
+  names), so a mix can drive the LOWERED batched lanes
+  (``submit(dtype=...)`` -> ``CacheKey.dtype``) alongside f32 traffic
+  and prove the executables never alias; every solution still passes
+  the same 1e-4 verification below.
 
 Two driving modes: **closed** loop (``concurrency`` clients, each submits,
 waits, repeats — throughput self-clocks to service capacity) and **open**
@@ -69,9 +76,12 @@ def _compilecache_dir() -> Optional[str]:
 class WorkloadSpec:
     """One sampled request template."""
 
-    kind: str          # random | internal | dat | dataset
+    kind: str          # random | internal | dat | dataset | structured...
     arg: str           # n as string, path, or dataset name
     nrhs: int = 1
+    #: per-request storage dtype for the batched lane (the ``dtype:``
+    #: token); None = the server's default.
+    dtype: Optional[str] = None
 
 
 @dataclass
@@ -105,15 +115,29 @@ def parse_mix(mix: str) -> List[Tuple[WorkloadSpec, float]]:
             raise ValueError(f"workload token {token!r} needs kind:arg")
         kind, arg = token.split(":", 1)
         if kind not in ("random", "internal", "dat", "dataset",
-                        "spd", "banded", "blockdiag"):
+                        "spd", "banded", "blockdiag", "dtype"):
             raise ValueError(f"unknown workload kind {kind!r} in {token!r}")
+        dtype = None
+        if kind == "dtype":
+            # dtype:<dt>/<n> — a random dominant system served at the
+            # lowered storage dtype (the mixed-precision batched lane).
+            from gauss_tpu.core.lowered import LOWERED_DTYPES
+
+            dt_part, _, n_part = arg.partition("/")
+            if dt_part not in LOWERED_DTYPES:
+                raise ValueError(
+                    f"bad dtype in workload token {token!r}; options: "
+                    f"{LOWERED_DTYPES}")
+            if not n_part or int(n_part) < 1:
+                raise ValueError(f"bad size in workload token {token!r}")
+            kind, arg, dtype = "random", n_part, dt_part
         if kind in ("random", "internal", "spd") and int(arg) < 1:
             raise ValueError(f"bad size in workload token {token!r}")
         if kind in ("banded", "blockdiag"):
             n_part = arg.split("/", 1)[0]
             if int(n_part) < 1:
                 raise ValueError(f"bad size in workload token {token!r}")
-        out.append((WorkloadSpec(kind=kind, arg=arg), weight))
+        out.append((WorkloadSpec(kind=kind, arg=arg, dtype=dtype), weight))
     if not out:
         raise ValueError(f"empty workload mix {mix!r}")
     return out
@@ -218,7 +242,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
         # BATCHED executable shapes too (a serial warmup only ever forms
         # batch-1 dispatches, leaving every batch-bucket shape to compile
         # inside the measured window).
-        warm_handles = [server.submit(*materialize(spec, rng, cfg.nrhs))
+        warm_handles = [server.submit(*materialize(spec, rng, cfg.nrhs),
+                                      dtype=spec.dtype)
                         for spec in warm_plan]
         for h in warm_handles:
             h.result(cfg.timeout_s)
@@ -252,7 +277,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
             a, b = materialize(plan[i], wrng, cfg.nrhs)
             operands[i] = (a, b)
             results[i] = server.solve(a, b, deadline_s=cfg.deadline_s,
-                                      timeout=cfg.timeout_s)
+                                      timeout=cfg.timeout_s,
+                                      dtype=plan[i].dtype)
 
     t_start = time.perf_counter()
     if cfg.mode == "closed":
@@ -274,7 +300,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
             delay = t_next - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            handles.append(server.submit(a, b, deadline_s=cfg.deadline_s))
+            handles.append(server.submit(a, b, deadline_s=cfg.deadline_s,
+                                         dtype=spec.dtype))
         for i, h in enumerate(handles):
             results[i] = h.result(cfg.timeout_s)
     else:

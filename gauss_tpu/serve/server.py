@@ -215,7 +215,8 @@ class SolverServer:
         return round(min(60.0, max(0.01, self.config.max_batch / rate)), 4)
 
     def submit(self, a, b, deadline_s: Optional[float] = None,
-               structure: Optional[str] = None) -> ServeRequest:
+               structure: Optional[str] = None,
+               dtype: Optional[str] = None) -> ServeRequest:
         """Enqueue one system. Returns the request handle immediately; a
         queue-full rejection resolves the handle synchronously with
         ``retry_after_s`` set (the client never blocks to learn it was
@@ -226,7 +227,13 @@ class SolverServer:
         classified here (one O(n^2) scan against an O(n^3) solve); the tag
         keys batching and the executable cache, and certified-SPD batches
         take the Cholesky lane. Without ``structure_aware`` the tag is
-        ignored — the pre-existing single-lane behavior."""
+        ignored — the pre-existing single-lane behavior.
+
+        ``dtype``: the batched lane's storage dtype for this request
+        ("float32" / "bfloat16" / "bf16x3" — core.lowered's ladder names);
+        None takes ``config.dtype``. Requests batch only with same-dtype
+        company and compile against their own ``CacheKey.dtype`` entry —
+        mixed-precision traffic can never alias an f32 executable."""
         if deadline_s is None:
             deadline_s = self.config.deadline_default_s
         if self.config.structure_aware and structure is None:
@@ -235,7 +242,8 @@ class SolverServer:
             structure = structure_tag(a)
         if not self.config.structure_aware:
             structure = None
-        req = ServeRequest(a, b, deadline_s=deadline_s, structure=structure)
+        req = ServeRequest(a, b, deadline_s=deadline_s, structure=structure,
+                           dtype=dtype or self.config.dtype)
         # SLO-degraded admission (slo_shed): while a burn-rate alert FIRES,
         # the effective queue bound shrinks, so load is turned away while
         # the error budget is bleeding — shedding starts BEFORE the
@@ -289,9 +297,11 @@ class SolverServer:
         return req
 
     def solve(self, a, b, deadline_s: Optional[float] = None,
-              timeout: Optional[float] = 300.0) -> ServeResult:
+              timeout: Optional[float] = 300.0,
+              dtype: Optional[str] = None) -> ServeResult:
         """Synchronous convenience: submit + wait."""
-        return self.submit(a, b, deadline_s=deadline_s).result(timeout)
+        return self.submit(a, b, deadline_s=deadline_s,
+                           dtype=dtype).result(timeout)
 
     # -- worker loop ------------------------------------------------------
 
@@ -341,7 +351,8 @@ class SolverServer:
                 continue
             if (nxt.n <= self.ladder[-1]
                     and buckets.bucket_for(nxt.n, self.ladder) == want
-                    and nxt.structure == first.structure):
+                    and nxt.structure == first.structure
+                    and nxt.dtype == first.dtype):
                 got.append(nxt)
             else:
                 requeue.append(nxt)
@@ -390,8 +401,12 @@ class SolverServer:
         # percentiles and span trees are computable from per-batch spans —
         # before this, serve_batch_* spans had no request identity at all.
         traces = [r.trace_id for r in reqs]
+        # dtype was already a CacheKey field (PR 3); the precision choice
+        # now actually varies it — batches are dtype-homogeneous (drain
+        # compatibility above), so f32 and lowered executables can never
+        # alias one cache entry.
         key = CacheKey(bucket_n=bucket_n, nrhs=nrhs, batch=bb,
-                       dtype="float32", engine=cfg.engine,
+                       dtype=reqs[0].dtype or "float32", engine=cfg.engine,
                        refine_steps=cfg.refine_steps, mesh=None,
                        structure=reqs[0].structure)
 

@@ -192,8 +192,58 @@ def _measure_panel_fused(n: int, dtype: str, params: Dict[str, Any],
     return best
 
 
+#: the most recent converged refine count per (n, dtype-name) measured by
+#: _measure_lowered — read back by the concretizer so the store pins the
+#: MEASURED minimal budget, not the swept cap.
+_LOWERED_USED_STEPS: Dict[Tuple[int, str], int] = {}
+
+
+def _measure_lowered(n: int, dtype: str, params: Dict[str, Any],
+                     seed: int, reps: int,
+                     prune_s: Optional[float]) -> Optional[float]:
+    """Best-of-``reps`` seconds for one LOWERED solve (core.lowered) at
+    the candidate (dtype, refine_steps) pair — the refine-steps-vs-dtype
+    axis. A candidate that cannot reach the 1e-4 gate at its budget is
+    DISQUALIFIED (recorded like a pruned candidate), so the store can
+    only ever pin a converging pair; the converged run's SURFACED
+    iteration count (dsfloat.refine_ds) is stashed for the concretizer.
+    None = pruned or disqualified."""
+    from gauss_tpu.core import lowered
+    from gauss_tpu.utils.timing import timed
+
+    a64, b64 = _seeded_system(n, seed)
+    ldt = str(params.get("dtype") or "float32")
+    steps = params.get("refine_steps")
+    steps = int(steps) if steps else None
+
+    def run_once():
+        return lowered.solve_lowered(a64, b64, dtype=ldt,
+                                     refine_steps=steps)
+
+    try:
+        with obs.compile_span("tune_candidate", op="lowered", n=n,
+                              dtype=ldt, refine_steps=steps):
+            _, _, info = run_once()  # compile outside the timing
+    except lowered.PrecisionNotConvergedError as e:
+        obs.emit("tune_sweep", event="disqualified", op="lowered", n=n,
+                 params=params, rel_residual=float(f"{e.rel_residual:.3e}"))
+        return None
+    _LOWERED_USED_STEPS[(n, ldt)] = int(info["refine_steps"])
+    best = None
+    for r in range(max(1, reps)):
+        t, _ = timed(run_once, warmup=0, reps=1)
+        best = t if best is None else min(best, t)
+        if r == 0 and prune_s is not None and t > prune_s:
+            obs.emit("tune_sweep", event="pruned", op="lowered", n=n,
+                     params=params, first_rep_s=round(t, 6),
+                     prune_s=round(prune_s, 6))
+            return None
+    return best
+
+
 _MEASURERS = {"lu_factor": _measure_lu_factor, "matmul": _measure_matmul,
-              "panel_fused": _measure_panel_fused}
+              "panel_fused": _measure_panel_fused,
+              "lowered": _measure_lowered}
 
 
 def _concrete_lu_factor(n: int, dtype: str,
@@ -212,7 +262,23 @@ def _concrete_lu_factor(n: int, dtype: str,
     return out
 
 
-_CONCRETIZERS = {"lu_factor": _concrete_lu_factor}
+def _concrete_lowered(n: int, dtype: str,
+                      params: Dict[str, Any]) -> Dict[str, Any]:
+    """Pin the winning lowered pair's refine budget to the MEASURED
+    converged iteration count (the refine_ds surfaced count stashed by
+    _measure_lowered, plus one step of margin for operands the sweep
+    system did not sample) — the store entry then records what the gate
+    actually needed, not the swept cap."""
+    out = dict(params)
+    used = _LOWERED_USED_STEPS.get((n, str(out.get("dtype") or "float32")))
+    if used is not None and out.get("refine_steps"):
+        out["refine_steps"] = min(int(out["refine_steps"]),
+                                  max(1, used + 1))
+    return out
+
+
+_CONCRETIZERS = {"lu_factor": _concrete_lu_factor,
+                 "lowered": _concrete_lowered}
 
 
 def sweep_point(op: str, n: int, dtype: str = "float32",

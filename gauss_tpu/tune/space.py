@@ -84,6 +84,21 @@ MM_TILE_SEED = (512, 512, 1024)
 #: row-elimination kernel tile (bm, bn) (kernels.rowelim_pallas defaults).
 ROWELIM_TILE_SEED = (256, 256)
 
+#: lowered-precision solve path (core.lowered): the storage/GEMM dtype
+#: the factorization runs at and the double-single refinement budget that
+#: brings it back to the 1e-4 gate. The dtype SEED is float32 — an
+#: untuned checkout keeps today's path exactly; only an offline
+#: ``gauss-tune --ops lowered`` sweep that MEASURED a converging cheaper
+#: (dtype, refine_steps) pair on this hardware moves the start down the
+#: ladder (bfloat16 storage / the bf16x3 split-GEMM middle rung). The
+#: refine seed is the dsfloat default (clears saylr4, cond ~1e6);
+#: candidates bracket the measured needs of the lowered dtypes (bf16
+#: ~4e-3/step contraction wants headroom, bf16x3 ~1e-5 needs almost
+#: none). The sweep runner DISQUALIFIES candidates that miss the gate,
+#: so the store can only ever pin a converging pair.
+LOWERED_DTYPE_SEED = "float32"
+LOWERED_REFINE_SEED = 6
+
 #: host-f64 refinement rounds per batched serve dispatch
 #: (serve.admission.ServeConfig.refine_steps).
 SERVE_REFINE_SEED = 1
@@ -156,6 +171,16 @@ SPACES: Dict[str, Tuple[Axis, ...]] = {
         Axis("bm", MM_TILE_SEED[0], (256, 1024)),
         Axis("bn", MM_TILE_SEED[1], (256, 1024)),
         Axis("bk", MM_TILE_SEED[2], (512, 2048)),
+    ),
+    # the mixed-precision solve ladder (core.lowered.solve_lowered_auto):
+    # which dtype rung a solve STARTS at and its refinement budget —
+    # refine-steps-vs-dtype as one swept pair, per (n-bucket, device).
+    # The winner concretizes refine_steps to the MEASURED converged count
+    # (dsfloat.refine_ds surfaces it), so the store pins the minimal
+    # budget that actually met the gate.
+    "lowered": (
+        Axis("dtype", LOWERED_DTYPE_SEED, ("bfloat16", "bf16x3")),
+        Axis("refine_steps", LOWERED_REFINE_SEED, (2, 4, 8, 12)),
     ),
     # serve-layer knobs consulted at warmup (bucket growth is declared for
     # operators; the pow2 ladder stays the only implemented policy)

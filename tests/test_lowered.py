@@ -1,0 +1,503 @@
+"""PR 11 — mixed-precision factorization: bf16/bf16x3 MXU paths refined
+back to the 1e-4 gate, plus the batched throughput record.
+
+Covers the precision contract (f32 accumulation, f32 inverses/solves,
+doubled VMEM admission at bf16), the dtype-parameterized residual grid
+over the fused/unfused factorization forms, refine-convergence with the
+typed demotion ladder (core.lowered), the surfaced refine_ds iteration
+count, the tuned (dtype, refine_steps) axis, the serve layer's dtype
+lanes with cache-key isolation, and the throughput bench's
+record/ratchet machinery.
+"""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gauss_tpu.core import blocked, dsfloat, lowered  # noqa: E402
+from gauss_tpu.core.matmul import (  # noqa: E402
+    BF16X3,
+    dot_bf16x3,
+    resolve_precision,
+    split_bf16,
+)
+from gauss_tpu.verify import checks  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(258458)
+
+
+def _system(rng, n):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _ill_system(rng, n, cond_exp=6):
+    """Symmetric system with condition ~10^cond_exp — bf16 refinement
+    (contraction ~cond * 4e-3) must fail on it while f32 + double-single
+    still clears the gate (the saylr4 class)."""
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    d = np.logspace(0, cond_exp, n)
+    return (q * d) @ q.T, rng.standard_normal(n)
+
+
+# --- the precision contract ------------------------------------------------
+
+
+def test_accumulate_contract_inverses_and_solves(rng):
+    """bf16 factors store f32 diagonal-block inverses and solve in f32
+    (returning f32); the f32 path keeps f32 everywhere — the contract's
+    observable surface."""
+    a, b = _system(rng, 64)
+    fac16 = blocked.lu_factor_blocked(jnp.asarray(a, jnp.bfloat16),
+                                      panel=16)
+    assert fac16.m.dtype == jnp.bfloat16
+    assert fac16.linv.dtype == jnp.float32
+    assert fac16.uinv.dtype == jnp.float32
+    x = blocked.lu_solve(fac16, jnp.asarray(b, jnp.float32))
+    assert x.dtype == jnp.float32
+    # One-shot bf16 accuracy lands at storage rounding, not accumulated
+    # rounding: comfortably under 1e-2 relative for a dominant system.
+    rel = checks.residual_norm(a, np.asarray(x, np.float64), b,
+                               relative=True)
+    assert rel < 5e-3
+    fac32 = blocked.lu_factor_blocked(jnp.asarray(a, jnp.float32), panel=16)
+    assert fac32.m.dtype == jnp.float32
+    assert fac32.linv.dtype == jnp.float32
+
+
+def test_bf16x3_split_gemm_fidelity(rng):
+    """The explicit split-GEMM: ~1e-5 relative class (lax.Precision.HIGH's
+    fidelity), two orders tighter than a plain bf16 pass; the split is an
+    exact two-term decomposition to bf16-pair precision."""
+    a = rng.standard_normal((256, 256)).astype(np.float32)
+    b = rng.standard_normal((256, 256)).astype(np.float32)
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    scale = np.abs(ref).max()
+    e3 = np.abs(np.asarray(dot_bf16x3(jnp.asarray(a), jnp.asarray(b)),
+                           np.float64) - ref).max() / scale
+    e1 = np.abs(np.asarray(
+        jnp.dot(jnp.asarray(a, jnp.bfloat16), jnp.asarray(b, jnp.bfloat16)),
+        np.float64) - ref).max() / scale
+    assert e3 < 5e-5
+    assert e3 < e1 / 30
+    hi, lo = split_bf16(jnp.asarray(a))
+    recon = np.asarray(hi, np.float32) + np.asarray(lo, np.float32)
+    assert np.abs(recon - a).max() <= 2 ** -14  # ~16 captured bits
+
+
+def test_bf16x3_precision_name_is_opt_in():
+    """resolve_precision admits "bf16x3" only where the caller routes the
+    sentinel (blocked LU, matmul); everywhere else it is a typed error,
+    never a raw trace failure."""
+    assert resolve_precision("bf16x3", allow_split=True) == BF16X3
+    with pytest.raises(ValueError, match="bf16x3"):
+        resolve_precision("bf16x3")
+    with pytest.raises(ValueError, match="unknown precision"):
+        resolve_precision("bf16x9", allow_split=True)
+
+
+def test_fused_fits_vmem_bf16_admits_double(monkeypatch):
+    """Halving itemsize roughly doubles the fused kernel's admission:
+    the largest h admitted at itemsize=2 is >= 1.8x the itemsize=4 one
+    (exact 2x minus the itemsize-independent per-row overhead)."""
+    def max_h(itemsize):
+        lo, hi = 128, 1 << 22
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if blocked.fused_fits_vmem(mid, 128, ct=256,
+                                       itemsize=itemsize):
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    h4, h2 = max_h(4), max_h(2)
+    assert h2 > h4
+    assert h2 / h4 >= 1.8
+
+
+def test_abft_rejects_lowered_typed(rng):
+    """The checksum rider is defined against f32 math: bf16 storage and
+    the bf16x3 split both get the clear ValueError, on the flat and the
+    chunked forms."""
+    a, _ = _system(rng, 64)
+    a16 = jnp.asarray(a, jnp.bfloat16)
+    with pytest.raises(ValueError, match="abft=True requires float32"):
+        blocked.lu_factor_blocked(a16, panel=16, abft=True)
+    with pytest.raises(ValueError, match="abft=True requires float32"):
+        blocked.lu_factor_blocked_chunked(jnp.asarray(a, jnp.float32),
+                                          panel=16, chunk=2,
+                                          gemm_precision="bf16x3",
+                                          abft=True)
+
+
+# --- the dtype-parameterized residual grid ---------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "bf16x3"])
+@pytest.mark.parametrize("n,panel,chunk", [
+    (96, 16, 2), (100, 16, 2),   # non-multiple-of-panel edge
+    (64, 32, 1),                 # single-panel groups (fused skipped)
+    (96, 48, 2),                 # panel not dividing n
+])
+def test_lowered_residual_grid(rng, dtype, n, panel, chunk):
+    """The lowered analog of test_fused's f32 grid: every factorization
+    form (flat / unrolled / chunked), fused AND unfused panel impls, at
+    bf16 storage and the bf16x3 split — each factor refines back under
+    the SAME 1e-4 relative gate through the shared dsfloat machinery."""
+    a, b = _system(rng, n)
+    storage = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    gp = "bf16x3" if dtype == "bf16x3" else "highest"
+    a_dev = jnp.asarray(a, storage)
+    at_ds, b_ds = dsfloat.to_ds(a.T), dsfloat.to_ds(b)
+    # The unfused-pair ("auto") leg runs on one representative shape —
+    # the kernels share the tile math verbatim (test_fused bit-identity),
+    # so per-shape coverage of both impls only re-compiles the same code.
+    impls = ("fused", "auto") if (n, panel, chunk) == (96, 16, 2) \
+        else ("fused",)
+    for impl in impls:
+        routes = [
+            blocked.lu_factor_blocked(a_dev, panel=panel, panel_impl=impl,
+                                      gemm_precision=gp),
+            blocked.lu_factor_blocked_unrolled(a_dev, panel=panel,
+                                               panel_impl=impl,
+                                               gemm_precision=gp),
+            blocked.lu_factor_blocked_chunked(a_dev, panel=panel,
+                                              chunk=chunk, panel_impl=impl,
+                                              gemm_precision=gp),
+        ]
+        for fac in routes:
+            x0 = blocked.lu_solve(fac, b_ds.hi)
+            x = dsfloat.refine_ds(fac, at_ds, b_ds, x0, iters=6)
+            rel = checks.residual_norm(a, dsfloat.ds_to_f64(x), b,
+                                       relative=True)
+            assert rel < 1e-4, (dtype, impl, n, panel, chunk, rel)
+
+
+# --- refinement convergence + typed demotion -------------------------------
+
+
+def test_refine_convergence_property(rng):
+    """The convergence property the ladder rests on: across seeds, a
+    bf16 factor + refine_ds either meets 1e-4 or the solve demotes
+    TYPED — solve_lowered_auto always ends verified, and the serving
+    dtype is recorded honestly."""
+    for seed in range(5):
+        r = np.random.default_rng(seed)
+        a, b = _system(r, 80)
+        x, _, info = lowered.solve_lowered_auto(a, b)
+        assert info["rel_residual"] <= 1e-4
+        assert checks.residual_norm(a, x, b, relative=True) <= 1e-4
+        # Untuned store: the start IS float32, so nothing can demote.
+        assert info["dtype"] == "float32" and info["demoted"] is False
+
+
+def test_lowered_direct_rungs(rng):
+    """Each ladder dtype, called directly, converges on a dominant
+    system and reports its measured refine count."""
+    a, b = _system(rng, 96)
+    for dt, max_steps in (("bfloat16", 4), ("bf16x3", 2), ("float32", 2)):
+        x, fac, info = lowered.solve_lowered(a, b, dtype=dt)
+        assert info["rel_residual"] <= 1e-4
+        assert 0 <= info["refine_steps"] <= max_steps
+        assert info["dtype"] == dt
+
+
+def test_lowered_demotes_typed_on_ill_conditioning(rng):
+    """cond ~1e6: bf16 refinement diverges -> typed
+    PrecisionNotConvergedError; the auto walk demotes down the ladder
+    and still serves a verified solution."""
+    a, b = _ill_system(rng, 64)
+    with pytest.raises(lowered.PrecisionNotConvergedError) as ei:
+        lowered.solve_lowered(a, b, dtype="bfloat16")
+    assert ei.value.dtype == "bfloat16"
+    assert ei.value.rel_residual > 1e-4
+    x, _, info = lowered.solve_lowered_auto(a, b)
+    assert checks.residual_norm(a, x, b, relative=True) <= 1e-4
+
+
+def test_lowered_auto_consults_tuned_store(rng, monkeypatch):
+    """A tuned store that recorded a converging (bfloat16, steps) pair
+    moves the start down the ladder; the served dtype is bf16 with no
+    demotion on a well-conditioned operand — and an ill-conditioned one
+    demotes back to f32 deterministically."""
+    from gauss_tpu.tune import apply as tapply
+
+    def fake_params(op, n, dtype="float32", engine="blocked"):
+        assert op == "lowered"
+        return {"dtype": "bfloat16", "refine_steps": 6}
+
+    monkeypatch.setattr(tapply, "params_for", fake_params)
+    a, b = _system(rng, 80)
+    x, _, info = lowered.solve_lowered_auto(a, b)
+    assert info["dtype"] == "bfloat16" and info["demoted"] is False
+    assert checks.residual_norm(a, x, b, relative=True) <= 1e-4
+    ill_a, ill_b = _ill_system(rng, 64)
+    x, _, info = lowered.solve_lowered_auto(ill_a, ill_b)
+    assert info["demoted"] is True
+    assert checks.residual_norm(ill_a, x, ill_b, relative=True) <= 1e-4
+
+
+def test_recovery_ladder_lowered_rung(rng, monkeypatch):
+    """structured_rungs(lowered=True) prepends the mixed-precision rung
+    for the dense tag only (abft wins when both are set), and solve_auto
+    routes through it when the tuned store enables lowering — rung 0
+    serves, not 'demoted'."""
+    from gauss_tpu.resilience import recover
+    from gauss_tpu.structure import router
+
+    assert recover.structured_rungs("dense", lowered=True)[0] == "lowered"
+    assert recover.structured_rungs("dense")[0] == "blocked"
+    assert recover.structured_rungs("spd", lowered=True)[0] == "cholesky"
+    assert recover.structured_rungs("dense", abft=True,
+                                    lowered=True)[0] == "abft"
+    monkeypatch.setattr(lowered, "lowered_enabled", lambda n: True)
+    a, b = _system(rng, 80)
+    res = router.solve_auto(a, b)
+    assert res.rung == "lowered" and res.rung_index == 0
+    assert res.rel_residual <= 1e-4
+
+
+# --- refine_ds surfaced iteration count ------------------------------------
+
+
+def test_refine_ds_surfaces_iteration_count(rng):
+    """tol + return_iters: the count stops advancing at convergence and
+    the converged solution matches the budget run; the default call
+    shape (existing callers) is unchanged — a DS pair, same trace."""
+    a, b = _system(rng, 64)
+    fac = blocked.lu_factor_blocked(jnp.asarray(a, jnp.float32), panel=16)
+    at_ds, b_ds = dsfloat.to_ds(a.T), dsfloat.to_ds(b)
+
+    def x0():
+        return blocked.lu_solve(fac, b_ds.hi)
+
+    x, used = dsfloat.refine_ds(fac, at_ds, b_ds, x0(), iters=6,
+                                tol=1e-5, return_iters=True)
+    used = int(used)
+    assert 0 <= used < 6  # dominant f32 system converges well early
+    assert checks.residual_norm(a, dsfloat.ds_to_f64(x), b,
+                                relative=True) < 1e-5
+    # Without tol the count is the full budget.
+    _, used_all = dsfloat.refine_ds(fac, at_ds, b_ds, x0(), iters=3,
+                                    return_iters=True)
+    assert int(used_all) == 3
+    # The pre-existing call shape: a bare DS back.
+    x_plain = dsfloat.refine_ds(fac, at_ds, b_ds, x0(), iters=2)
+    assert isinstance(x_plain, dsfloat.DS)
+
+
+# --- the tuned (dtype, refine_steps) axis ----------------------------------
+
+
+def test_lowered_space_declared():
+    from gauss_tpu.tune import space as tspace
+
+    axes = {ax.name: ax for ax in tspace.space_for("lowered")}
+    assert axes["dtype"].seed == "float32"  # untuned = unchanged
+    assert set(axes["dtype"].candidates) == {"bfloat16", "bf16x3"}
+    assert axes["refine_steps"].seed == tspace.LOWERED_REFINE_SEED
+    assert tspace.seed_params("lowered")["dtype"] == "float32"
+
+
+def test_tune_measurer_disqualifies_nonconverging(rng, monkeypatch):
+    """The sweep can only ever pin a converging pair: a candidate that
+    misses the gate at its budget returns None (disqualified), and the
+    converged candidate's measured step count feeds the concretizer."""
+    from gauss_tpu.tune import runner
+
+    ill = _ill_system(np.random.default_rng(0), 48)
+    monkeypatch.setattr(runner, "_seeded_system", lambda n, seed: ill)
+    t = runner._measure_lowered(48, "float32",
+                                {"dtype": "bfloat16", "refine_steps": 6},
+                                258458, 1, None)
+    assert t is None
+    t = runner._measure_lowered(48, "float32",
+                                {"dtype": "float32", "refine_steps": 8},
+                                258458, 1, None)
+    assert t is not None and t > 0
+    used = runner._LOWERED_USED_STEPS[(48, "float32")]
+    conc = runner._concrete_lowered(
+        48, "float32", {"dtype": "float32", "refine_steps": 8})
+    assert conc["refine_steps"] == min(8, max(1, used + 1))
+
+
+def test_lowered_sweep_point_end_to_end(rng):
+    """A micro sweep over the lowered axes picks a converging winner and
+    produces a regress-ingestable point."""
+    from gauss_tpu.tune import runner
+
+    point = runner.sweep_point("lowered", 48, reps=1,
+                               axes={"dtype": ["float32", "bfloat16"],
+                                     "refine_steps": [6]})
+    assert point["op"] == "lowered"
+    assert point["best_params"]["dtype"] in ("float32", "bfloat16")
+    assert point["best_s"] > 0
+
+
+# --- serve: dtype lanes + cache isolation ----------------------------------
+
+
+def test_cachekey_no_dtype_aliasing():
+    """f32 and lowered executables can never alias: distinct keys,
+    distinct entries, both solving at the gate."""
+    from gauss_tpu.serve.cache import (
+        BatchedExecutable,
+        CacheKey,
+        ExecutableCache,
+        storage_dtype,
+    )
+
+    assert storage_dtype("bf16x3") == np.dtype("float32")
+    assert storage_dtype("bfloat16") == np.dtype("bfloat16")
+    cache = ExecutableCache(8)
+    keys = [CacheKey(bucket_n=32, nrhs=1, batch=2, dtype=dt,
+                     engine="blocked", refine_steps=2)
+            for dt in ("float32", "bfloat16", "bf16x3")]
+    assert len(set(keys)) == 3
+    exes = [cache.get(k) for k in keys]
+    assert len({id(e) for e in exes}) == 3 and len(cache) == 3
+    rng = np.random.default_rng(0)
+    a = np.stack([rng.standard_normal((32, 32)) + 32 * np.eye(32)
+                  for _ in range(2)])
+    b = rng.standard_normal((2, 32, 1))
+    for key, exe in zip(keys, exes):
+        assert isinstance(exe, BatchedExecutable)
+        x = exe.solve(a, b)
+        rel = max(checks.residual_norm(a[i], x[i], b[i], relative=True)
+                  for i in range(2))
+        assert rel <= 1e-4, (key.dtype, rel)
+
+
+def test_loadgen_dtype_token():
+    from gauss_tpu.serve.loadgen import parse_mix
+
+    specs = parse_mix("random:64,dtype:bfloat16/64*2,dtype:bf16x3/32")
+    assert [(s.kind, s.arg, s.dtype) for s, _ in specs] == [
+        ("random", "64", None), ("random", "64", "bfloat16"),
+        ("random", "32", "bf16x3")]
+    assert specs[1][1] == 2.0
+    with pytest.raises(ValueError, match="bad dtype"):
+        parse_mix("dtype:float8/64")
+    with pytest.raises(ValueError, match="bad size"):
+        parse_mix("dtype:bfloat16/0")
+    with pytest.raises(ValueError, match="bad size"):
+        parse_mix("dtype:bfloat16")
+
+
+def test_serve_dtype_lanes_end_to_end(rng):
+    """A server mixing f32 and bf16 requests: same-bucket different-dtype
+    requests never share a batch or an executable, every solution passes
+    the verify gate, and both dtype entries exist in the cache."""
+    from gauss_tpu.serve.admission import ServeConfig
+    from gauss_tpu.serve.server import SolverServer
+
+    cfg = ServeConfig(ladder=(32, 64), max_batch=4, refine_steps=2,
+                      verify_gate=1e-4)
+    with SolverServer(cfg) as server:
+        handles = []
+        operands = []
+        for i in range(6):
+            a, b = _system(rng, 48)
+            dt = "bfloat16" if i % 2 else None  # None -> cfg default f32
+            operands.append((a, b))
+            handles.append(server.submit(a, b, dtype=dt))
+        results = [h.result(120.0) for h in handles]
+        for (a, b), res in zip(operands, results):
+            assert res.ok, res.error
+            assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+        key_dtypes = {k.dtype for k in server.cache.keys()}
+    assert key_dtypes == {"float32", "bfloat16"}
+
+
+# --- the throughput record --------------------------------------------------
+
+
+def test_throughput_bench_and_ratchet(tmp_path):
+    """The batched solves/sec leg: summary shape, verified-only history
+    derivation, regress ingest of the kind, and the committed ratchet
+    entries that gate the record from this PR on."""
+    from gauss_tpu.bench import throughput as tput
+    from gauss_tpu.obs import regress
+
+    summary = tput.measure_throughput(ns=[48], batch=2, reps=1, seed=1)
+    (leg,) = summary["legs"]
+    assert leg["verified"] and leg["s_per_solve"] > 0
+    assert leg["dtype"] == "float32" and leg["refine_steps"] == 1
+    recs = tput.history_records(summary)
+    assert recs == [("tput:float32/n48/b2/s_per_solve",
+                     leg["s_per_solve"], "s")]
+    # Unverified legs never become baselines.
+    bad = dict(summary, legs=[dict(leg, verified=False)])
+    assert tput.history_records(bad) == []
+    # regress ingests the kind.
+    p = tmp_path / "tput.json"
+    p.write_text(json.dumps(summary))
+    ingested = regress.ingest_file(p)
+    assert [r["metric"] for r in ingested] == [recs[0][0]]
+    # The record is ratcheted like the latency headline: committed
+    # baselines + explicit ceilings, gated by the same evaluator.
+    for n in (256, 1024, 2048):
+        assert f"tput:float32/n{n}/b8/s_per_solve" in \
+            regress.RATCHET_BASELINES
+    assert regress.RATCHET_CEILINGS[
+        "tput:float32/n2048/b8/s_per_solve"] == 1.4
+    best = regress.RATCHET_BASELINES["tput:float32/n2048/b8/s_per_solve"]
+    assert regress.evaluate_ratchet(
+        "tput:float32/n2048/b8/s_per_solve",
+        best * 1.5)["status"] == "out-of-band"
+    assert regress.evaluate_ratchet(
+        "tput:float32/n2048/b8/s_per_solve",
+        best * 1.2)["status"] == "ok"
+
+
+def test_throughput_epochs_committed():
+    """3 seeded epochs per record size in the committed history (the
+    acceptance artifact)."""
+    from gauss_tpu.obs import regress
+
+    hist = regress.load_history(
+        os.path.join(REPO, "reports", "history.jsonl"))
+    for n in (256, 1024, 2048):
+        vals = [r["value"] for r in hist
+                if r["metric"] == f"tput:float32/n{n}/b8/s_per_solve"]
+        assert len(vals) >= 3, n
+        assert min(vals) <= regress.RATCHET_BASELINES[
+            f"tput:float32/n{n}/b8/s_per_solve"] * 1.0001
+
+
+# --- provenance: grid --dtype metric isolation ------------------------------
+
+
+def test_cell_metric_carries_dtype():
+    """Lowered grid cells enter history as their own metrics; f32/absent
+    keeps every pre-existing name."""
+    from gauss_tpu.obs import regress
+
+    base = {"suite": "gauss-internal", "key": "2048", "backend": "tpu",
+            "span": "device"}
+    assert regress._cell_metric(base) == \
+        "cell:gauss-internal/2048/tpu@device"
+    assert regress._cell_metric(dict(base, dtype="float32")) == \
+        "cell:gauss-internal/2048/tpu@device"
+    assert regress._cell_metric(dict(base, dtype="bfloat16")) == \
+        "cell:gauss-internal/2048/tpu@device@bfloat16"
+
+
+def test_grid_cell_dtype_field_default():
+    from gauss_tpu.bench.grid import Cell
+
+    c = Cell("gauss-internal", "64", "tpu", 1.0, True, 0.0, None)
+    assert c.dtype == "float32"
