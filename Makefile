@@ -10,11 +10,13 @@ STRUCT_SMOKE ?= /tmp/gauss_structure_check
 TUNE_SMOKE ?= /tmp/gauss_tune_check
 LIVE_SMOKE ?= /tmp/gauss_live_check
 ABFT_SMOKE ?= /tmp/gauss_abft_check
+DURABLE_SMOKE ?= /tmp/gauss_durable_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
-	structure-check tune-check live-check abft-check clean
+	structure-check tune-check live-check abft-check durable-check clean
 
-# The timing-gated gates (obs/serve/structure/tune/faults/live/abft-check)
+# The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
+# durable-check)
 # are regress-gated through obs.regress noise bands calibrated on an
 # UNCONTENDED box: running them concurrently — with each other, or with
 # the test suite — pushes s_per_case / s_per_solve out of band and fails
@@ -213,6 +215,38 @@ abft-check:
 	assert sd and sd[0]['detections']['total'] >= 100 \
 	  and sd[0]['injected']['total'] >= 100, sd; \
 	print('abft-check: sdc summary ok:', sd[0]['detections'])"
+
+# The durability gate (CI-callable): the kill-the-server chaos campaign —
+# >= 30 seeded crash/torn-write/resume cases (in-process batch-boundary
+# crashes + REAL os._exit subprocess kills via the server_kill /
+# journal_torn_write fault kinds, plus a supervised auto-restart leg)
+# against the write-ahead request journal; the invariant is 100% of
+# admitted requests reaching exactly one terminal status (served results
+# re-verified by the campaign at the 1e-4 gate from the journaled
+# operands), zero duplicate terminals, and zero duplicate solves under
+# idempotent resubmission (exit 2 on any violation). The overhead phase
+# measures journal-on seconds-per-request against the same journal-off
+# plan (regress-gated; journal-off stays inside the pre-existing
+# serve-check band). Then the recorded stream is asserted to carry a
+# durability summary and every trace in it must hold exactly one terminal
+# ACROSS the in-process crashes (requesttrace --check — replayed
+# terminals complete the original trace trees). Timing-gated: honor the
+# serial-ordering note above.
+durable-check:
+	rm -rf $(DURABLE_SMOKE) && mkdir -p $(DURABLE_SMOKE)
+	timeout -k 10 540 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.serve.durablecheck --cases 28 --seed 258458 \
+	  --tmpdir $(DURABLE_SMOKE) \
+	  --metrics-out $(DURABLE_SMOKE)/durable.jsonl \
+	  --summary-json $(DURABLE_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(DURABLE_SMOKE)/durable.jsonl \
+	  --json | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	du=[r['durability'] for r in runs.values() if r.get('durability')]; \
+	assert du and du[0]['resumes']['replayed'] >= 10 \
+	  and du[0]['deduped'] >= 1, du; \
+	print('durable-check: durability summary ok:', du[0]['resumes'])"
+	$(PYTHON) -m gauss_tpu.obs.requesttrace $(DURABLE_SMOKE)/durable.jsonl \
+	  --check > /dev/null
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -568,6 +568,34 @@ def _failure_note(stage: str, e: Exception, limit: int = 500) -> str:
     return f"{stage}: {type(e).__name__}: {msg}"
 
 
+def _infra_retryable(e: Exception) -> bool:
+    """Is this failure INFRA-class — transport/daemon/device-runtime noise
+    rather than a deterministic bug? The classifier keys on the same
+    signals :func:`_failure_note` already strips for readability:
+    timestamped device-daemon log lines buried in the message, plus the
+    canonical gRPC/runtime markers (UNAVAILABLE, DEADLINE_EXCEEDED, socket
+    resets, tunnel drops). Shape/value/assertion failures replay the same
+    bug on a retry and are never classified infra."""
+    import re
+
+    if isinstance(e, (ValueError, TypeError, AssertionError)):
+        return False
+    msg = " ".join(str(e).split())
+    if re.search(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}", msg):
+        return True  # device-daemon log lines ride only transport failures
+    return bool(re.search(
+        r"UNAVAILABLE|DEADLINE_EXCEEDED|ABORTED|Socket closed"
+        r"|[Cc]onnection (?:reset|refused|closed|aborted)"
+        r"|tunnel|[Hh]eartbeat", msg))
+
+
+def _utc_stamp() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
 def _ctx_note(suite: str, ctx) -> str:
     """Provenance note carried by every cell of a prepared key — including
     cells whose run() later fails (the source is known the moment prep
@@ -674,18 +702,65 @@ def run_suite(suite: str, keys: Sequence, backends: Sequence[str],
                 except Exception as e:  # keep the sweep on backend failure
                     print(f"bench-grid: {suite}/{key_label}/{backend} "
                           f"failed: {e}", file=sys.stderr)
-                    # The exception text rides in the cell's note: a FAILED
-                    # cell must be diagnosable from the JSON alone (VERDICT
-                    # round 2 weak #2 — a crash that records nothing is
-                    # indistinguishable from a verification failure).
-                    note = _ctx_note(suite, ctx)
-                    fail = _failure_note("failed", e)
-                    cell = Cell(suite, str(key), backend, 0.0, False,
-                                float("nan"),
-                                baselines.reference_seconds(suite, key,
-                                                            backend),
-                                span=_cell_span(suite, backend, span),
-                                note=f"{note}; {fail}" if note else fail)
+                    t_fail = _utc_stamp()
+                    first_fail = _failure_note("failed", e)
+                    cell = None
+                    if _infra_retryable(e):
+                        # ONE bounded retry, infra-class failures only: a
+                        # daemon hiccup mid-sweep costs a whole cell (and
+                        # on long device sweeps, the rerun costs hours).
+                        # The retried cell records BOTH timestamps — the
+                        # note must show the cell is a second attempt, not
+                        # a clean first run.
+                        print(f"bench-grid: {suite}/{key_label}/{backend} "
+                              f"infra-class failure; retrying once",
+                              file=sys.stderr, flush=True)
+                        obs.emit("cell_retry", suite=suite, key=key_label,
+                                 backend=backend, error=first_fail[:200])
+                        try:
+                            with obs.span(
+                                    f"cell:{suite}/{key_label}/{backend}"
+                                    f"/retry", suite=suite, key=key_label,
+                                    backend=backend, retry=True):
+                                cell = run(ctx, key, backend, run_t,
+                                           span=span)
+                        except Exception as e2:
+                            # Reproduced: stays FAILED honestly, carrying
+                            # both attempts' evidence.
+                            print(f"bench-grid: {suite}/{key_label}/"
+                                  f"{backend} retry failed: {e2}",
+                                  file=sys.stderr)
+                            first_fail = (
+                                f"{first_fail} [at {t_fail}]; retry "
+                                f"reproduced at {_utc_stamp()}: "
+                                f"{_failure_note('failed', e2)}")
+                        else:
+                            retry_note = (f"retried: infra-class failure "
+                                          f"at {t_fail} -> succeeded at "
+                                          f"{_utc_stamp()}; first: "
+                                          f"{first_fail}")
+                            cell = replace(
+                                cell, note=(f"{cell.note}; {retry_note}"
+                                            if cell.note else retry_note))
+                            print(f"bench-grid: {suite}/{key_label}/"
+                                  f"{backend} retry -> "
+                                  f"{cell.seconds:.6f}s "
+                                  f"verified={cell.verified}",
+                                  file=sys.stderr, flush=True)
+                    if cell is None:
+                        # The exception text rides in the cell's note: a
+                        # FAILED cell must be diagnosable from the JSON
+                        # alone (VERDICT round 2 weak #2 — a crash that
+                        # records nothing is indistinguishable from a
+                        # verification failure).
+                        note = _ctx_note(suite, ctx)
+                        cell = Cell(suite, str(key), backend, 0.0, False,
+                                    float("nan"),
+                                    baselines.reference_seconds(suite, key,
+                                                                backend),
+                                    span=_cell_span(suite, backend, span),
+                                    note=(f"{note}; {first_fail}"
+                                          if note else first_fail))
                 else:
                     print(f"bench-grid: {suite}/{key_label}/{backend} -> "
                           f"{cell.seconds:.6f}s verified={cell.verified}",

@@ -269,6 +269,22 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "durable_campaign":
+        # A kill-the-server campaign summary (python -m gauss_tpu.serve
+        # .durablecheck --summary-json): per-case recovery cost and the
+        # journal-on serving cost enter history — the journal getting more
+        # expensive, or recovery getting slower, gates exactly like a perf
+        # regression (the exactly-once INVARIANT itself is a hard exit-2,
+        # not a band). Derivation lives with the campaign runner (single
+        # source); lazy import keeps jax out of this module.
+        from gauss_tpu.serve.durablecheck import history_records as \
+            durable_hist
+
+        for metric, value, unit in durable_hist(doc):
+            rec = _record(metric, value, path, "durable", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, list):  # bench-grid --json cells
         for cell in doc:
             if isinstance(cell, dict) and cell.get("verified"):

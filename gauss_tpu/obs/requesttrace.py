@@ -49,8 +49,8 @@ TERMINAL_STATUSES = ("ok", "rejected", "expired", "failed", "cancelled")
 #: event types that are per-request stages (single ``trace``) or shared
 #: batch stages (``traces`` list) in a request tree
 _STAGE_TYPES = ("serve_admit", "serve_request", "serve_batch", "serve_cache",
-                "serve_retry", "serve_fallback", "span", "recovery", "route",
-                "fault", "fleet", "health")
+                "serve_retry", "serve_fallback", "serve_dedup", "span",
+                "recovery", "route", "fault", "fleet", "health")
 
 
 def mint() -> str:

@@ -227,6 +227,66 @@ def _serving_lines(sv: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def durability_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the durable-admission events (``journal`` lifecycle from
+    gauss_tpu.serve.durable, ``serve_resume`` recovery reports,
+    ``serve_dedup`` idempotent-resubmission hits, ``serve_supervisor``
+    watchdog transitions) into one report. Empty dict when the run used no
+    journal — journal-off runs carry no durability noise."""
+    journal = [ev for ev in events if ev.get("type") == "journal"]
+    resumes = [ev for ev in events if ev.get("type") == "serve_resume"]
+    # Idempotent dedupe shows up two ways: a journaled-terminal hit emits
+    # its terminal ``serve_request`` with deduped=True; an in-flight hit
+    # (key already pending) emits a ``serve_dedup`` attach event.
+    dedups = ([ev for ev in events if ev.get("type") == "serve_dedup"]
+              + [ev for ev in events if ev.get("type") == "serve_request"
+                 and ev.get("deduped")])
+    sup = [ev for ev in events if ev.get("type") == "serve_supervisor"]
+    if not (journal or resumes):
+        return {}
+    jevents: Dict[str, int] = {}
+    torn = 0
+    for ev in journal:
+        k = str(ev.get("event", "?"))
+        jevents[k] = jevents.get(k, 0) + 1
+        if k == "torn_tail":
+            torn += int(ev.get("dropped", 0) or 0)
+    sup_events: Dict[str, int] = {}
+    for ev in sup:
+        k = str(ev.get("event", "?"))
+        sup_events[k] = sup_events.get(k, 0) + 1
+    return {
+        "journal_events": jevents,
+        "torn_dropped": torn,
+        "resumes": {"count": len(resumes),
+                    "replayed": sum(int(ev.get("replayed", 0) or 0)
+                                    for ev in resumes),
+                    "expired": sum(int(ev.get("expired", 0) or 0)
+                                   for ev in resumes),
+                    "clean": sum(1 for ev in resumes if ev.get("clean"))},
+        "deduped": len(dedups),
+        "supervisor": sup_events,
+    }
+
+
+def _durability_lines(du: Dict[str, Any]) -> List[str]:
+    lines = []
+    je = ", ".join(f"{k} x{v}"
+                   for k, v in sorted(du["journal_events"].items()))
+    lines.append(f"  journal: {je or '-'}"
+                 + (f"; {du['torn_dropped']} torn record(s) dropped"
+                    if du["torn_dropped"] else ""))
+    r = du["resumes"]
+    lines.append(f"  resumes: {r['count']} ({r['replayed']} replayed, "
+                 f"{r['expired']} expired-in-recovery, {r['clean']} clean); "
+                 f"{du['deduped']} idempotent dedupe(s)")
+    if du["supervisor"]:
+        sv = ", ".join(f"{k} x{v}"
+                       for k, v in sorted(du["supervisor"].items()))
+        lines.append(f"  supervisor: {sv}")
+    return lines
+
+
 def slo_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the live plane's ``alert`` transitions (obs.slo burn-rate
     alerts) into per-SLO fire/clear counts with the last observed burn
@@ -614,6 +674,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "profile": flat_profile(evs),
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
         "serving": serving_summary(evs),
+        "durability": durability_summary(evs),
         "slo": slo_summary(evs),
         "structure": structure_summary(evs),
         "resilience": resilience_summary(evs),
@@ -670,6 +731,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("serving:")
         out.extend(_serving_lines(serving))
+
+    durability = durability_summary(evs)
+    if durability:
+        out.append("")
+        out.append("durability (request journal):")
+        out.extend(_durability_lines(durability))
 
     slo = slo_summary(evs)
     if slo:

@@ -35,6 +35,16 @@ Five phases:
   wrong tag, forced through the ``structure.detect`` mis-tag hook; the
   router must demote down the recovery ladder to general LU and end with
   an independently verified solution or a typed error.
+- **durable** (``--no-durable`` to skip): the serving plane killed and
+  restarted against its write-ahead request journal
+  (gauss_tpu.serve.durable) — one in-process case per crash kind (batch-
+  boundary crash, torn terminal append, clean drain, resume-under-load);
+  the invariant is the durability contract: every admitted request reaches
+  exactly one journaled terminal (served results re-verified by the
+  runner), and idempotent resubmission never re-solves. The case runner is
+  shared with ``make durable-check`` (gauss_tpu.serve.durablecheck — the
+  deep campaign, with REAL os._exit subprocess kills); this phase keeps
+  the invariant inside the one chaos gate.
 - **sdc** (``--sdc-cases``, 0 disables): ON-DEVICE silent data corruption
   — seeded ``sdc_bitflip`` faults at the ABFT panel-group sites of the
   checksum-carrying LU and Cholesky engines
@@ -406,6 +416,40 @@ def run_sdc_phase(cases: int, seed: int, gate: float, log=print) -> Dict:
     return summ
 
 
+def run_durable_phase(seed: int, gate: float, tmpdir: str) -> Dict:
+    """Kill-the-server chaos: one in-process case per crash kind against
+    the write-ahead request journal (the deep campaign with real
+    subprocess kills is ``make durable-check``; the runner is shared)."""
+    from gauss_tpu import obs
+    from gauss_tpu.serve import durablecheck
+    from gauss_tpu.serve.cache import ExecutableCache
+
+    cache = ExecutableCache(32)
+    ddir = os.path.join(tmpdir, "durable")
+    os.makedirs(ddir, exist_ok=True)
+    cases: List[Dict] = []
+    with obs.span("chaos_durable_phase"):
+        for i, kind in enumerate(durablecheck.CASE_KINDS):
+            try:
+                cases.append(durablecheck.run_recovery_case(
+                    i, seed, gate, ddir, kind, cache=cache))
+            except Exception as e:  # noqa: BLE001 — untyped escape IS the bug
+                cases.append({"case": i, "kind": kind,
+                              "outcome": "violation",
+                              "error": f"{type(e).__name__}: {e}"[:200]})
+    # NOTE: these crashes are driven by the server's _crash() chaos hook,
+    # not the inject module — they are deliberately NOT counted in the
+    # campaign's "injected" fault total (no ``fault`` events exist for
+    # them in the stream; the resilience summary must keep reconciling
+    # with the injected count).
+    return {"ran": True, "cases": cases,
+            "admitted": sum(c.get("audit", {}).get("admitted", 0)
+                            for c in cases),
+            "crashes": len(cases),
+            "violations": sum(1 for c in cases
+                              if c["outcome"] == "violation")}
+
+
 def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
     """(metric, value, unit) records a campaign contributes to the
     regression history. All slow-side-gated: recovery regressing shows as a
@@ -456,6 +500,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(subprocess workers; the slowest phase)")
     p.add_argument("--no-structure", action="store_true",
                    help="skip the structured-solve mis-tag phase")
+    p.add_argument("--no-durable", action="store_true",
+                   help="skip the kill-the-server journal-recovery phase")
     p.add_argument("--sdc-cases", type=int, default=12,
                    help="on-device sdc_bitflip cases against the ABFT "
                         "checksum engines (0 disables; the deep campaign "
@@ -506,6 +552,8 @@ def main(argv=None) -> int:
                else run_fleet_phase(args.seed, args.gate))
         struct = ({} if args.no_structure
                   else run_structure_phase(args.seed, args.gate))
+        dur = ({} if args.no_durable
+               else run_durable_phase(args.seed, args.gate, args.tmpdir))
         sdc = (run_sdc_phase(args.sdc_cases, args.seed, args.gate)
                if args.sdc_cases > 0 else {})
         wall = round(time.perf_counter() - t0, 3)
@@ -517,6 +565,7 @@ def main(argv=None) -> int:
                       + (0 if not ckpt or ckpt["bit_identical"] else 1)
                       + (flt.get("violations", 0) if flt else 0)
                       + (struct.get("violations", 0) if struct else 0)
+                      + (dur.get("violations", 0) if dur else 0)
                       + (sdc.get("violations", 0) if sdc else 0))
         injected = (solver["injected"] + (serve.get("injected", 0))
                     + (ckpt.get("injected", 0) if ckpt else 0)
@@ -542,7 +591,7 @@ def main(argv=None) -> int:
             "engines": engines, "sizes": sizes, "gate": args.gate,
             "injected": injected, "injected_by_site": sites,
             "solver": solver, "serve": serve, "checkpoint": ckpt,
-            "fleet": flt, "structure": struct, "sdc": sdc,
+            "fleet": flt, "structure": struct, "durable": dur, "sdc": sdc,
             "wall_s": wall, "invariant_ok": violations == 0,
         }
         obs.emit("chaos_campaign",
@@ -580,6 +629,14 @@ def main(argv=None) -> int:
         print(f"  structure: {len(struct['cases'])} mis-tag case(s) -> "
               f"{by_outcome}, {struct['demotions']} demotion(s), "
               f"{struct['violations']} violation(s)")
+    if dur:
+        by_outcome = {}
+        for c in dur["cases"]:
+            by_outcome[c["outcome"]] = by_outcome.get(c["outcome"], 0) + 1
+        print(f"  durable: {dur['crashes']} kill/resume case(s) "
+              f"({'+'.join(c['kind'] for c in dur['cases'])}) -> "
+              f"{by_outcome}, {dur['admitted']} admitted, "
+              f"{dur['violations']} violation(s)")
     if sdc:
         print(f"  sdc: {sdc['cases']} on-device case(s), "
               f"{sdc['injected']} bitflip(s) -> detect rate "

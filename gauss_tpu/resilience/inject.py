@@ -18,6 +18,17 @@ in docs/RESILIENCE.md):
                             executable build — gauss_tpu.serve.cache
     serve.worker.dispatch   delay the serve worker before dispatch (deadline
                             pressure) — gauss_tpu.serve.server
+    serve.server.batch      kill the whole serving process (os._exit) at a
+                            seeded batch BOUNDARY (kind ``server_kill``;
+                            ``skip`` picks the batch) — the crash the
+                            write-ahead request journal must recover from —
+                            gauss_tpu.serve.server worker loop
+    serve.journal.append    tear the journal's live segment MID-RECORD
+                            (kind ``journal_torn_write``: a prefix of the
+                            record is written, then the process dies —
+                            ``param`` in (0,1) picks the tear fraction);
+                            recovery must drop the torn tail by
+                            construction — gauss_tpu.serve.durable
     dist.multihost.straggler  sleep ``param`` seconds in multihost
                             initialize — gauss_tpu.dist.multihost
     dist.multihost.worker   kill the worker process (os._exit) or stall it
@@ -97,8 +108,14 @@ CORRUPT_KINDS = ("nan", "inf", "bitflip", "near_zero_pivot")
 #: ``sdc_bitflip`` flips one bit of one ON-DEVICE array element at an ABFT
 #: panel-group site (the corruption is applied by the owning runner via
 #: :func:`poll_sdc` — this module never touches device arrays itself).
+#: ``server_kill`` is the serving-process analog of ``kill`` (os._exit at
+#: the serve worker's batch-boundary hook — a distinct name so a campaign
+#: can aim at the SERVER without also arming worker/fleet kill sites);
+#: ``journal_torn_write`` tears the live journal segment mid-record and
+#: dies (applied by gauss_tpu.serve.durable via :func:`poll_torn_write` —
+#: only the journal knows its own record boundaries).
 ACTION_KINDS = ("raise", "compile_fail", "delay", "kill", "stall", "mistag",
-                "sdc_bitflip")
+                "sdc_bitflip", "server_kill", "journal_torn_write")
 KINDS = CORRUPT_KINDS + ACTION_KINDS
 
 #: exit status used by kind="kill" — distinctive, so a harness can tell an
@@ -408,6 +425,21 @@ def poll_sdc(site: str):
     return sp, ap.rng_for(sp)
 
 
+def poll_torn_write(site: str):
+    """Poll ``site`` for a torn journal write (kind ``journal_torn_write``).
+    Returns the spec when one fires — the JOURNAL applies the tear itself
+    (write a prefix of the record, then die: only it knows its record
+    boundaries) — else None. Other kinds at the site are ignored (wrong
+    hook shape); the trigger still counts and emits its ``fault`` event."""
+    ap = _ACTIVE
+    if ap is None:
+        return None
+    sp = ap.poll(site)
+    if sp is None or sp.kind != "journal_torn_write":
+        return None
+    return sp
+
+
 def maybe_delay(site: str) -> float:
     """Poll ``site``; kind ``delay`` sleeps ``param`` seconds (straggler /
     deadline-pressure injection). Returns the seconds slept."""
@@ -430,7 +462,7 @@ def maybe_kill(site: str) -> None:
     sp = poll(site)
     if sp is None:
         return
-    if sp.kind == "kill":
+    if sp.kind in ("kill", "server_kill"):
         os._exit(KILL_EXIT_CODE)
     if sp.kind == "stall":
         while True:  # pragma: no cover — only ends by external kill

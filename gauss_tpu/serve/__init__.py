@@ -46,4 +46,8 @@ from gauss_tpu.serve.cache import (  # noqa: F401
     CacheKey,
     ExecutableCache,
 )
+from gauss_tpu.serve.durable import (  # noqa: F401
+    JournalError,
+    RequestJournal,
+)
 from gauss_tpu.serve.server import SolverServer  # noqa: F401

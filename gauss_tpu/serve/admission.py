@@ -99,6 +99,28 @@ class ServeConfig:
     #                                 factor — degradation starts before
     #                                 the deadline cliff, not at it
     degraded_queue_factor: float = 0.5  # admission bound scale under alert
+    # -- durable admission (gauss_tpu.serve.durable) -----------------------
+    journal_dir: Optional[str] = None  # write-ahead request journal: every
+    #                                    admit/terminal is journaled (CRC'd
+    #                                    JSONL segments) and a restart
+    #                                    replays unterminated admits. None
+    #                                    (default) = journal off — the serve
+    #                                    path is byte-identical to pre-
+    #                                    journal behavior (one is-None check
+    #                                    at admission)
+    journal_fsync_batch: int = 8    # fsync every N journal appends (group
+    #                                 commit; shutdown marker + rotation
+    #                                 always fsync)
+    journal_rotate_records: int = 4096  # compact the live segment past this
+    #                                     many records (tmp+fsync+rename)
+    resume: bool = True             # with a journal: replay unterminated
+    #                                 admits at start() (in-deadline ones
+    #                                 re-solve, expired ones get a typed
+    #                                 STATUS_EXPIRED terminal). False =
+    #                                 journal new traffic only
+    heartbeat_path: Optional[str] = None  # worker-loop liveness file for
+    #                                       the supervisor (durable
+    #                                       .supervise); None = off
 
 
 @dataclasses.dataclass
@@ -109,6 +131,11 @@ class ServeResult:
     x: Optional[np.ndarray] = None
     lane: Optional[str] = None       # "batched" | "handoff" | "numpy"
     bucket_n: Optional[int] = None
+    #: the request's end-to-end trace id, stamped at resolve so EVERY
+    #: client-visible outcome — including synchronous admission rejects —
+    #: can be joined against the obs stream (the loadgen-visible half of
+    #: request tracing; the terminal obs events have carried it since PR 8).
+    trace: Optional[str] = None
     latency_s: Optional[float] = None
     queue_s: Optional[float] = None
     retry_after_s: Optional[float] = None
@@ -133,7 +160,8 @@ class ServeRequest:
     def __init__(self, a: np.ndarray, b: np.ndarray,
                  deadline_s: Optional[float] = None,
                  structure: Optional[str] = None,
-                 dtype: Optional[str] = None):
+                 dtype: Optional[str] = None,
+                 request_id: Optional[str] = None):
         from gauss_tpu.obs import requesttrace
 
         with ServeRequest._ids_lock:
@@ -142,6 +170,21 @@ class ServeRequest:
         #: every event this request touches (obs.requesttrace folds the
         #: stream back into one span tree per request).
         self.trace_id = requesttrace.mint()
+        #: client-supplied idempotency key (durable serving): journaled
+        #: with the admit/terminal records so a resubmission after a crash
+        #: dedupes against the journal instead of re-solving. None = the
+        #: request has no cross-restart identity.
+        self.request_id = request_id
+        #: journal identity — the id the durable layer pairs admit/terminal
+        #: records under. Defaults to this request's id; RECOVERY replays
+        #: set it to the original (journaled) id so the replayed terminal
+        #: pairs with the original admit.
+        self.journal_id = self.id
+        #: terminal hook: the durable layer installs its journal append
+        #: here at admission; resolve() calls it EXACTLY when the CAS is
+        #: won, so journal terminals inherit the one-terminal guarantee.
+        #: None (no journal) costs one is-None check.
+        self._on_terminal = None
         self.a = np.asarray(a)
         self.b = np.asarray(b)
         #: structure routing tag ("spd" / "banded" / "blockdiag" / "dense"),
@@ -166,6 +209,10 @@ class ServeRequest:
         self.t_submit = time.perf_counter()
         self.deadline = (self.t_submit + deadline_s
                          if deadline_s is not None else None)
+        #: wall-clock deadline (the journalable form: perf_counter has no
+        #: meaning across a process restart)
+        self.deadline_unix = (time.time() + deadline_s
+                              if deadline_s is not None else None)
         self._done = threading.Event()
         self._resolve_lock = threading.Lock()
         self._result: Optional[ServeResult] = None
@@ -187,6 +234,19 @@ class ServeRequest:
             if self._result is not None:
                 return False
             result.latency_s = time.perf_counter() - self.t_submit
+            result.trace = self.trace_id
+            hook = self._on_terminal
+            if hook is not None:
+                # The durable layer's terminal append — BEFORE the done
+                # event: a client must never observe a terminal the
+                # journal doesn't hold yet (a fast keyed resubmission
+                # would miss the dedupe map and re-solve). Runs only on
+                # the WINNING resolve, so the journal carries exactly one
+                # terminal per request; the hook never raises (journal
+                # failures are counted, not propagated). The lock is
+                # per-request — the append cost blocks only this
+                # request's waiters.
+                hook(self, result)
             self._result = result
             self._done.set()
             return True

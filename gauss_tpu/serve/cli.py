@@ -21,9 +21,51 @@ Examples::
 from __future__ import annotations
 
 import argparse
+import os
+import signal
 import sys
 
 from gauss_tpu.utils.env import honor_jax_platforms
+
+
+def _install_drain_handler(server) -> None:
+    """SIGTERM = graceful drain (journal runs only): stop admitting, serve
+    what was accepted, journal the clean-shutdown marker, exit cleanly.
+    The handler runs in the main thread between bytecodes; stop() is
+    thread-safe against the worker and any in-flight client waits (their
+    requests resolve as served or rejected — exactly one terminal each)."""
+    def _drain(signum, frame):
+        print("gauss-serve: SIGTERM — draining (clean-shutdown marker "
+              "journaled on completion)", file=sys.stderr)
+        server.stop(drain=True)
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _drain)
+    except ValueError:  # pragma: no cover — not the main thread
+        pass
+
+
+def _run_supervised(args, argv) -> int:
+    """``--supervised``: re-exec this same serve command as a CHILD under
+    gauss_tpu.serve.durable.supervise (the PR-5 fleet watchdog pattern).
+    Died/stalled children restart against the same journal; the journal's
+    resume makes the restart correct and --compile-cache makes it warm."""
+    from gauss_tpu import obs
+    from gauss_tpu.serve import durable
+
+    if not args.journal:
+        print("gauss-serve: --supervised requires --journal (the restart "
+              "is only correct against a journal)", file=sys.stderr)
+        return 2
+    child = [a for a in (argv if argv is not None else sys.argv[1:])
+             if a != "--supervised"]
+    child_argv = [sys.executable, "-m", "gauss_tpu.serve.cli"] + child
+    hb = os.path.join(args.journal, "heartbeat.json")
+    with obs.run(tool="gauss_serve_supervisor", journal=args.journal):
+        return durable.supervise(
+            child_argv, heartbeat_path=hb, max_restarts=args.max_restarts,
+            stall_after_s=args.stall_after)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -83,6 +125,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "the GAUSS_COMPILE_CACHE env). A second process "
                         "sharing DIR warms up from cached executables — "
                         "the report's warmup_s shows the delta")
+    # -- durable admission (gauss_tpu.serve.durable) -----------------------
+    p.add_argument("--journal", default=None, metavar="DIR",
+                   help="write-ahead request journal at DIR: every admit/"
+                        "terminal is journaled (CRC'd JSONL segments, "
+                        "batched fsync, atomic rotation) and a restarted "
+                        "server replays unterminated admits — exactly-once "
+                        "terminal statuses across kill -9 (docs/SERVING.md "
+                        "durability section)")
+    p.add_argument("--resume", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="with --journal: replay unterminated admits at "
+                        "start (in-deadline requests re-solve, expired "
+                        "ones get typed STATUS_EXPIRED terminals); "
+                        "--no-resume journals new traffic only "
+                        "(default: resume)")
+    p.add_argument("--request-ids", action="store_true",
+                   help="mint a deterministic idempotency key per loadgen "
+                        "request (submit(request_id=...)); with --journal, "
+                        "resubmissions after a crash dedupe against "
+                        "journaled terminals instead of re-solving")
+    p.add_argument("--supervised", action="store_true",
+                   help="wrap this serve run in the fleet watchdog "
+                        "pattern: a supervisor process restarts a died/"
+                        "stalled server against the same journal (requires "
+                        "--journal; warm restarts via --compile-cache)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="supervised mode: restart budget (default 3)")
+    p.add_argument("--stall-after", type=float, default=30.0, metavar="S",
+                   help="supervised mode: heartbeat staleness that calls "
+                        "a stall (default 30)")
     # -- live telemetry plane ---------------------------------------------
     p.add_argument("--live-port", type=int, default=None, metavar="PORT",
                    help="embed the live telemetry endpoint on PORT "
@@ -118,6 +190,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.supervised:
+        return _run_supervised(args, argv)
     honor_jax_platforms()
 
     from gauss_tpu.tune import compilecache
@@ -147,19 +221,28 @@ def main(argv=None) -> int:
         ladder=ladder, max_batch=args.max_batch, max_queue=args.max_queue,
         batch_linger_s=args.linger, cache_capacity=args.cache_capacity,
         refine_steps=args.refine_steps, panel=args.panel,
-        dtype=args.dtype, live_port=args.live_port, slo_shed=args.slo_shed)
+        dtype=args.dtype, live_port=args.live_port, slo_shed=args.slo_shed,
+        journal_dir=args.journal, resume=args.resume,
+        heartbeat_path=os.environ.get("GAUSS_SERVE_HEARTBEAT") or None)
     cfg = LoadgenConfig(
         mix=args.mix, requests=args.requests, warmup=args.warmup,
         mode=args.mode, concurrency=args.concurrency, rate=args.rate,
         nrhs=args.nrhs, seed=args.seed, deadline_s=args.deadline,
-        serve=serve_cfg)
+        request_ids=args.request_ids, serve=serve_cfg)
 
     with obs.run(metrics_out=args.metrics_out, tool="gauss_serve",
                  mode=args.mode, mix=args.mix, requests=args.requests):
         with SolverServer(serve_cfg) as server:
+            if args.journal:
+                # Graceful drain: SIGTERM -> stop admitting, flush
+                # in-flight batches, journal the clean-shutdown marker,
+                # exit 0 — the next start replays nothing.
+                _install_drain_handler(server)
             if server.live_url:
                 print(f"live telemetry: {server.live_url}/metrics "
                       f"(watch with: gauss-top --url {server.live_url})")
+            if args.journal and server.last_resume:
+                print(f"journal: {args.journal} resume={server.last_resume}")
             summary = run_load(server, cfg)
     print(format_summary(summary))
     if args.metrics_out:

@@ -1,0 +1,554 @@
+"""Durable admission: the write-ahead request journal and crash recovery.
+
+PR 5 made *worker* solves supervised and bit-identically resumable; PR 9
+made the *math* SDC-proof. But ``SolverServer`` itself was still one
+in-memory queue: ``kill -9`` the serving process mid-load and every admitted
+request silently vanished with no terminal status — exactly the
+exactly-one-terminal invariant PR 5/PR 8 established everywhere else. This
+module is the missing durability layer:
+
+- **Write-ahead journal.** With ``ServeConfig(journal_dir=...)`` every
+  admitted request appends an ``admit`` record (operands included) to an
+  append-only JSONL segment BEFORE ``submit()`` returns, and every terminal
+  resolution appends a ``terminal`` record from the same first-resolve-wins
+  CAS that already guarantees one terminal per request — so the journal
+  carries exactly one terminal per admit by construction. Each record line
+  is ``<crc32 hex> <json>``: a torn or truncated tail (kill mid-append, a
+  merged partial line) fails its CRC and is DROPPED at scan time, never a
+  crash — the journal parses to the longest valid record prefix no matter
+  where the crash landed.
+- **Batched fsync.** Appends flush to the OS on every record (a process
+  kill — the failure mode the chaos campaign injects — cannot lose flushed
+  bytes) and ``fsync`` every ``fsync_batch`` records plus at every
+  shutdown-marker/rotation boundary (group commit against power loss).
+- **Segment rotation.** When the live segment exceeds ``rotate_records``
+  the journal compacts: live (unterminated) admits plus the recent
+  idempotency terminals are rewritten into a fresh segment via the
+  ``dcheckpoint`` atomic-write idiom (tmp + fsync + rename + parent fsync)
+  and older segments are deleted — the journal's size tracks the live
+  request set, not the traffic history.
+- **Crash -> restart recovery.** On ``start()`` a server given a journal
+  with unterminated admits (and no clean-shutdown marker) replays them
+  through the normal dispatch path: still-in-deadline requests re-solve
+  (and re-verify at the configured gate), past-deadline ones resolve as a
+  typed ``STATUS_EXPIRED`` terminal. Replayed requests keep their ORIGINAL
+  trace ids, so a request's obs span tree completes across the crash —
+  ``requesttrace --check`` holds over kill -> restart.
+- **Exactly-once from the client's view.** ``submit(request_id=...)``
+  carries a client idempotency key into the journal; a resubmission whose
+  key already has a journaled terminal resolves immediately from the
+  journal — same status, same solution — without re-solving. (Execution is
+  at-least-once across a crash window — a request killed after compute but
+  before its terminal append is re-solved on recovery — but the terminal
+  status, and anything a keyed client can observe, is exactly-once.)
+- **Graceful drain.** ``stop(drain=True)`` — wired to SIGTERM in
+  ``gauss-serve`` — stops admitting, flushes in-flight batches, resolves
+  stragglers, and appends a clean-``shutdown`` marker so the next start
+  replays nothing.
+- **Supervision.** :func:`supervise` wraps the serving process in the PR-5
+  fleet watchdog pattern: liveness + heartbeat-file freshness distinguish
+  died from stalled, either one is restarted (bounded) against the SAME
+  journal — warm via the PR-7 persistent compile cache — and recovery
+  replays the dead process's unterminated admits. ``gauss-serve
+  --supervised`` is the CLI form.
+
+``journal_dir=None`` (the default) keeps all of this compiled out of the
+serve path: one ``is None`` check at admission and none at resolve (the
+terminal hook is only installed on journaled requests).
+
+Fault hooks (gauss_tpu.resilience.inject): ``serve.server.batch`` fires at
+every worker batch boundary (kind ``server_kill`` = os._exit — the honest
+SIGKILL stand-in) and ``serve.journal.append`` fires per record append
+(kind ``journal_torn_write`` writes a partial record then kills the
+process: a crash mid-append, the torn tail recovery must drop).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
+from gauss_tpu.resilience.checkpoint import fsync_dir
+
+#: journal record schema (bumped on incompatible record changes; a scan of
+#: a newer schema is a typed error, never a misparse)
+JOURNAL_SCHEMA = 1
+
+SEGMENT_PREFIX = "journal-"
+SEGMENT_SUFFIX = ".jsonl"
+
+#: idempotency terminals carried across a rotation compaction (the dedupe
+#: window: a keyed resubmission older than this many terminals may re-solve)
+IDEMPOTENCY_KEEP = 1024
+
+
+class JournalError(RuntimeError):
+    """The journal directory cannot be trusted (foreign schema, unreadable
+    directory). Torn/truncated RECORDS are never this — they are dropped by
+    construction; this is for damage recovery must not guess through."""
+
+
+# -- array codec -----------------------------------------------------------
+
+def encode_array(a: np.ndarray) -> Dict[str, Any]:
+    a = np.ascontiguousarray(a)
+    return {"shape": list(a.shape), "dtype": str(a.dtype),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def decode_array(doc: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(doc["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(doc["dtype"])).reshape(
+        doc["shape"]).copy()
+
+
+# -- record line codec -----------------------------------------------------
+
+def encode_record(doc: Dict[str, Any]) -> bytes:
+    body = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+    return f"{crc:08x} {body}\n".encode("utf-8")
+
+
+def decode_line(line: bytes) -> Optional[Dict[str, Any]]:
+    """One journal line -> record dict, or None when the line is torn —
+    short, CRC-mismatched (a partial record merged with the next append),
+    or not JSON. Never raises: a corrupt line is a dropped line."""
+    try:
+        text = line.decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+    if len(text) < 10 or text[8] != " ":
+        return None
+    crc_hex, body = text[:8], text[9:].rstrip("\n")
+    try:
+        crc = int(crc_hex, 16)
+    except ValueError:
+        return None
+    if zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF != crc:
+        return None
+    try:
+        doc = json.loads(body)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+# -- scan ------------------------------------------------------------------
+
+class JournalState:
+    """What a scan of a journal directory recovers: the admits still owed a
+    terminal, the idempotency map, and whether the last run shut down
+    cleanly."""
+
+    def __init__(self):
+        self.admits: Dict[int, Dict[str, Any]] = {}     # id -> admit record
+        self.order: List[int] = []                      # admit ids, in order
+        self.terminals: Dict[int, Dict[str, Any]] = {}  # id -> terminal
+        #: client idempotency key -> terminal record (the dedupe map)
+        self.by_rid: Dict[str, Dict[str, Any]] = {}
+        self.clean_shutdown = False
+        self.records = 0
+        self.torn_dropped = 0
+        self.max_id = 0
+
+    def live_admits(self) -> List[Dict[str, Any]]:
+        """Admit records with no terminal, in admission order — the replay
+        set."""
+        return [self.admits[i] for i in self.order if i not in self.terminals]
+
+    def apply(self, doc: Dict[str, Any]) -> None:
+        rec = doc.get("rec")
+        # Any record after a shutdown marker belongs to a NEWER run in the
+        # same directory: the marker only means "clean" when final.
+        if rec != "shutdown":
+            self.clean_shutdown = False
+        if rec == "admit":
+            rid = doc.get("id")
+            if isinstance(rid, int):
+                self.admits[rid] = doc
+                self.order.append(rid)
+                self.max_id = max(self.max_id, rid)
+        elif rec == "terminal":
+            rid = doc.get("id")
+            if isinstance(rid, int):
+                self.terminals.setdefault(rid, doc)
+                self.max_id = max(self.max_id, rid)
+            key = doc.get("rid")
+            if key:
+                self.by_rid.setdefault(str(key), doc)
+        elif rec == "shutdown":
+            self.clean_shutdown = True
+
+
+def segment_paths(dirpath: str) -> List[str]:
+    try:
+        names = sorted(n for n in os.listdir(dirpath)
+                       if n.startswith(SEGMENT_PREFIX)
+                       and n.endswith(SEGMENT_SUFFIX))
+    except FileNotFoundError:
+        return []
+    return [os.path.join(dirpath, n) for n in names]
+
+
+def scan(dirpath: str) -> JournalState:
+    """Fold every segment (oldest first) into a :class:`JournalState`.
+    Torn/truncated/merged lines are counted and dropped — by construction a
+    scan parses to the longest valid prefix of each segment and NEVER
+    raises on tail damage."""
+    state = JournalState()
+    for path in segment_paths(dirpath):
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        for line in data.split(b"\n"):
+            if not line:
+                continue
+            doc = decode_line(line + b"\n")
+            if doc is None:
+                state.torn_dropped += 1
+                continue
+            if doc.get("schema", JOURNAL_SCHEMA) > JOURNAL_SCHEMA:
+                raise JournalError(
+                    f"journal segment {path} carries schema "
+                    f"{doc.get('schema')} > {JOURNAL_SCHEMA}: refusing to "
+                    f"replay records this build cannot interpret")
+            state.records += 1
+            state.apply(doc)
+    return state
+
+
+# -- the journal -----------------------------------------------------------
+
+class RequestJournal:
+    """Append-only, CRC-per-record, segment-rotated request journal.
+
+    Thread-safe: client threads (admits, client-side cancels) and the
+    worker thread (terminals) append concurrently under one lock. All
+    appends go to the LIVE segment; a restart always opens a fresh segment
+    so recovery appends never extend a possibly-torn tail.
+    """
+
+    def __init__(self, dirpath: str, *, fsync_batch: int = 8,
+                 rotate_records: int = 4096):
+        self.dir = os.fspath(dirpath)
+        os.makedirs(self.dir, exist_ok=True)
+        self.fsync_batch = max(1, int(fsync_batch))
+        self.rotate_records = max(16, int(rotate_records))
+        self._lock = threading.Lock()
+        #: the state recovered from segments present at open (what a
+        #: restart replays); live appends do NOT update it.
+        self.recovered = scan(self.dir)
+        segs = segment_paths(self.dir)
+        if segs:
+            last = os.path.basename(segs[-1])
+            seq = int(last[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)]) + 1
+        else:
+            seq = 0
+        self._seq = seq
+        self._path = self._segment_path(seq)
+        self._f = open(self._path, "ab", buffering=0)
+        self._live_records = 0
+        #: rotate once the live segment holds this many records; reset
+        #: past each compaction to carried + rotate_records, so a large
+        #: carried set cannot re-trigger rotation on every append.
+        self._rotate_at = self.rotate_records
+        self._since_fsync = 0
+        self.appends = 0
+        self.fsyncs = 0
+        self.rotations = 0
+        self.closed = False
+        if self.recovered.torn_dropped:
+            obs.emit("journal", event="torn_tail",
+                     dropped=self.recovered.torn_dropped, dir=self.dir)
+        obs.emit("journal", event="open", dir=self.dir, segment=self._seq,
+                 recovered_records=self.recovered.records,
+                 live=len(self.recovered.live_admits()),
+                 clean_shutdown=self.recovered.clean_shutdown)
+
+    def _segment_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"{SEGMENT_PREFIX}{seq:06d}"
+                                      f"{SEGMENT_SUFFIX}")
+
+    # -- append paths ------------------------------------------------------
+
+    def _append(self, doc: Dict[str, Any], force_fsync: bool = False) -> None:
+        payload = encode_record(doc)
+        with self._lock:
+            if self.closed:
+                return
+            if _inject.enabled():
+                sp = _inject.poll_torn_write("serve.journal.append")
+                if sp is not None:
+                    # A crash MID-APPEND: a prefix of the record reaches the
+                    # file, the process dies before the rest. `param` (0,1)
+                    # picks the tear fraction; the torn line fails its CRC
+                    # at the next scan and is dropped by construction.
+                    frac = sp.param if 0 < sp.param < 1 else 0.5
+                    cut = max(1, int(len(payload) * frac))
+                    self._f.write(payload[:cut])
+                    os._exit(_inject.KILL_EXIT_CODE)
+            self._f.write(payload)  # unbuffered: flushed to the OS per record
+            self.appends += 1
+            self._live_records += 1
+            self._since_fsync += 1
+            if force_fsync or self._since_fsync >= self.fsync_batch:
+                os.fsync(self._f.fileno())
+                self.fsyncs += 1
+                self._since_fsync = 0
+            obs.counter("journal.appends")
+            rotate = self._live_records >= self._rotate_at
+        if rotate:
+            self.rotate()
+
+    def append_admit(self, *, id: int, request_id: Optional[str],
+                     trace: str, a: np.ndarray, b: np.ndarray,
+                     was_vector: bool, deadline_unix: Optional[float],
+                     dtype: Optional[str], structure: Optional[str]) -> None:
+        self._append({
+            "rec": "admit", "schema": JOURNAL_SCHEMA, "id": int(id),
+            "rid": request_id, "trace": trace,
+            "n": int(a.shape[0]), "k": 1 if was_vector else int(b.shape[1]),
+            "was_vector": bool(was_vector),
+            "deadline_unix": deadline_unix, "t_unix": time.time(),
+            "dtype": dtype, "structure": structure,
+            "a": encode_array(np.asarray(a, np.float64)),
+            "b": encode_array(np.asarray(b, np.float64)),
+        })
+
+    def append_terminal(self, *, id: int, request_id: Optional[str],
+                        trace: str, status: str,
+                        x: Optional[np.ndarray] = None,
+                        lane: Optional[str] = None,
+                        rel_residual: Optional[float] = None,
+                        error: Optional[str] = None) -> Dict[str, Any]:
+        doc = {"rec": "terminal", "schema": JOURNAL_SCHEMA, "id": int(id),
+               "rid": request_id, "trace": trace, "status": status,
+               "lane": lane, "t_unix": time.time(),
+               "rel_residual": (float(rel_residual)
+                               if rel_residual is not None else None),
+               "error": (str(error)[:500] if error else None)}
+        if x is not None:
+            doc["x"] = encode_array(np.asarray(x, np.float64))
+        self._append(doc)
+        return doc
+
+    def append_shutdown(self) -> None:
+        """The clean-shutdown marker: the next start replays nothing. Always
+        fsynced — this is the record whose absence means 'crashed'."""
+        self._append({"rec": "shutdown", "schema": JOURNAL_SCHEMA,
+                      "t_unix": time.time()}, force_fsync=True)
+        obs.emit("journal", event="shutdown_marker", dir=self.dir)
+
+    # -- rotation ----------------------------------------------------------
+
+    def rotate(self) -> None:
+        """Compact into a fresh segment: re-journal the still-live admits
+        plus the most recent :data:`IDEMPOTENCY_KEEP` keyed terminals (the
+        dedupe window), atomically (tmp + fsync + rename + dir fsync), then
+        delete the older segments. A kill at any instant leaves either the
+        old segments or the complete new one."""
+        with self._lock:
+            if self.closed:
+                return
+            os.fsync(self._f.fileno())
+            self.fsyncs += 1
+            self._since_fsync = 0
+            self._f.close()
+            state = scan(self.dir)
+            keep: List[Dict[str, Any]] = state.live_admits()
+            keyed = [t for t in state.terminals.values() if t.get("rid")]
+            keyed.sort(key=lambda t: t.get("t_unix") or 0.0)
+            keep += keyed[-IDEMPOTENCY_KEEP:]
+            old = segment_paths(self.dir)
+            self._seq += 1
+            self._path = self._segment_path(self._seq)
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(self._path) + ".", suffix=".tmp",
+                dir=self.dir)
+            with os.fdopen(fd, "wb") as f:
+                for doc in keep:
+                    f.write(encode_record(doc))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path)
+            fsync_dir(self.dir)
+            for path in old:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._f = open(self._path, "ab", buffering=0)
+            self._live_records = len(keep)
+            self._rotate_at = len(keep) + self.rotate_records
+            self.rotations += 1
+            obs.counter("journal.rotations")
+            obs.emit("journal", event="rotate", segment=self._seq,
+                     carried=len(keep), deleted=len(old))
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            try:
+                os.fsync(self._f.fileno())
+                self.fsyncs += 1
+            except OSError:
+                pass
+            self._f.close()
+            self.closed = True
+
+    def abandon(self) -> None:
+        """Crash stand-in for in-process chaos: drop the file handle with
+        no fsync, no marker, no close bookkeeping — the journal directory
+        is left exactly as a kill would leave it."""
+        with self._lock:
+            try:
+                self._f.close()
+            finally:
+                self.closed = True
+
+    def stats(self) -> Dict[str, Any]:
+        segs = segment_paths(self.dir)
+        return {"dir": self.dir, "segments": len(segs),
+                "appends": self.appends, "fsyncs": self.fsyncs,
+                "rotations": self.rotations,
+                "bytes": sum(os.path.getsize(p) for p in segs
+                             if os.path.exists(p)),
+                "recovered_records": self.recovered.records,
+                "torn_dropped": self.recovered.torn_dropped}
+
+
+def terminal_to_result(doc: Dict[str, Any]):
+    """A journaled terminal record -> the client-visible ServeResult a
+    deduped resubmission resolves with (solution included when journaled)."""
+    from gauss_tpu.serve.admission import ServeResult
+
+    x = decode_array(doc["x"]) if doc.get("x") is not None else None
+    return ServeResult(status=doc.get("status"), x=x, lane=doc.get("lane"),
+                       rel_residual=doc.get("rel_residual"),
+                       error=doc.get("error"))
+
+
+# -- the supervisor --------------------------------------------------------
+
+def supervise(child_argv: List[str], *, heartbeat_path: str,
+              max_restarts: int = 3, stall_after_s: float = 30.0,
+              poll_s: float = 0.25, term_grace_s: float = 15.0,
+              env: Optional[Dict[str, str]] = None,
+              log=print) -> int:
+    """Run ``child_argv`` under the PR-5 fleet watchdog pattern and restart
+    it — against the same journal — when it dies or stalls.
+
+    - *died*: the child process exited nonzero (crash, kill, preemption).
+    - *stalled*: the child is alive but its heartbeat file (written from
+      the serve worker loop) has not been touched for ``stall_after_s`` —
+      it is killed, then restarted.
+    - restarts are bounded by ``max_restarts``; a child that exits 0 ends
+      supervision with 0. Respawns strip ``GAUSS_FAULTS`` from the
+      environment: an injected kill models a ONE-OFF crash, the same
+      max_triggers=1 contract the in-process hooks have — without this the
+      replayed plan would re-kill every incarnation at the same boundary.
+
+    The journal makes the restart correct: the replacement's ``--resume``
+    replays the dead incarnation's unterminated admits, and the PR-7
+    persistent compile cache (pass ``--compile-cache``/GAUSS_COMPILE_CACHE
+    through) makes it warm. SIGTERM to the supervisor forwards to the
+    child for a graceful drain (clean-shutdown marker) before exiting.
+    """
+    base_env = dict(env if env is not None else os.environ)
+    base_env["GAUSS_SERVE_HEARTBEAT"] = heartbeat_path
+    restarts = 0
+    draining = {"flag": False}
+    child: Dict[str, Optional[subprocess.Popen]] = {"proc": None}
+
+    def _forward_term(signum, frame):  # pragma: no cover — signal timing
+        draining["flag"] = True
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+
+    prev = None
+    try:
+        prev = signal.signal(signal.SIGTERM, _forward_term)
+    except ValueError:  # not the main thread (tests drive this in-thread)
+        prev = None
+
+    def _hb_age() -> Optional[float]:
+        try:
+            return time.time() - os.path.getmtime(heartbeat_path)
+        except OSError:
+            return None
+
+    try:
+        spawn_env = base_env
+        while True:
+            t_spawn = time.time()
+            proc = subprocess.Popen(child_argv, env=spawn_env)
+            child["proc"] = proc
+            obs.counter("serve.supervisor_spawns")
+            obs.emit("serve_supervisor", event="spawn", pid=proc.pid,
+                     restarts=restarts)
+            log(f"supervise: spawned pid {proc.pid} (restart {restarts})")
+            stalled = False
+            while proc.poll() is None:
+                time.sleep(poll_s)
+                if draining["flag"]:
+                    continue  # drain in progress; wait for clean exit
+                age = _hb_age()
+                # Only call a stall once the child has had time to write
+                # its first beat (spawn + jax import can take seconds).
+                if (age is not None and age > stall_after_s
+                        and time.time() - t_spawn > stall_after_s):
+                    stalled = True
+                    obs.emit("serve_supervisor", event="stall",
+                             pid=proc.pid, heartbeat_age_s=round(age, 3))
+                    log(f"supervise: pid {proc.pid} stalled "
+                        f"(heartbeat {age:.1f}s stale); killing")
+                    proc.kill()
+                    proc.wait(timeout=term_grace_s)
+                    break
+            rc = proc.returncode
+            if rc == 0 and not stalled:
+                obs.emit("serve_supervisor", event="done", restarts=restarts)
+                return 0
+            if draining["flag"]:
+                obs.emit("serve_supervisor", event="drained", rc=rc)
+                return rc if rc is not None else 0
+            cause = "stalled" if stalled else f"died rc={rc}"
+            if restarts >= max_restarts:
+                obs.emit("serve_supervisor", event="gave_up", cause=cause,
+                         restarts=restarts)
+                log(f"supervise: {cause}; restart budget "
+                    f"({max_restarts}) spent — giving up")
+                return rc if rc else 1
+            restarts += 1
+            obs.counter("serve.supervisor_restarts")
+            obs.emit("serve_supervisor", event="restart", cause=cause,
+                     restarts=restarts)
+            log(f"supervise: child {cause}; restarting against the same "
+                f"journal ({restarts}/{max_restarts})")
+            # One-off-crash contract: injected fault plans die with the
+            # incarnation they killed.
+            spawn_env = {k: v for k, v in base_env.items()
+                         if k != _inject.ENV_VAR}
+    finally:
+        if prev is not None:
+            signal.signal(signal.SIGTERM, prev)
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:  # pragma: no cover
+            proc.kill()

@@ -97,6 +97,11 @@ class LoadgenConfig:
     deadline_s: Optional[float] = None
     timeout_s: float = 600.0
     verify_gate: float = VERIFY_GATE
+    #: mint a deterministic idempotency key per request
+    #: (``submit(request_id="lg<seed>-<i>")``) — with a journaled server a
+    #: rerun of the same plan dedupes already-terminal requests instead of
+    #: re-solving them (the crash-restart client behavior).
+    request_ids: bool = False
     serve: ServeConfig = field(default_factory=ServeConfig)
 
 
@@ -268,6 +273,9 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
         with next_lock:
             return next(next_i, None)
 
+    def _rid(i: int) -> Optional[str]:
+        return f"lg{cfg.seed}-{i}" if cfg.request_ids else None
+
     def closed_worker(wid: int):
         wrng = np.random.default_rng(cfg.seed + 1000 + wid)
         while True:
@@ -278,7 +286,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
             operands[i] = (a, b)
             results[i] = server.solve(a, b, deadline_s=cfg.deadline_s,
                                       timeout=cfg.timeout_s,
-                                      dtype=plan[i].dtype)
+                                      dtype=plan[i].dtype,
+                                      request_id=_rid(i))
 
     t_start = time.perf_counter()
     if cfg.mode == "closed":
@@ -301,7 +310,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
             if delay > 0:
                 time.sleep(delay)
             handles.append(server.submit(a, b, deadline_s=cfg.deadline_s,
-                                         dtype=spec.dtype))
+                                         dtype=spec.dtype,
+                                         request_id=_rid(i)))
         for i, h in enumerate(handles):
             results[i] = h.result(cfg.timeout_s)
     else:
@@ -364,6 +374,13 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
                      if k in ("entries", "capacity", "evictions")}},
         "verify_gate": cfg.verify_gate,
     }
+    if getattr(server, "journal", None) is not None:
+        # Durable admission was on: the journal's own accounting rides in
+        # the report (and the overhead is visible as the delta between a
+        # journal-on and journal-off run of the same plan — what
+        # durablecheck's overhead phase measures and history-gates).
+        summary["journal"] = {**server.journal.stats(),
+                              "resume": server.last_resume}
     if getattr(server, "live", None) is not None:
         # The live plane was on: fold its SLO monitors into the report.
         # The nested dict is ALSO exportable standalone (gauss-serve
@@ -441,6 +458,15 @@ def format_summary(summary: Dict) -> str:
         + (f"; {summary['retries']} retried batch attempt(s)"
            if summary.get("retries") else ""),
     ]
+    jr = summary.get("journal")
+    if jr:
+        lines.append(
+            f"  journal: {jr['appends']} append(s) / {jr['fsyncs']} "
+            f"fsync(s) / {jr['rotations']} rotation(s), "
+            f"{jr['segments']} segment(s), {jr['bytes']} bytes"
+            + (f"; resumed {jr['resume']['replayed']} replayed + "
+               f"{jr['resume']['expired']} expired"
+               if jr.get("resume") else ""))
     slo = summary.get("slo")
     if slo:
         lines.append(

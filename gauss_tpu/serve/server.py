@@ -34,10 +34,23 @@ terminal status folds back into one per-request span tree
 (gauss_tpu.obs.requesttrace). With ``slo_shed`` the admission path consults
 the firing SLO alerts and degrades EARLY (reduced queue bound) instead of
 riding into the deadline cliff.
+
+With ``ServeConfig(journal_dir=...)`` admission is DURABLE
+(gauss_tpu.serve.durable): every admit and every terminal is journaled
+(write-ahead, CRC-per-record, torn-tail tolerant), a restarted server
+replays unterminated admits through this same dispatch path (in-deadline
+requests re-solve, expired ones get a typed terminal, original trace ids
+preserved so span trees complete across the crash), and client idempotency
+keys (``submit(request_id=...)``) dedupe resubmissions against journaled
+terminals — exactly-once terminal statuses across ``kill -9``.
+``journal_dir=None`` keeps the whole layer compiled out: one ``is None``
+check at admission, none anywhere else.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import queue as _queue
 import threading
 import time
@@ -72,11 +85,19 @@ class SolverServer:
     and an RPC front end would wrap ``submit`` without changing any of them.
     """
 
-    def __init__(self, config: Optional[ServeConfig] = None):
+    def __init__(self, config: Optional[ServeConfig] = None, *,
+                 cache: Optional[ExecutableCache] = None):
         self.config = config or ServeConfig()
         self.ladder = buckets.validate_ladder(
             self.config.ladder or buckets.DEFAULT_LADDER)
-        self.cache = ExecutableCache(self.config.cache_capacity)
+        # ``cache``: share one executable cache across server incarnations
+        # (the durable chaos campaign restarts dozens of servers; paying a
+        # fresh compile set per incarnation would benchmark XLA, not the
+        # recovery protocol). Default: a private cache, as before.
+        # ``is None``, not ``or``: an EMPTY shared cache is falsy
+        # (len() == 0) and ``or`` would silently discard it.
+        self.cache = (cache if cache is not None
+                      else ExecutableCache(self.config.cache_capacity))
         self.health = LaneHealth(self.config.unhealthy_after,
                                  self.config.device_probe_cooldown_s)
         self._queue: "_queue.Queue[ServeRequest]" = _queue.Queue()
@@ -93,6 +114,26 @@ class SolverServer:
         self.live = None                  # obs.live.LiveAggregator
         self._live_server = None          # obs.export.LiveServer
         self._live_prev = None            # sink displaced by install()
+        #: durable admission (None = journal off; the serve path is then
+        #: byte-identical to the pre-journal behavior)
+        self.journal = None               # serve.durable.RequestJournal
+        self._rid_terminals: dict = {}    # idempotency key -> terminal doc
+        self._rid_pending: dict = {}      # idempotency key -> in-flight req
+        self._resumed = False             # replay runs once per journal open
+        #: what the last start() recovery did (the campaign/test assert
+        #: surface): {"replayed", "expired", "clean", ...}; None before
+        #: any journaled start.
+        self.last_resume = None
+        self._hb_last = 0.0               # heartbeat write throttle
+        if self.config.journal_dir:
+            from gauss_tpu.serve import durable as _durable
+
+            self._durable = _durable
+            self.journal = _durable.RequestJournal(
+                self.config.journal_dir,
+                fsync_batch=self.config.journal_fsync_batch,
+                rotate_records=self.config.journal_rotate_records)
+            self._rid_terminals = dict(self.journal.recovered.by_rid)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -107,6 +148,9 @@ class SolverServer:
         self._worker = threading.Thread(target=self._run, name="gauss-serve",
                                         daemon=True)
         self._worker.start()
+        if self.journal is not None and not self._resumed:
+            self._resumed = True
+            self._replay()
         return self
 
     def _start_live(self) -> None:
@@ -143,6 +187,117 @@ class SolverServer:
         """The live endpoint base URL (None when the plane is off)."""
         return self._live_server.url if self._live_server else None
 
+    # -- durability (gauss_tpu.serve.durable) ------------------------------
+
+    def _journal_terminal(self, req: ServeRequest, result) -> None:
+        """The resolve() terminal hook (installed only on journaled
+        requests): append the terminal record from the winning CAS, so the
+        journal carries exactly one terminal per admit. Never raises into
+        the resolver — a journaling failure is counted and surfaced, not
+        allowed to turn a served result into a client-visible error."""
+        try:
+            doc = self.journal.append_terminal(
+                id=req.journal_id, request_id=req.request_id,
+                trace=req.trace_id, status=result.status, x=result.x,
+                lane=result.lane, rel_residual=result.rel_residual,
+                error=result.error)
+            if req.request_id:
+                self._rid_terminals[req.request_id] = doc
+                self._rid_pending.pop(req.request_id, None)
+        except Exception as e:  # noqa: BLE001 — durability must not break serving
+            obs.counter("journal.errors")
+            obs.emit("journal", event="append_error",
+                     error=f"{type(e).__name__}: {e}"[:200])
+
+    def _replay(self) -> None:
+        """Crash -> restart recovery: push the journal's unterminated
+        admits back through the normal dispatch path. In-deadline requests
+        re-solve (and re-verify at the configured gate); past-deadline ones
+        resolve as typed STATUS_EXPIRED terminals. Replayed requests keep
+        their ORIGINAL journal ids and trace ids, so terminals pair with
+        their admits and obs span trees complete across the crash. The
+        admission bound is bypassed — these requests were already admitted
+        once; re-rejecting them would forfeit their terminal."""
+        st = self.journal.recovered
+        if st.clean_shutdown or not self.config.resume:
+            self.last_resume = {"replayed": 0, "expired": 0,
+                                "clean": st.clean_shutdown,
+                                "resume": self.config.resume,
+                                "torn_dropped": st.torn_dropped}
+            obs.emit("serve_resume", **self.last_resume)
+            return
+        dec = self._durable.decode_array
+        replayed = expired = 0
+        now = time.time()
+        for doc in st.live_admits():
+            try:
+                a = dec(doc["a"])
+                b = dec(doc["b"])
+            except Exception:  # pragma: no cover — admit body damaged
+                obs.counter("journal.replay_undecodable")
+                continue
+            if doc.get("was_vector"):
+                b = b.reshape(-1)
+            remaining = None
+            if doc.get("deadline_unix") is not None:
+                remaining = float(doc["deadline_unix"]) - now
+            structure = (doc.get("structure")
+                         if self.config.structure_aware else None)
+            req = ServeRequest(
+                a, b, deadline_s=(remaining if remaining is None
+                                  or remaining > 0 else None),
+                structure=structure,
+                dtype=doc.get("dtype") or self.config.dtype,
+                request_id=doc.get("rid"))
+            req.journal_id = int(doc["id"])
+            if doc.get("trace"):
+                req.trace_id = str(doc["trace"])
+            req._on_terminal = self._journal_terminal
+            if req.request_id:
+                # Replayed requests join the pending map too: a client
+                # resubmitting its key DURING recovery attaches to the
+                # replay instead of double-solving.
+                self._rid_pending[req.request_id] = req
+            if remaining is not None and remaining <= 0:
+                expired += 1
+                if req.resolve(ServeResult(
+                        status=STATUS_EXPIRED,
+                        error="deadline expired before recovery "
+                              "(crash -> restart replay)")):
+                    obs.counter("serve.resume_expired")
+                    obs.emit("serve_request", id=req.journal_id, n=req.n,
+                             trace=req.trace_id, status=STATUS_EXPIRED,
+                             replayed=True)
+                continue
+            replayed += 1
+            self._depth_add(1)
+            self._queue.put(req)
+            obs.counter("serve.replayed")
+            obs.emit("serve_admit", id=req.journal_id, trace=req.trace_id,
+                     n=req.n, k=req.k, replayed=True,
+                     deadline_s=remaining)
+        self.last_resume = {"replayed": replayed, "expired": expired,
+                            "clean": False, "resume": True,
+                            "torn_dropped": st.torn_dropped}
+        obs.emit("serve_resume", **self.last_resume)
+
+    def _crash(self) -> None:
+        """CHAOS HOOK (not part of the serving API): die the way a kill at
+        a batch boundary does. The worker finishes its in-flight batch
+        (those terminals are journaled — a kill cannot unresolve them),
+        then everything still queued is ABANDONED unresolved, the journal
+        file handle is dropped with no fsync and no shutdown marker, and
+        no terminal/flush bookkeeping runs. The in-process durable chaos
+        campaign uses this where a subprocess would use os._exit."""
+        self._stop.set()
+        self._queue.put(None)  # type: ignore[arg-type]
+        if self._worker is not None:
+            self._worker.join(timeout=60.0)
+            self._worker = None
+        if self.journal is not None:
+            self.journal.abandon()
+        self._stop_live()
+
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the worker; with ``drain`` (default) requests accepted
         before the stop began are served first, otherwise they resolve as
@@ -158,6 +313,7 @@ class SolverServer:
         tests/test_serve.py::test_stop_shutdown_race pins)."""
         with self._depth_lock:
             self._closed = True
+        joined = True
         if self._worker is not None:
             if drain:
                 deadline = time.monotonic() + timeout
@@ -166,6 +322,7 @@ class SolverServer:
             self._stop.set()
             self._queue.put(None)  # type: ignore[arg-type] # wake the worker
             self._worker.join(timeout=timeout)
+            joined = not self._worker.is_alive()
             self._worker = None
         else:
             self._stop.set()
@@ -186,6 +343,14 @@ class SolverServer:
                 obs.emit("serve_request", id=req.id, n=req.n,
                          trace=req.trace_id, status=STATUS_REJECTED,
                          reason="server_stopped")
+        if self.journal is not None and not self.journal.closed:
+            # Graceful drain's final act: the clean-shutdown marker — but
+            # only when the stop actually completed (worker joined). A
+            # wedged worker might still be computing a journaled admit;
+            # claiming "clean" would make the next start skip its replay.
+            if joined:
+                self.journal.append_shutdown()
+            self.journal.close()
         self._stop_live()
 
     def __enter__(self) -> "SolverServer":
@@ -216,7 +381,8 @@ class SolverServer:
 
     def submit(self, a, b, deadline_s: Optional[float] = None,
                structure: Optional[str] = None,
-               dtype: Optional[str] = None) -> ServeRequest:
+               dtype: Optional[str] = None,
+               request_id: Optional[str] = None) -> ServeRequest:
         """Enqueue one system. Returns the request handle immediately; a
         queue-full rejection resolves the handle synchronously with
         ``retry_after_s`` set (the client never blocks to learn it was
@@ -233,7 +399,43 @@ class SolverServer:
         ("float32" / "bfloat16" / "bf16x3" — core.lowered's ladder names);
         None takes ``config.dtype``. Requests batch only with same-dtype
         company and compile against their own ``CacheKey.dtype`` entry —
-        mixed-precision traffic can never alias an f32 executable."""
+        mixed-precision traffic can never alias an f32 executable.
+
+        ``request_id``: a client idempotency key (durable serving only —
+        ignored without ``config.journal_dir``). Journaled with the admit;
+        a resubmission whose key already holds a journaled terminal
+        resolves from the journal — same status, same solution — WITHOUT
+        re-solving, which is what makes crash recovery exactly-once from
+        the client's view."""
+        jr = self.journal
+        if jr is not None and request_id:
+            pending = self._rid_pending.get(request_id)
+            if pending is not None:
+                # The key is already IN FLIGHT (admitted, or replayed by
+                # recovery, not yet terminal): attach the resubmission to
+                # the live request instead of admitting a duplicate —
+                # without this, a client retrying while recovery replays
+                # its backlog would double-solve (and double-terminal) the
+                # same logical request. Same handle, same single terminal.
+                obs.counter("serve.deduped_pending")
+                obs.emit("serve_dedup", request_id=request_id,
+                         trace=pending.trace_id, pending=True)
+                return pending
+            term = self._rid_terminals.get(request_id)
+            if term is not None:
+                # Idempotent resubmission: the journaled terminal answers.
+                # A fresh trace is minted (this is a NEW client
+                # interaction) and carries exactly one terminal event —
+                # the dedupe, not a second solve.
+                req = ServeRequest(a, b, deadline_s=deadline_s,
+                                   request_id=request_id)
+                if req.resolve(self._durable.terminal_to_result(term)):
+                    obs.counter("serve.deduped")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             trace=req.trace_id,
+                             status=term.get("status"), deduped=True,
+                             request_id=request_id)
+                return req
         if deadline_s is None:
             deadline_s = self.config.deadline_default_s
         if self.config.structure_aware and structure is None:
@@ -243,7 +445,8 @@ class SolverServer:
         if not self.config.structure_aware:
             structure = None
         req = ServeRequest(a, b, deadline_s=deadline_s, structure=structure,
-                           dtype=dtype or self.config.dtype)
+                           dtype=dtype or self.config.dtype,
+                           request_id=request_id)
         # SLO-degraded admission (slo_shed): while a burn-rate alert FIRES,
         # the effective queue bound shrinks, so load is turned away while
         # the error budget is bleeding — shedding starts BEFORE the
@@ -263,6 +466,23 @@ class SolverServer:
             closed = self._closed
             full = not closed and self._depth >= bound
             if not closed and not full:
+                if jr is not None:
+                    # Write-ahead: the admit is journaled (and the
+                    # terminal hook installed) INSIDE the admission
+                    # critical section, strictly before the request
+                    # becomes visible to the worker — so a terminal can
+                    # never precede its admit in the journal, and journal
+                    # admit order is queue order. Without a journal this
+                    # branch costs one is-None check.
+                    jr.append_admit(
+                        id=req.id, request_id=request_id,
+                        trace=req.trace_id, a=req.a, b=req.b,
+                        was_vector=req.was_vector,
+                        deadline_unix=req.deadline_unix,
+                        dtype=req.dtype, structure=req.structure)
+                    req._on_terminal = self._journal_terminal
+                    if request_id:
+                        self._rid_pending[request_id] = req
                 self._depth += 1
                 self._queue.put(req)
         if closed:
@@ -298,15 +518,19 @@ class SolverServer:
 
     def solve(self, a, b, deadline_s: Optional[float] = None,
               timeout: Optional[float] = 300.0,
-              dtype: Optional[str] = None) -> ServeResult:
+              dtype: Optional[str] = None,
+              request_id: Optional[str] = None) -> ServeResult:
         """Synchronous convenience: submit + wait."""
-        return self.submit(a, b, deadline_s=deadline_s,
-                           dtype=dtype).result(timeout)
+        return self.submit(a, b, deadline_s=deadline_s, dtype=dtype,
+                           request_id=request_id).result(timeout)
 
     # -- worker loop ------------------------------------------------------
 
     def _run(self) -> None:
+        hb_path = self.config.heartbeat_path
         while not self._stop.is_set():
+            if hb_path is not None:
+                self._heartbeat(hb_path)
             try:
                 req = self._queue.get(timeout=0.1)
             except _queue.Empty:
@@ -328,6 +552,28 @@ class SolverServer:
                 inst = served / dt
                 self._drain_rate = (0.7 * self._drain_rate + 0.3 * inst
                                     if self._drain_rate else inst)
+            if _inject.enabled():
+                # Hook point "serve.server.batch": the batch BOUNDARY —
+                # the in-flight batch's terminals are journaled, the rest
+                # of the queue is not yet served. Kind "server_kill"
+                # os._exits here (the durable campaign's crash site).
+                _inject.maybe_kill("serve.server.batch")
+
+    def _heartbeat(self, path: str) -> None:
+        """Supervisor liveness (durable.supervise): touch the heartbeat
+        file from the worker loop, throttled — a wedged worker stops
+        touching it and the supervisor calls the stall."""
+        now = time.monotonic()
+        if now - self._hb_last < 0.5:
+            return
+        self._hb_last = now
+        try:
+            with open(path, "w") as f:
+                f.write(json.dumps({"pid": os.getpid(),
+                                    "time_unix": time.time(),
+                                    "batches": self.batches}))
+        except OSError:  # pragma: no cover — liveness must not kill serving
+            pass
 
     def _drain_same_bucket(self, first: ServeRequest):
         """Collect queued requests that share ``first``'s size bucket — and,

@@ -313,3 +313,75 @@ def test_gauss_dist_default_device_mesh(monkeypatch):
                          thread_sweep=[too_many])
     assert len(bad) == 1 and not bad[0].verified
     assert "devices" in (bad[0].note or "")
+
+
+def test_infra_retryable_classifier():
+    # gRPC/daemon/transport shapes retry; deterministic bugs never do.
+    assert grid._infra_retryable(RuntimeError(
+        "UNAVAILABLE: connection to TPU daemon lost"))
+    assert grid._infra_retryable(RuntimeError(
+        "DEADLINE_EXCEEDED waiting for worker"))
+    assert grid._infra_retryable(OSError("Connection reset by peer"))
+    assert grid._infra_retryable(RuntimeError(
+        "compile failed 2026-08-04T10:11:12.345Z daemon restarting"))
+    assert not grid._infra_retryable(ValueError("bad shape (3, 4)"))
+    assert not grid._infra_retryable(TypeError("not an array"))
+    assert not grid._infra_retryable(AssertionError("residual too large"))
+    assert not grid._infra_retryable(RuntimeError("some deterministic bug"))
+
+
+def test_run_suite_retries_infra_failure_once(monkeypatch, capsys):
+    """An infra-class failure gets ONE retry; the retried cell verifies and
+    its note records BOTH timestamps (first failure + retry) so the cell is
+    visibly a second attempt, never a clean first run."""
+    from gauss_tpu.cli import _common
+
+    real = _common.solve_with_backend
+    calls = {"n": 0}
+
+    def flaky_once(a, b, backend, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: tunnel dropped")
+        return real(a, b, backend, **kw)
+
+    monkeypatch.setattr(_common, "solve_with_backend", flaky_once)
+    cells = grid.run_suite("gauss-internal", [16], ["tpu-unblocked"])
+    assert len(cells) == 1 and cells[0].verified
+    assert "retried: infra-class failure at " in cells[0].note
+    assert "-> succeeded at " in cells[0].note
+    assert "UNAVAILABLE" in cells[0].note
+    assert "retrying once" in capsys.readouterr().err
+
+
+def test_run_suite_reproduced_infra_failure_stays_failed(monkeypatch,
+                                                         capsys):
+    """A failure that reproduces on the retry stays FAILED honestly, with
+    both attempts' timestamps and notes in the cell."""
+    from gauss_tpu.cli import _common
+
+    def always_down(a, b, backend, **kw):
+        raise RuntimeError("UNAVAILABLE: tunnel down")
+
+    monkeypatch.setattr(_common, "solve_with_backend", always_down)
+    cells = grid.run_suite("gauss-internal", [16], ["tpu-unblocked"])
+    assert len(cells) == 1 and not cells[0].verified
+    note = cells[0].note
+    assert "[at 20" in note and "retry reproduced at 20" in note
+    assert note.count("UNAVAILABLE") == 2
+
+
+def test_run_suite_deterministic_failure_not_retried(monkeypatch):
+    from gauss_tpu.cli import _common
+
+    calls = {"n": 0}
+
+    def det_bug(a, b, backend, **kw):
+        calls["n"] += 1
+        raise ValueError("deterministic shape bug")
+
+    monkeypatch.setattr(_common, "solve_with_backend", det_bug)
+    cells = grid.run_suite("gauss-internal", [16], ["tpu-unblocked"])
+    assert len(cells) == 1 and not cells[0].verified
+    assert calls["n"] == 1          # no second attempt
+    assert "retried" not in cells[0].note

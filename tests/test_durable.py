@@ -1,0 +1,514 @@
+"""Durable-serving tests: the write-ahead request journal (CRC'd records,
+torn-tail tolerance as a PROPERTY — every byte offset — batched fsync,
+atomic rotation), crash -> restart recovery with exactly-once terminal
+statuses, idempotency-key dedupe (journaled AND in-flight), graceful-drain
+clean-shutdown markers, the supervisor loop, the reject-path trace
+coverage, the kill-campaign case runner, and the regress/summarize ingest
+for ``kind: durable_campaign``.
+
+All CPU (conftest pins the platform); servers share one module-scoped
+executable cache so the jitted batch executables compile once.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import regress, requesttrace, summarize
+from gauss_tpu.serve import (
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    ServeConfig,
+    SolverServer,
+    durable,
+)
+from gauss_tpu.serve.cache import ExecutableCache
+from gauss_tpu.verify import checks
+
+GATE = 1e-4
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(64)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(258458)
+
+
+def _system(rng, n):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _config(journal_dir, **over):
+    kw = dict(ladder=(16, 32), max_batch=4, panel=16, refine_steps=1,
+              verify_gate=GATE, journal_dir=journal_dir,
+              journal_fsync_batch=4)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def _fill_journal(jd, records=10, terminals=6):
+    jr = durable.RequestJournal(jd, fsync_batch=2, rotate_records=10_000)
+    rng = np.random.default_rng(7)
+    a, b = rng.standard_normal((4, 4)), rng.standard_normal(4)
+    for i in range(records):
+        jr.append_admit(id=i, request_id=f"r{i}", trace=f"t{i}", a=a, b=b,
+                        was_vector=True, deadline_unix=None, dtype=None,
+                        structure=None)
+    for i in range(terminals):
+        jr.append_terminal(id=i, request_id=f"r{i}", trace=f"t{i}",
+                           status="ok", x=b, lane="batched",
+                           rel_residual=1e-9)
+    jr.close()
+    return jr
+
+
+# -- journal mechanics -----------------------------------------------------
+
+def test_record_codec_roundtrip(rng):
+    a = rng.standard_normal((5, 5))
+    doc = {"rec": "admit", "id": 3, "a": durable.encode_array(a)}
+    line = durable.encode_record(doc)
+    back = durable.decode_line(line)
+    assert back["id"] == 3
+    assert np.array_equal(durable.decode_array(back["a"]), a)
+    # any single corrupted byte in the body fails the CRC -> dropped
+    corrupt = bytearray(line)
+    corrupt[15] ^= 0x40
+    assert durable.decode_line(bytes(corrupt)) is None
+
+
+def test_torn_write_every_byte_offset_parses_longest_prefix(tmp_path):
+    """The satellite property: truncating the segment at EVERY byte offset
+    of the final record parses to the longest valid record prefix — a torn
+    tail is dropped, never a crash, never a misparse."""
+    jd = str(tmp_path / "j")
+    _fill_journal(jd)
+    path = durable.segment_paths(jd)[-1]
+    data = open(path, "rb").read()
+    last_start = data.rstrip(b"\n").rfind(b"\n") + 1
+    total = durable.scan(jd).records
+    for cut in range(last_start, len(data)):
+        with open(path, "wb") as f:
+            f.write(data[:cut])
+        st = durable.scan(jd)
+        # the record survives only once every body byte is present (the
+        # trailing newline itself is not load-bearing)
+        want = total if cut >= len(data) - 1 else total - 1
+        assert st.records == want, (cut, st.records, want)
+        assert st.torn_dropped == (0 if cut == last_start or want == total
+                                   else 1)
+
+
+def test_partial_line_merged_with_next_append_drops_both(tmp_path):
+    """A torn record followed by a later append on the same line (no
+    newline between them) fails the merged line's CRC: both are dropped,
+    every record on its own line still parses."""
+    jd = str(tmp_path / "j")
+    _fill_journal(jd, records=4, terminals=2)
+    path = durable.segment_paths(jd)[-1]
+    data = open(path, "rb").read()
+    last_start = data.rstrip(b"\n").rfind(b"\n") + 1
+    extra = durable.encode_record({"rec": "terminal", "id": 3, "rid": "r3",
+                                   "trace": "t3", "status": "failed",
+                                   "schema": durable.JOURNAL_SCHEMA})
+    with open(path, "wb") as f:
+        f.write(data[:last_start + 8] + extra)  # torn tail + merged record
+    st = durable.scan(jd)
+    assert st.torn_dropped == 1
+    assert "r3" not in st.by_rid          # the merged terminal is gone
+    assert st.records == 6 - 1            # all fully-lined records survive
+
+
+def test_rotation_compacts_and_carries_dedupe_window(tmp_path):
+    jd = str(tmp_path / "j")
+    jr = durable.RequestJournal(jd, fsync_batch=4, rotate_records=16)
+    rng = np.random.default_rng(3)
+    a, b = rng.standard_normal((4, 4)), rng.standard_normal(4)
+    jr.append_admit(id=0, request_id="live0", trace="t", a=a, b=b,
+                    was_vector=True, deadline_unix=None, dtype=None,
+                    structure=None)
+    for i in range(1, 30):
+        jr.append_admit(id=i, request_id=f"k{i}", trace="t", a=a, b=b,
+                        was_vector=True, deadline_unix=None, dtype=None,
+                        structure=None)
+        jr.append_terminal(id=i, request_id=f"k{i}", trace="t",
+                           status="ok", x=b)
+    assert jr.rotations >= 1
+    jr.close()
+    assert len(durable.segment_paths(jd)) <= 2  # old segments deleted
+    st = durable.scan(jd)
+    live = st.live_admits()
+    assert [d["id"] for d in live] == [0]       # live admit carried
+    assert "k29" in st.by_rid                   # dedupe window carried
+    # rotation must not re-trigger per append once the carried set is big
+    assert jr.rotations < 5
+
+
+def test_clean_shutdown_marker_only_when_final(tmp_path):
+    jd = str(tmp_path / "j")
+    jr = durable.RequestJournal(jd)
+    jr.append_shutdown()
+    jr.close()
+    assert durable.scan(jd).clean_shutdown
+    jr2 = durable.RequestJournal(jd)
+    rng = np.random.default_rng(1)
+    jr2.append_admit(id=9, request_id=None, trace="t",
+                     a=rng.standard_normal((3, 3)),
+                     b=rng.standard_normal(3), was_vector=True,
+                     deadline_unix=None, dtype=None, structure=None)
+    jr2.close()
+    st = durable.scan(jd)
+    assert not st.clean_shutdown          # a later run reopened the journal
+    assert len(st.live_admits()) == 1
+
+
+# -- server integration ----------------------------------------------------
+
+def test_journal_off_path_unchanged(rng, shared_cache):
+    """journal_dir=None: no journal object, no terminal hook, and the
+    client-visible result still carries its trace id (the loadgen-visible
+    reject-tracing satellite applies to every status)."""
+    with SolverServer(_config(None), cache=shared_cache) as srv:
+        assert srv.journal is None
+        a, b = _system(rng, 12)
+        h = srv.submit(a, b)
+        assert h._on_terminal is None
+        res = h.result(30)
+        assert res.status == STATUS_OK
+        assert res.trace == h.trace_id
+
+
+def test_crash_recovery_exactly_once_and_traces_complete(rng, shared_cache,
+                                                         tmp_path):
+    """Kill at a batch boundary -> restart -> every admitted request holds
+    exactly one journaled terminal, served results verify at the gate from
+    the JOURNALED operands, and the replayed terminals complete the
+    ORIGINAL trace trees (requesttrace --check holds across the crash)."""
+    jd = str(tmp_path / "j")
+    stream = str(tmp_path / "events.jsonl")
+    with obs.run(metrics_out=stream, tool="test_crash_recovery"):
+        srv = SolverServer(_config(jd), cache=shared_cache).start()
+        rids = []
+        for j in range(4):                # served before the crash
+            a, b = _system(rng, 20)
+            srv.submit(a, b, request_id=f"c{j}", deadline_s=60.0)
+            rids.append(f"c{j}")
+        t0 = time.monotonic()             # let the worker terminal a few
+        while (srv.requests_served < 2 and time.monotonic() - t0 < 30):
+            time.sleep(0.005)
+        srv._stop.set()                   # park the worker: the rest must
+        srv._worker.join(timeout=30)      # still be QUEUED at crash time
+        srv._worker = None
+        for j in range(4, 8):
+            a, b = _system(rng, 20)
+            srv.submit(a, b, request_id=f"c{j}", deadline_s=60.0)
+            rids.append(f"c{j}")
+        srv._crash()
+        st = durable.scan(jd)
+        assert len(st.live_admits()) > 0  # the crash stranded real work
+        srv2 = SolverServer(_config(jd), cache=shared_cache).start()
+        assert srv2.last_resume["replayed"] == len(st.live_admits())
+        srv2.stop(drain=True, timeout=120.0)
+    st = durable.scan(jd)
+    assert durable.scan(jd).clean_shutdown
+    per_rid = {}
+    for term in st.terminals.values():
+        per_rid[term["rid"]] = per_rid.get(term["rid"], 0) + 1
+    assert sorted(per_rid) == sorted(rids)
+    assert all(v == 1 for v in per_rid.values())
+    for doc in st.admits.values():
+        term = st.terminals[doc["id"]]
+        if term["status"] == "ok":
+            a = durable.decode_array(doc["a"])
+            b = durable.decode_array(doc["b"]).reshape(-1)
+            x = durable.decode_array(term["x"])
+            assert checks.residual_norm(a, x, b, relative=True) <= GATE
+    from gauss_tpu.obs import registry
+
+    trees = requesttrace.request_traces(registry.read_events(stream))
+    assert len(trees) >= len(rids)
+    assert requesttrace.check_traces(trees) == []
+
+
+def test_clean_shutdown_replays_nothing(rng, shared_cache, tmp_path):
+    jd = str(tmp_path / "j")
+    srv = SolverServer(_config(jd), cache=shared_cache).start()
+    a, b = _system(rng, 14)
+    assert srv.solve(a, b, request_id="x0", timeout=60).status == STATUS_OK
+    srv.stop(drain=True)
+    srv2 = SolverServer(_config(jd), cache=shared_cache).start()
+    assert srv2.last_resume == {"replayed": 0, "expired": 0, "clean": True,
+                                "resume": True, "torn_dropped": 0}
+    srv2.stop()
+
+
+def test_duplicate_request_id_returns_journaled_status_without_resolving(
+        rng, shared_cache, tmp_path):
+    """The satellite property: a resubmission of a SERVED key returns the
+    journaled status (solution included) without re-solving — across a
+    server restart, and with zero new journal terminals."""
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 18)
+    with SolverServer(_config(jd), cache=shared_cache) as srv:
+        first = srv.solve(a, b, request_id="dup", timeout=60)
+        assert first.status == STATUS_OK
+    terms_before = len(durable.scan(jd).terminals)
+    with SolverServer(_config(jd), cache=shared_cache) as srv2:
+        again = srv2.solve(a, b, request_id="dup", timeout=5)
+        assert again.status == STATUS_OK
+        assert np.allclose(again.x, first.x)
+        assert srv2.requests_served == 0          # zero duplicate solves
+    assert len(durable.scan(jd).terminals) == terms_before
+
+
+def test_pending_dedupe_attaches_to_inflight_request(rng, shared_cache,
+                                                     tmp_path):
+    """A resubmission while the key is still IN FLIGHT (queued or being
+    replayed) attaches to the live request instead of admitting a
+    duplicate — the hole the first campaign smoke found."""
+    jd = str(tmp_path / "j")
+    srv = SolverServer(_config(jd), cache=shared_cache)
+    # not started: submissions queue, nothing resolves
+    srv._closed = False
+    a, b = _system(rng, 16)
+    h1 = srv.submit(a, b, request_id="pend")
+    h2 = srv.submit(a, b, request_id="pend")
+    assert h2 is h1
+    srv.start()
+    assert h1.result(60).status == STATUS_OK
+    srv.stop(drain=True)
+    st = durable.scan(jd)
+    assert sum(1 for t in st.terminals.values()
+               if t.get("rid") == "pend") == 1
+
+
+def test_expired_in_recovery_is_typed_terminal(rng, shared_cache, tmp_path):
+    jd = str(tmp_path / "j")
+    srv = SolverServer(_config(jd), cache=shared_cache)
+    srv.start()
+    a, b = _system(rng, 16)
+    # submit with a deadline that will be dead by the (post-crash) restart
+    # and crash before the worker can drain it: linger the worker first
+    srv._stop.set()
+    srv._worker.join(timeout=30)
+    srv._worker = None
+    h = srv.submit(a, b, request_id="late", deadline_s=0.05)
+    assert not h.done
+    srv._crash()
+    time.sleep(0.1)
+    srv2 = SolverServer(_config(jd), cache=shared_cache).start()
+    assert srv2.last_resume["expired"] == 1
+    srv2.stop(drain=True)
+    st = durable.scan(jd)
+    term = st.by_rid["late"]
+    assert term["status"] == STATUS_EXPIRED
+    assert "recovery" in term["error"]
+
+
+def test_reject_terminals_carry_traces_loadgen_visible(rng, shared_cache,
+                                                       tmp_path):
+    """The reject-path tracing satellite: queue-full and server-stopped
+    rejections carry the trace in BOTH the terminal event and the
+    client-visible ServeResult, and requesttrace --check covers a stream
+    of nothing but rejects."""
+    stream = str(tmp_path / "rejects.jsonl")
+    with obs.run(metrics_out=stream, tool="test_rejects"):
+        cfg = _config(None, max_queue=0)
+        srv = SolverServer(cfg, cache=shared_cache).start()
+        a, b = _system(rng, 12)
+        h = srv.submit(a, b)                      # queue_full reject
+        res = h.result(5)
+        assert res.status == STATUS_REJECTED
+        assert res.trace == h.trace_id            # client-visible join key
+        srv.stop()
+        h2 = srv.submit(a, b)                     # server-stopped reject
+        assert h2.result(5).status == STATUS_REJECTED
+        assert h2.result(5).trace == h2.trace_id
+    from gauss_tpu.obs import registry
+
+    events = registry.read_events(stream)
+    terminals = [ev for ev in events if ev.get("type") == "serve_request"]
+    assert len(terminals) == 2
+    assert all(ev.get("trace") for ev in terminals)
+    trees = requesttrace.request_traces(events)
+    assert requesttrace.check_traces(trees) == []
+
+
+def test_heartbeat_written_from_worker_loop(rng, shared_cache, tmp_path):
+    hb = str(tmp_path / "hb.json")
+    with SolverServer(_config(None, heartbeat_path=hb),
+                      cache=shared_cache) as srv:
+        t0 = time.monotonic()
+        while not os.path.exists(hb) and time.monotonic() - t0 < 10:
+            time.sleep(0.02)
+        assert os.path.exists(hb)
+        doc = json.loads(open(hb).read())
+        assert doc["pid"] == os.getpid()
+
+
+def test_supervise_restarts_dead_child(tmp_path):
+    """The watchdog loop itself, jax-free: a child that dies once (rc 113)
+    then exits 0 must be restarted exactly once, and GAUSS_FAULTS must not
+    leak into the respawn environment."""
+    import sys as _sys
+
+    marker = str(tmp_path / "died_once")
+    hb = str(tmp_path / "hb.json")
+    script = (
+        "import os, sys, time\n"
+        "open(os.environ['HB'], 'w').write('beat')\n"
+        f"m = {marker!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x')\n"
+        "    assert os.environ.get('GAUSS_FAULTS') == 'armed'\n"
+        "    os._exit(113)\n"
+        "assert 'GAUSS_FAULTS' not in os.environ\n"
+        "sys.exit(0)\n")
+    env = dict(os.environ, HB=hb, GAUSS_FAULTS="armed")
+    logs = []
+    rc = durable.supervise([_sys.executable, "-c", script],
+                           heartbeat_path=hb, max_restarts=2,
+                           stall_after_s=60.0, env=env, log=logs.append)
+    assert rc == 0
+    assert any("restarting" in ln for ln in logs)
+
+
+def test_inject_kinds_and_torn_write_hook():
+    from gauss_tpu.resilience import inject
+
+    plan = inject.FaultPlan.parse(
+        "serve.server.batch=server_kill:skip=2;"
+        "serve.journal.append=journal_torn_write:param=0.5")
+    kinds = {sp.kind for sp in plan.specs}
+    assert kinds == {"server_kill", "journal_torn_write"}
+    with inject.plan(plan):
+        # wrong-shape poll: server_kill site never fires the torn hook
+        assert inject.poll_torn_write("serve.server.batch") is None
+        sp = inject.poll_torn_write("serve.journal.append")
+        assert sp is not None and sp.param == 0.5
+
+
+def test_campaign_case_runner_each_kind(shared_cache, tmp_path):
+    from gauss_tpu.serve import durablecheck
+
+    for i, kind in enumerate(durablecheck.CASE_KINDS):
+        out = durablecheck.run_recovery_case(i, 99, GATE, str(tmp_path),
+                                             kind, cache=shared_cache)
+        assert out["outcome"] == "ok", out
+        assert out["audit"]["admitted"] >= 8
+        assert out["deduped"] == out["audit"]["admitted"]
+        assert out["dedupe_resolves"] == 0
+
+
+def test_campaign_summary_regress_roundtrip(tmp_path):
+    from gauss_tpu.serve.durablecheck import history_records
+
+    summary = {"kind": "durable_campaign", "cases": 30, "wall_s": 45.0,
+               "overhead": {"on": {"s_per_request": 0.0012},
+                            "off": {"s_per_request": 0.0005},
+                            "overhead_ratio": 2.4}}
+    recs = history_records(summary)
+    metrics = {m for m, _v, _u in recs}
+    assert metrics == {"durable:s_per_case", "durable:journal_s_per_request"}
+    path = tmp_path / "durable.json"
+    path.write_text(json.dumps(summary))
+    ingested = regress.ingest_file(path)
+    assert {r["metric"] for r in ingested} == metrics
+    assert all(r["kind"] == "durable" for r in ingested)
+
+
+def test_summarize_durability_section(rng, shared_cache, tmp_path):
+    jd = str(tmp_path / "j")
+    stream = str(tmp_path / "durable_events.jsonl")
+    with obs.run(metrics_out=stream, tool="test_durability_summary"):
+        srv = SolverServer(_config(jd), cache=shared_cache).start()
+        srv._stop.set()                   # park the worker: the submit
+        srv._worker.join(timeout=30)      # below must still be queued
+        srv._worker = None                # when the crash hits
+        a, b = _system(rng, 14)
+        srv.submit(a, b, request_id="s0")
+        srv._crash()
+        srv2 = SolverServer(_config(jd), cache=shared_cache).start()
+        srv2.stop(drain=True, timeout=60)
+        with SolverServer(_config(jd), cache=shared_cache) as srv3:
+            srv3.solve(a, b, request_id="s0", timeout=10)
+    from gauss_tpu.obs import registry
+
+    events = registry.read_events(stream)
+    run_id = events[0]["run"]
+    doc = summarize.run_summary(events, run_id)
+    du = doc["durability"]
+    assert du["resumes"]["replayed"] == 1
+    assert du["deduped"] == 1
+    assert du["journal_events"]["open"] >= 3
+    text = summarize.summarize_run(events, run_id)
+    assert "durability (request journal):" in text
+
+
+def test_loadgen_journal_report_and_request_ids(shared_cache, tmp_path):
+    from gauss_tpu.serve.loadgen import LoadgenConfig, format_summary, \
+        run_load
+
+    cfg = LoadgenConfig(mix="random:14", requests=6, warmup=2,
+                        concurrency=2, seed=5, request_ids=True,
+                        serve=_config(str(tmp_path / "j")))
+    with SolverServer(cfg.serve, cache=shared_cache) as srv:
+        summary = run_load(srv, cfg)
+    assert summary["counts"]["ok"] == 6
+    assert summary["journal"]["appends"] > 0
+    assert "journal:" in format_summary(summary)
+    # the minted idempotency keys landed in the journal
+    st = durable.scan(str(tmp_path / "j"))
+    assert any(k.startswith("lg5-") for k in st.by_rid)
+
+
+def test_stop_shutdown_race_still_exactly_one_terminal_with_journal(
+        rng, shared_cache, tmp_path):
+    """The PR-4 shutdown-race guarantee, now with the journal in the loop:
+    every request that submit() admitted holds exactly one journaled
+    terminal even when stop() races a burst of submitters."""
+    jd = str(tmp_path / "j")
+    srv = SolverServer(_config(jd), cache=shared_cache).start()
+    a, b = _system(rng, 12)
+    stop_now = threading.Event()
+    admitted = []
+    lock = threading.Lock()
+
+    def submitter(k):
+        for j in range(12):
+            rid = f"race{k}-{j}"
+            h = srv.submit(a, b, request_id=rid, deadline_s=30.0)
+            if not (h.done and h.result(0).status == STATUS_REJECTED):
+                with lock:
+                    admitted.append(rid)
+            if stop_now.is_set() and j > 4:
+                return
+
+    threads = [threading.Thread(target=submitter, args=(k,))
+               for k in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    stop_now.set()
+    srv.stop(drain=True, timeout=120.0)
+    for t in threads:
+        t.join()
+    st = durable.scan(jd)
+    per_rid = {}
+    for term in st.terminals.values():
+        if term.get("rid"):
+            per_rid[term["rid"]] = per_rid.get(term["rid"], 0) + 1
+    for rid in admitted:
+        assert per_rid.get(rid, 0) == 1, rid
