@@ -11,9 +11,11 @@ TUNE_SMOKE ?= /tmp/gauss_tune_check
 LIVE_SMOKE ?= /tmp/gauss_live_check
 ABFT_SMOKE ?= /tmp/gauss_abft_check
 DURABLE_SMOKE ?= /tmp/gauss_durable_check
+OUTOFCORE_SMOKE ?= /tmp/gauss_outofcore_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
-	structure-check tune-check live-check abft-check durable-check clean
+	structure-check tune-check live-check abft-check durable-check \
+	outofcore-check clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -247,6 +249,28 @@ durable-check:
 	print('durable-check: durability summary ok:', du[0]['resumes'])"
 	$(PYTHON) -m gauss_tpu.obs.requesttrace $(DURABLE_SMOKE)/durable.jsonl \
 	  --check > /dev/null
+
+# The out-of-core gate (CI-callable): the host-streamed blocked LU —
+# only the active panel group + a bounded trailing tile window device-
+# resident, H2D/D2H double-buffered against compute — solved end to end
+# on the CPU proxy and asserted on its three contracts: the 1e-4
+# relative-residual gate, the MEASURED peak of the device-byte ledger
+# under 50% of the full in-core working set (with the trailing region
+# demonstrably tiled), and solve_handoff routing a forced-oversized
+# no-mesh request onto the streamed lane (route event lane=outofcore on
+# the recorded stream). Streamed s_per_solve, the stall fraction
+# (1 - transfer/compute overlap), and the peak device fraction are
+# regress-gated against the committed epochs. The acceptance-scale
+# n=32768 leg runs via `--giant 32768` (minutes; not part of this gate).
+# Timing-gated: honor the serial-ordering note above.
+outofcore-check:
+	rm -rf $(OUTOFCORE_SMOKE) && mkdir -p $(OUTOFCORE_SMOKE)
+	timeout -k 10 420 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.outofcore.check --seed 258458 \
+	  --metrics-out $(OUTOFCORE_SMOKE)/outofcore.jsonl \
+	  --summary-json $(OUTOFCORE_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(OUTOFCORE_SMOKE)/outofcore.jsonl \
+	  > /dev/null
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

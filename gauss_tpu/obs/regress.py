@@ -269,6 +269,22 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "outofcore_bench":
+        # An out-of-core gate summary (python -m gauss_tpu.outofcore.check
+        # --summary-json): streamed seconds-per-solve, the stall fraction
+        # (1 - transfer/compute overlap — the double-buffered pipeline
+        # breaking shows as this jumping toward 1), and the measured peak
+        # device fraction enter history, so the giant-system lane's
+        # streaming efficiency is gated exactly like a perf regression.
+        # Derivation lives with the checker (single source); lazy import
+        # keeps jax out of this module.
+        from gauss_tpu.outofcore.check import history_records as ooc_hist
+
+        for metric, value, unit in ooc_hist(doc):
+            rec = _record(metric, value, path, "outofcore", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "durable_campaign":
         # A kill-the-server campaign summary (python -m gauss_tpu.serve
         # .durablecheck --summary-json): per-case recovery cost and the

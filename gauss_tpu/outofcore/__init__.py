@@ -1,0 +1,36 @@
+"""gauss_tpu.outofcore — host-streamed solves for n beyond device memory.
+
+The full matrix lives in host memory; only the active panel group plus a
+bounded window of trailing column tiles are device-resident, with
+H2D/D2H transfers double-buffered against MXU work. The per-group step
+is the SHARED ``core.blocked._factor_group`` (the checkpointed and ABFT
+paths step the same function), so the streamed factor cannot drift from
+the in-core forms. See stream.py's module docstring for the full design;
+``python -m gauss_tpu.outofcore.check`` is the CI gate.
+
+Quick tour::
+
+    from gauss_tpu import outofcore
+
+    x = outofcore.solve_outofcore(a, b)          # float64, 1e-4-refinable
+    stats = outofcore.last_stream_stats()        # transfers/stalls/peak
+    outofcore.outofcore_fits(65536)              # admission (HBM-shaped)
+
+``solve_handoff(engine="outofcore")`` forces this route;
+oversized single-device requests stream here automatically.
+"""
+
+from gauss_tpu.outofcore.stream import (  # noqa: F401
+    OUTOFCORE_DEVICE_FRAC,
+    PIPELINE_TILE_BUFFERS,
+    OutOfCoreLU,
+    SDCDetectedError,
+    StreamStats,
+    host_memory_budget,
+    last_stream_stats,
+    lu_factor_outofcore,
+    lu_solve_outofcore,
+    outofcore_fits,
+    outofcore_window,
+    solve_outofcore,
+)

@@ -1,0 +1,947 @@
+"""Host-streamed blocked LU — solves for n beyond one device's memory.
+
+The single-chip blocked path holds ~3 matrix copies on device
+(core.blocked.fits_single_chip); past ~34k at f32 on a v5e that is a hard
+wall, and without a multi-device mesh ``solve_handoff`` used to raise an
+explicit error there. This module opens the giant-system workload on ONE
+device: the full matrix lives (and is updated) in HOST memory, and only
+
+- the active panel GROUP's (gh, w) column block, and
+- a bounded WINDOW of trailing (gh, ct) column tiles (a small fixed number
+  of pipeline buffers, sized by :func:`outofcore_window` from
+  ``device_memory_budget()``)
+
+are ever device-resident. H2D/D2H transfers are double-buffered against
+MXU work: tile t+1 is ``jax.device_put`` while tile t's compiled update
+runs, and tile t-1's result is copied back while tile t computes. Every
+transfer and every exposed device stall is an obs SPAN
+(``outofcore.h2d`` / ``outofcore.d2h`` / ``outofcore.compute_wait``) so
+``obs.doctor`` can attribute stream-vs-compute time, and the engine keeps
+a byte LEDGER of every device buffer it holds — ``peak_device_bytes`` is
+measured, not modeled (XLA's in-kernel transients ride on top; the gate
+asserts the ledger peak far enough under the full working set that they
+cannot close the gap).
+
+**Shared math, cannot drift.** The per-group step IS
+:func:`gauss_tpu.core.blocked._factor_group` — the same function the
+one-shot chunked form traces, the checkpointed path steps, and the ABFT
+runner replays — called on a RECTANGULAR (gh, w) group-only buffer
+(``gs=0``, trailing width 0: the in-core last-group trace). The windowed
+trailing update mirrors ``_factor_group``'s right-of-group branch
+operation for operation (the same ``_gdot`` blockwise L-solve scan and
+rank-w GEMM, restricted to one (gh, ct) tile), so the streamed factor
+matches the in-core chunked factor to GEMM-tiling rounding.
+
+**Riders.** ``abft=True`` carries the Huang-Abraham checksum row on the
+host and verifies (a) the group-column identity inside the shared group
+step and (b) the trailing column-sum identity per streamed tile; a
+mismatch raises a typed :class:`SDCDetectedError` localized to (group,
+column). Retired columns leave the device permanently, so the in-core
+final whole-factor identity is unnecessary: every column is checked at
+the moment it retires. ``checkpoint_path`` serializes the host carry —
+the exact ``(m, perm, min_piv, linvs, uinvs, next_group)`` signature of
+gauss_tpu.resilience.checkpoint, through its own ``save_state`` /
+``_load_resume_state`` — every K groups, so a killed giant solve resumes
+instead of restarting.
+
+Fault hooks: ``outofcore.group`` (kill between groups — preemption),
+``outofcore.tile`` (corrupt a trailing tile on its way to the device —
+what the ABFT rider detects).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from contextlib import contextmanager
+from functools import partial
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
+from gauss_tpu.tune import space as _tspace
+
+#: device buffers the tile pipeline keeps live at once: the in-flight
+#: input tile, its output, plus the prefetched next input and the
+#: previous output draining back to host.
+PIPELINE_TILE_BUFFERS = 4
+
+#: fraction of the device budget the streamed working set (group block +
+#: window tiles) may claim. Kept well under the 50%-of-full-working-set
+#: acceptance bar so XLA's in-update transients (~1 tile copy) can never
+#: close the gap. Seeded in tune.space so a sweep can recalibrate it per
+#: hardware epoch alongside the window itself.
+OUTOFCORE_DEVICE_FRAC = _tspace.OUTOFCORE_DEVICE_FRAC_SEED
+
+#: host working set ~ the factor copy being updated in place + the
+#: caller's original operand + refinement/transfer transients.
+OUTOFCORE_HOST_FACTOR = 2.25
+
+#: conservative usable host RAM when the OS cannot report it.
+DEFAULT_HOST_BYTES = 32 * 2**30
+
+#: row-block size for the chunked host-f64 residual matvec (refinement
+#: never materializes a full f64 copy of a giant operand).
+RESIDUAL_ROW_BLOCK = 4096
+
+
+class SDCDetectedError(RuntimeError):
+    """The ABFT checksum rider detected silent data corruption in the
+    streamed factorization — localized to the panel group (and global
+    column) that produced it. With checkpointing enabled the natural
+    recovery is a resume from the last verified checkpoint; under the
+    recovery ladder (resilience.recover) the rung simply escalates."""
+
+    def __init__(self, msg: str, group: int = -1, col: int = -1,
+                 err: float = float("inf")):
+        super().__init__(msg)
+        self.group = group
+        self.col = col
+        self.err = err
+
+
+class OutOfCoreLU(NamedTuple):
+    """Host-resident factorization state — the streamed analog of
+    core.blocked.BlockedLU (same getrf layout, same permuted-row
+    convention, numpy instead of device arrays)."""
+
+    m: np.ndarray           # (npad, npad) factored; rows permuted
+    perm: np.ndarray        # (npad,) gather indices
+    min_abs_pivot: float
+    linv: np.ndarray        # (nb, panel, panel) accumulate-dtype inverses
+    uinv: np.ndarray
+    n: int
+    panel: int
+    abft_err: Optional[np.ndarray] = None  # per-group max mismatch
+
+
+@dataclasses.dataclass
+class StreamStats:
+    """Measured accounting for one streamed factor/solve: transfer and
+    stall walls (mirrored by the obs spans), streamed bytes, and the
+    device-byte ledger's measured peak."""
+
+    n: int = 0
+    npad: int = 0
+    panel: int = 0
+    chunk: int = 0
+    ct: int = 0
+    groups: int = 0
+    tiles: int = 0
+    solves: int = 0
+    h2d_s: float = 0.0
+    d2h_s: float = 0.0
+    compute_wait_s: float = 0.0
+    wall_s: float = 0.0
+    bytes_h2d: int = 0
+    bytes_d2h: int = 0
+    live_device_bytes: int = 0
+    peak_device_bytes: int = 0
+
+    # -- device ledger -----------------------------------------------------
+    def add_dev(self, nbytes: int) -> None:
+        self.live_device_bytes += int(nbytes)
+        if self.live_device_bytes > self.peak_device_bytes:
+            self.peak_device_bytes = self.live_device_bytes
+
+    def sub_dev(self, nbytes: int) -> None:
+        self.live_device_bytes -= int(nbytes)
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def transfer_s(self) -> float:
+        return self.h2d_s + self.d2h_s
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Of the stream engine's blocking+streaming time, the fraction the
+        host spent MOVING TILES while dispatched device work was in flight
+        (transfers are issued strictly after the compute they shadow), vs
+        stalled on the device with nothing left to stream
+        (``compute_wait``). 1.0 = the pipeline fully hid the device behind
+        the stream; a collapse toward 0 means async dispatch broke and
+        every transfer ran against an idle device."""
+        denom = self.transfer_s + self.compute_wait_s
+        return (self.transfer_s / denom) if denom > 0 else 0.0
+
+    @property
+    def stall_fraction(self) -> float:
+        """1 - overlap_fraction (the regress-gated, smaller-is-better
+        form)."""
+        return 1.0 - self.overlap_fraction
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("live_device_bytes", None)
+        d["overlap_fraction"] = round(self.overlap_fraction, 4)
+        d["stall_fraction"] = round(self.stall_fraction, 4)
+        for k in ("h2d_s", "d2h_s", "compute_wait_s", "wall_s"):
+            d[k] = round(d[k], 6)
+        return d
+
+
+#: the stats scope: solve_outofcore opens one so the factor and every
+#: triangular sweep accumulate into a single record; bare factor/solve
+#: calls open their own. The finished record is kept for callers
+#: (last_stream_stats) and emitted as an ``outofcore`` obs event.
+_ACTIVE: Optional[StreamStats] = None
+_LAST: Optional[StreamStats] = None
+
+
+def last_stream_stats() -> Optional[StreamStats]:
+    """The most recent completed streamed operation's accounting."""
+    return _LAST
+
+
+@contextmanager
+def _stats_scope(**fields):
+    """Enter (or join) the active StreamStats scope."""
+    global _ACTIVE, _LAST
+    if _ACTIVE is not None:
+        yield _ACTIVE
+        return
+    stats = StreamStats(**fields)
+    _ACTIVE = stats
+    t0 = time.perf_counter()
+    try:
+        yield stats
+    finally:
+        stats.wall_s += time.perf_counter() - t0
+        _ACTIVE = None
+        _LAST = stats
+
+
+@contextmanager
+def _timed(stats: StreamStats, key: str, name: str, **attrs):
+    """One accounted obs span: wall accumulates into ``stats.<key>`` AND
+    lands on the recorder as a ``span`` event (zero-cost there when no
+    recorder is active — the stats still measure)."""
+    t0 = time.perf_counter()
+    try:
+        with obs.span(name, **attrs):
+            yield
+    finally:
+        setattr(stats, key, getattr(stats, key) + time.perf_counter() - t0)
+
+
+# -- admission + window sizing ----------------------------------------------
+
+
+def host_memory_budget() -> int:
+    """Usable host bytes (OS-reported physical memory with headroom, a
+    conservative constant when unreadable). Monkeypatchable seam for the
+    admission tests."""
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        psz = os.sysconf("SC_PAGE_SIZE")
+        if pages > 0 and psz > 0:
+            return int(0.8 * pages * psz)
+    except (AttributeError, OSError, ValueError):
+        pass
+    return DEFAULT_HOST_BYTES
+
+
+def _group_width(n: int, panel: Optional[int], chunk: Optional[int],
+                 itemsize: int):
+    from gauss_tpu.core import blocked
+
+    panel = blocked._resolve_panel(n, panel, itemsize)
+    if chunk is None:
+        from gauss_tpu.tune import apply as _tune
+
+        chunk = int(_tune.override("outofcore", n, "chunk")
+                    or _tspace.OUTOFCORE_CHUNK_SEED)
+    return panel, int(chunk)
+
+
+def outofcore_window(n: int, panel: Optional[int] = None,
+                     chunk: Optional[int] = None, itemsize: int = 4,
+                     budget: Optional[int] = None) -> int:
+    """The trailing tile width ``ct`` (a panel multiple): what fits the
+    device-budget fraction next to the tallest (first) group block, with
+    ``PIPELINE_TILE_BUFFERS`` copies live for the double-buffered
+    pipeline. A tuned store (op ``outofcore``) short-circuits the formula
+    per (n-bucket, dtype), exactly like the kernel tile widths."""
+    from gauss_tpu.core import blocked
+    from gauss_tpu.tune import apply as _tune
+
+    panel, chunk = _group_width(n, panel, chunk, itemsize)
+    npad = -(-n // panel) * panel
+    tuned = _tune.override("outofcore", n, "ct")
+    if tuned:
+        ct = max(panel, (int(tuned) // panel) * panel)
+    else:
+        budget = (blocked.device_memory_budget() if budget is None
+                  else int(budget))
+        group_bytes = npad * chunk * panel * itemsize
+        avail = OUTOFCORE_DEVICE_FRAC * budget - group_bytes
+        ct = int(avail // (PIPELINE_TILE_BUFFERS * npad * itemsize))
+        ct = max(panel, (ct // panel) * panel)
+    ct = min(ct, npad)
+    obs.record_vmem_estimate(
+        "outofcore_window", n=n, panel=panel, chunk=chunk, ct=ct,
+        itemsize=itemsize,
+        bytes=npad * (chunk * panel + PIPELINE_TILE_BUFFERS * ct) * itemsize)
+    return ct
+
+
+def outofcore_fits(n: int, itemsize: int = 4,
+                   host_budget: Optional[int] = None,
+                   budget: Optional[int] = None,
+                   panel: Optional[int] = None,
+                   chunk: Optional[int] = None) -> bool:
+    """Whether a host-streamed solve can ADMIT an (n, n) system: the host
+    must hold ~``OUTOFCORE_HOST_FACTOR`` matrix copies (the in-place
+    factor + the caller's original + transients), and the device-budget
+    fraction must fit the first group block next to at least a
+    minimum-width (one-panel) tile window. The HBM-shaped sibling of
+    ``fused_fits_vmem`` — emitted as a ``vmem_estimate`` obs event like
+    every other admission check."""
+    from gauss_tpu.core import blocked
+
+    panel, chunk = _group_width(n, panel, chunk, itemsize)
+    npad = -(-n // panel) * panel
+    host_budget = (host_memory_budget() if host_budget is None
+                   else int(host_budget))
+    dev_budget = (blocked.device_memory_budget() if budget is None
+                  else int(budget))
+    host_est = int(OUTOFCORE_HOST_FACTOR * npad * npad * itemsize)
+    dev_est = npad * (chunk * panel
+                      + PIPELINE_TILE_BUFFERS * panel) * itemsize
+    fits = (host_est <= host_budget
+            and dev_est <= OUTOFCORE_DEVICE_FRAC * dev_budget)
+    obs.record_vmem_estimate(
+        "outofcore_hbm", n=n, panel=panel, chunk=chunk, itemsize=itemsize,
+        bytes=dev_est, budget=dev_budget, host_bytes=host_est,
+        host_budget=host_budget, fits=fits)
+    return fits
+
+
+# -- compiled steps (cached on their statics) --------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _group_step(panel: int, gpanels: int, panel_impl: str,
+                gemm_precision: str, abft: bool):
+    """The compiled per-group step — the checkpoint module's donated
+    ``_factor_group`` jit verbatim for the plain form; the same function
+    with the checksum rider threaded for ``abft=True``. Rectangular
+    (gh, w) carry, ``g0=0``: the shared-step contract."""
+    from gauss_tpu.resilience.checkpoint import _group_step_jit
+
+    if not abft:
+        return _group_step_jit(panel, gpanels, panel_impl, gemm_precision)
+    import jax
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.core.matmul import resolve_precision
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(m, perm, min_piv, crow):
+        return blocked._factor_group(
+            m, perm, min_piv, 0, panel, gpanels, panel_impl,
+            resolve_precision(gemm_precision), crow=crow)
+
+    return step
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_step(panel: int, gpanels: int, gemm_precision: str, abft: bool):
+    """The compiled trailing-tile update: the EXACT right-of-group math of
+    ``_factor_group`` (permute rows by the group permutation, blockwise
+    ``U12 = L_g^-1 top`` through the stored diagonal-block inverses, then
+    ``A22_tile -= L21 @ U12``) restricted to one (gh, ct) column tile.
+    The tile buffer is donated — the pipeline's in-place update."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.core.matmul import resolve_precision
+
+    prec = resolve_precision(gemm_precision)
+    w = gpanels * panel
+
+    def _update(grp, linvs, gperm, tile):
+        dtype = tile.dtype
+        ct = tile.shape[1]
+        tp = tile[gperm]
+        top = tp[:w]
+
+        def usolve(x, i):
+            rows = lax.dynamic_slice(grp, (i * panel, 0), (panel, w))
+            r = lax.dynamic_slice(top, (i * panel, 0), (panel, ct))
+            r = r - blocked._gdot(rows, x, prec, dtype)
+            xi = blocked._gdot(linvs[i], r, prec, dtype)
+            return lax.dynamic_update_slice(x, xi, (i * panel, 0)), i
+
+        u12, _ = lax.scan(usolve, jnp.zeros((w, ct), dtype),
+                          jnp.arange(gpanels))
+        fresh = tp[w:] - blocked._gdot(grp[w:], u12, prec, dtype)
+        return u12, fresh
+
+    if not abft:
+        @partial(jax.jit, donate_argnums=(3,))
+        def step(grp, linvs, gperm, tile):
+            u12, fresh = _update(grp, linvs, gperm, tile)
+            return jnp.concatenate([u12, fresh], axis=0)
+
+        return step
+
+    @partial(jax.jit, donate_argnums=(3, 4))
+    def step_abft(grp, linvs, gperm, tile, ctile, lc):
+        u12, fresh = _update(grp, linvs, gperm, tile)
+        # The checksum row's exact rider of the tile GEMM (cf.
+        # _factor_group's crow update), then the trailing column-sum
+        # identity over this tile's live rows.
+        cnew = ctile - jnp.dot(lc, u12, precision=prec)
+        diff = jnp.sum(fresh, axis=0) - cnew[0]
+        diff = jnp.where(jnp.isnan(diff), jnp.inf, jnp.abs(diff))
+        return (jnp.concatenate([u12, fresh], axis=0), cnew,
+                jnp.max(diff), jnp.argmax(diff))
+
+    return step_abft
+
+
+@functools.lru_cache(maxsize=None)
+def _lc_step(panel: int, gpanels: int, gemm_precision: str):
+    """``Lc = c1 @ Ugroup^-1`` for the group's checksum slice — shared
+    checksum math (core.blocked._csum_group_solve), jitted once per group
+    shape."""
+    import jax
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.core.matmul import resolve_precision
+
+    prec = resolve_precision(gemm_precision)
+
+    @jax.jit
+    def f(crow_grp, grp, uinvs):
+        return blocked._csum_group_solve(crow_grp, grp, uinvs, gpanels,
+                                         panel, prec)
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _subst_step(lower: bool):
+    """One streamed block-row substitution step — the body of
+    ``core.blocked._blockwise_substitution_scan`` with the factor's block
+    row ``strip`` streamed in instead of sliced from a device-resident
+    matrix. ``x`` is donated (rebound every step)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    prec = lax.Precision.HIGHEST
+
+    @partial(jax.jit, donate_argnums=(3,))
+    def step(strip, inv_i, rhs, x, i):
+        panel = strip.shape[0]
+        zero = i * 0  # index literal in i's dtype (x64-safe)
+        r = lax.dynamic_slice(rhs, (i * panel, zero),
+                              (panel, rhs.shape[1]))
+        r = r - jnp.dot(strip, x, precision=prec)
+        xi = jnp.dot(inv_i, r, precision=prec)
+        return lax.dynamic_update_slice(x, xi, (i * panel, zero))
+
+    return step
+
+
+# -- the streamed factorization ----------------------------------------------
+
+
+def _stage_host(a_np: np.ndarray, npad: int, np_dtype) -> np.ndarray:
+    """The host working copy: _pad_to_panel's identity-padded layout,
+    built with numpy so the full matrix never touches the device."""
+    n = a_np.shape[0]
+    m = np.zeros((npad, npad), dtype=np_dtype)
+    m[:n, :n] = a_np
+    if npad > n:
+        idx = np.arange(n, npad)
+        m[idx, idx] = 1.0
+    return m
+
+
+def lu_factor_outofcore(a, *, panel: Optional[int] = None,
+                        chunk: Optional[int] = None,
+                        ct: Optional[int] = None,
+                        panel_impl: str = "auto",
+                        gemm_precision: str = "highest",
+                        dtype=None, abft: bool = False,
+                        checkpoint_path=None,
+                        checkpoint_every_groups: int = 1,
+                        resume: bool = True,
+                        keep: bool = False) -> OutOfCoreLU:
+    """Host-streamed blocked LU with partial pivoting.
+
+    Same math as ``lu_factor_blocked_chunked`` — the per-group step is the
+    shared ``_factor_group`` — with the matrix held and updated in host
+    memory and only the active group + a ``ct``-wide tile window device-
+    resident. ``ct`` defaults to :func:`outofcore_window`; ``chunk``
+    (panels per group) consults the tuned store (op ``outofcore``).
+
+    ``abft=True`` verifies the checksum identities per group and per tile
+    (typed :class:`SDCDetectedError` on mismatch, ``abft_err`` on the
+    result otherwise). ``checkpoint_path`` saves the host carry every
+    ``checkpoint_every_groups`` groups through the resilience.checkpoint
+    idiom (atomic, previous generation kept, digest-guarded resume).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.core.matmul import resolve_precision
+
+    a_np = np.asarray(a)
+    n = a_np.shape[0]
+    if a_np.shape != (n, n):
+        raise ValueError(f"expected square matrix, got {a_np.shape}")
+    dtype = jnp.dtype(jnp.float32 if dtype is None else dtype)
+    itemsize = dtype.itemsize
+    blocked._check_lowered_support(dtype, resolve_precision(gemm_precision),
+                                   abft)
+    panel, chunk = _group_width(n, panel, chunk, itemsize)
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    npad = -(-n // panel) * panel
+    nb = npad // panel
+    if ct is None:
+        ct = outofcore_window(n, panel, chunk, itemsize)
+    ct = max(panel, (int(ct) // panel) * panel)
+    np_dtype = np.dtype(dtype)
+
+    with _stats_scope(n=n, panel=panel, chunk=chunk, ct=ct) as stats:
+        stats.n, stats.npad = n, npad
+        stats.panel, stats.chunk, stats.ct = panel, chunk, ct
+        m_host = _stage_host(a_np, npad, np_dtype)
+        perm_host = np.arange(npad, dtype=np.int64)
+        min_piv = jnp.asarray(jnp.inf, dtype)
+        stats.add_dev(min_piv.nbytes)
+        linv_parts, uinv_parts = [], []
+        abft_errs: list = []
+        crow_host = tol = None
+        if abft:
+            from gauss_tpu.resilience import abft as _abft
+
+            crow_host = m_host.sum(axis=0, dtype=np_dtype, keepdims=True)
+            tol = _abft.default_tol(npad, np_dtype,
+                                    float(np.abs(crow_host).max()))
+
+        # -- checkpoint/resume (the resilience.checkpoint carry) ----------
+        start_group = 0
+        ckpt = None
+        if checkpoint_path is not None:
+            from gauss_tpu.resilience import checkpoint as ckpt
+
+            meta = {"schema": ckpt.SCHEMA, "n": n, "panel": panel,
+                    "chunk": chunk, "panel_impl": panel_impl,
+                    "gemm_precision": gemm_precision, "dtype": str(dtype),
+                    "digest": ckpt._digest(a_np), "outofcore": True,
+                    "abft": bool(abft)}
+            state = (ckpt._load_resume_state(os.fspath(checkpoint_path),
+                                             meta) if resume else None)
+            if state is not None:
+                m_host = np.array(state["m"], dtype=np_dtype)
+                perm_host = np.array(state["perm"], dtype=np.int64)
+                min_piv = jnp.asarray(state["min_piv"].item(), dtype)
+                if state["linvs"].size:
+                    linv_parts = [state["linvs"]]
+                    uinv_parts = [state["uinvs"]]
+                start_group = int(state["meta"]["next_group"])
+                if abft:
+                    # The checksum row is reconstructible from the carry:
+                    # retired/updated columns' sums are invariants of the
+                    # data actually on disk — recompute from scratch over
+                    # the RESUMED matrix region still to be factored.
+                    crow_host = _resume_crow(m_host, perm_host, a_np,
+                                             np_dtype, start_group * panel)
+                obs.counter("outofcore.resumes")
+                obs.emit("outofcore", event="resume",
+                         next_group=start_group)
+
+        groups_done = 0
+        for g0 in range(start_group, nb, chunk):
+            _inject.maybe_kill("outofcore.group")
+            gs = g0 * panel
+            gh = npad - gs
+            gpanels = min(chunk, nb - g0)
+            w = gpanels * panel
+
+            # H2D the group's own column block (+ the checksum slice).
+            with _timed(stats, "h2d_s", "outofcore.h2d", what="group",
+                        group=g0, bytes=gh * w * itemsize):
+                grp_dev = jax.device_put(
+                    np.ascontiguousarray(m_host[gs:, gs:gs + w]))
+                gperm_dev = jax.device_put(np.arange(gh, dtype=np.int32))
+                jax.block_until_ready(grp_dev)
+                stats.add_dev(grp_dev.nbytes + gperm_dev.nbytes)
+                stats.bytes_h2d += grp_dev.nbytes
+                crow_dev = None
+                if abft:
+                    crow_dev = jax.device_put(
+                        np.ascontiguousarray(crow_host[:, gs:gs + w]))
+                    stats.add_dev(crow_dev.nbytes)
+                    stats.bytes_h2d += crow_dev.nbytes
+
+            # The shared per-group step (async dispatch: the tile
+            # pipeline's first prefetches overlap the factor itself).
+            step = _group_step(panel, gpanels, panel_impl, gemm_precision,
+                               abft)
+            in_bytes = (grp_dev.nbytes + gperm_dev.nbytes + min_piv.nbytes
+                        + (crow_dev.nbytes if crow_dev is not None else 0))
+            gerr = None
+            if abft:
+                (grp_dev, gperm_dev, min_piv, linvs_dev, uinvs_dev,
+                 crow_dev, gerr, _gcol) = step(grp_dev, gperm_dev, min_piv,
+                                               crow_dev)
+            else:
+                grp_dev, gperm_dev, min_piv, linvs_dev, uinvs_dev = step(
+                    grp_dev, gperm_dev, min_piv, g0=0)
+            stats.sub_dev(in_bytes)
+            stats.add_dev(grp_dev.nbytes + gperm_dev.nbytes + min_piv.nbytes
+                          + linvs_dev.nbytes + uinvs_dev.nbytes
+                          + (crow_dev.nbytes if crow_dev is not None else 0))
+
+            # -- the double-buffered trailing-tile pipeline ----------------
+            tile_errs = _stream_group_tiles(
+                stats, m_host, crow_host, gs, gh, w, ct, panel, gpanels,
+                gemm_precision, abft, grp_dev, linvs_dev, uinvs_dev,
+                gperm_dev, crow_dev, itemsize)
+
+            # Drain the group's own results back to host.
+            with _timed(stats, "compute_wait_s", "outofcore.compute_wait",
+                        what="group", group=g0):
+                jax.block_until_ready(grp_dev)
+            with _timed(stats, "d2h_s", "outofcore.d2h", what="group",
+                        group=g0, bytes=grp_dev.nbytes):
+                gperm_host = np.asarray(gperm_dev)
+                m_host[gs:, gs:gs + w] = np.asarray(grp_dev)
+                linv_parts.append(np.asarray(linvs_dev))
+                uinv_parts.append(np.asarray(uinvs_dev))
+                stats.bytes_d2h += grp_dev.nbytes
+            # Realign the already-factored L columns (left of the group)
+            # with the group's composed permutation — the host-side half
+            # of _factor_group's realignment (right columns were permuted
+            # on device inside each tile update).
+            if gs:
+                m_host[gs:, :gs] = np.take(m_host[gs:, :gs], gperm_host,
+                                           axis=0)
+            perm_host[gs:] = perm_host[gs:][gperm_host]
+
+            if abft:
+                gerr_v = float(np.asarray(gerr))
+                abft_errs.append(max(gerr_v, max(tile_errs, default=0.0)))
+                if abft_errs[-1] > tol:
+                    obs.counter("outofcore.sdc_detected")
+                    obs.emit("outofcore", event="sdc_detected", group=g0,
+                             err=abft_errs[-1], tol=tol)
+                    raise SDCDetectedError(
+                        f"ABFT checksum mismatch {abft_errs[-1]:.3e} "
+                        f"(tol {tol:.3e}) in panel group {g0} of the "
+                        f"streamed factorization", group=g0,
+                        err=abft_errs[-1])
+                crow_host[:, gs:gs + w] = np.asarray(crow_dev)
+
+            for buf in (grp_dev, gperm_dev, linvs_dev, uinvs_dev,
+                        crow_dev):
+                if buf is not None:
+                    stats.sub_dev(buf.nbytes)
+                    buf.delete()
+            groups_done += 1
+            stats.groups += 1
+            obs.counter("outofcore.groups")
+
+            if (ckpt is not None and groups_done % checkpoint_every_groups
+                    == 0 and g0 + chunk < nb):
+                mp_host = np.asarray(min_piv)
+                nbytes = ckpt.save_state(
+                    checkpoint_path,
+                    meta={**meta, "next_group": g0 + chunk,
+                          "panels_done": g0 + chunk},
+                    m=m_host, perm=perm_host, min_piv=mp_host,
+                    linvs=np.concatenate(linv_parts),
+                    uinvs=np.concatenate(uinv_parts))
+                obs.counter("outofcore.checkpoint_saves")
+                obs.emit("outofcore", event="checkpoint",
+                         next_group=g0 + chunk, bytes=int(nbytes))
+
+        if ckpt is not None and not keep:
+            for stale in (os.fspath(checkpoint_path),
+                          ckpt.prev_path(checkpoint_path)):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+
+        mp = float(np.asarray(min_piv))
+        stats.sub_dev(min_piv.nbytes)
+        obs.emit("outofcore", event="factor_complete", **stats.to_dict())
+        return OutOfCoreLU(
+            m=m_host, perm=perm_host, min_abs_pivot=mp,
+            linv=np.concatenate(linv_parts),
+            uinv=np.concatenate(uinv_parts), n=n, panel=panel,
+            abft_err=(np.asarray(abft_errs, dtype=np.float64)
+                      if abft else None))
+
+
+def _resume_crow(m_host, perm_host, a_np, np_dtype, gs):
+    """Rebuild the checksum row after a checkpoint resume: retired columns
+    keep their ORIGINAL sums (only used for provenance), active trailing
+    columns carry the sums of the current (partially updated) trailing
+    block — exactly what the per-tile identity checks verify against."""
+    npad = m_host.shape[0]
+    crow = np.zeros((1, npad), dtype=np_dtype)
+    n = a_np.shape[0]
+    crow[0, :n] = np.asarray(a_np, dtype=np_dtype).sum(axis=0)
+    crow[0, n:] = 1.0
+    if gs:
+        crow[0, gs:] = m_host[gs:, gs:].sum(axis=0, dtype=np_dtype)
+    return crow
+
+
+def _stream_group_tiles(stats, m_host, crow_host, gs, gh, w, ct, panel,
+                        gpanels, gemm_precision, abft, grp_dev, linvs_dev,
+                        uinvs_dev, gperm_dev, crow_dev, itemsize):
+    """The per-group tile pipeline: prefetch tile t+1 while tile t's
+    compiled update runs, drain tile t-1's result while tile t computes.
+    Returns the per-tile checksum mismatches (empty without abft)."""
+    import jax
+
+    npad = m_host.shape[0]
+    cols = [(c0, min(c0 + ct, npad))
+            for c0 in range(gs + w, npad, ct)]
+    if not cols:
+        return []
+    tstep = _tile_step(panel, gpanels, gemm_precision, abft)
+    lc_dev = None
+    if abft:
+        lc_dev = _lc_step(panel, gpanels, gemm_precision)(
+            crow_dev, grp_dev, uinvs_dev)
+        stats.add_dev(lc_dev.nbytes)
+    errs: list = []
+
+    def _h2d(c0, c1):
+        with _timed(stats, "h2d_s", "outofcore.h2d", what="tile",
+                    bytes=gh * (c1 - c0) * itemsize):
+            blk = np.ascontiguousarray(m_host[gs:, c0:c1])
+            # Fault hook "outofcore.tile": corrupt the tile on its way to
+            # the device — the data-corruption surface the ABFT rider's
+            # per-tile identity is there to catch.
+            if _inject.enabled():
+                blk = np.asarray(_inject.corrupt_operand("outofcore.tile",
+                                                         blk))
+            tdev = jax.device_put(blk)
+            cdev = None
+            if abft:
+                cdev = jax.device_put(
+                    np.ascontiguousarray(crow_host[:, c0:c1]))
+                stats.add_dev(cdev.nbytes)
+                stats.bytes_h2d += cdev.nbytes
+            jax.block_until_ready(tdev)
+            stats.add_dev(tdev.nbytes)
+            stats.bytes_h2d += tdev.nbytes
+        return tdev, cdev
+
+    pending = _h2d(*cols[0])
+    prev = None  # (out_dev, cout_dev, err_dev, (c0, c1))
+    for idx, (c0, c1) in enumerate(cols):
+        tdev, cdev = pending
+        # Dispatch this tile's update (async), donating the input buffers.
+        in_bytes = tdev.nbytes + (cdev.nbytes if cdev is not None else 0)
+        if abft:
+            out, cout, err, _col = tstep(grp_dev, linvs_dev, gperm_dev,
+                                         tdev, cdev, lc_dev)
+        else:
+            out = tstep(grp_dev, linvs_dev, gperm_dev, tdev)
+            cout = err = None
+        stats.sub_dev(in_bytes)
+        stats.add_dev(out.nbytes
+                      + (cout.nbytes if cout is not None else 0))
+        # Prefetch the NEXT tile while this one computes.
+        pending = _h2d(*cols[idx + 1]) if idx + 1 < len(cols) else None
+        # Drain the PREVIOUS tile's result while this one computes.
+        if prev is not None:
+            _drain_tile(stats, m_host, crow_host, gs, prev, errs)
+        prev = (out, cout, err, (c0, c1))
+        stats.tiles += 1
+        obs.counter("outofcore.tiles")
+    _drain_tile(stats, m_host, crow_host, gs, prev, errs)
+    if lc_dev is not None:
+        stats.sub_dev(lc_dev.nbytes)
+        lc_dev.delete()
+    return errs
+
+
+def _drain_tile(stats, m_host, crow_host, gs, prev, errs):
+    import jax
+
+    out, cout, err, (c0, c1) = prev
+    with _timed(stats, "compute_wait_s", "outofcore.compute_wait",
+                what="tile"):
+        jax.block_until_ready(out)
+    with _timed(stats, "d2h_s", "outofcore.d2h", what="tile",
+                bytes=out.nbytes):
+        m_host[gs:, c0:c1] = np.asarray(out)
+        stats.bytes_d2h += out.nbytes
+        if cout is not None:
+            crow_host[:, c0:c1] = np.asarray(cout)
+            errs.append(float(np.asarray(err)))
+    stats.sub_dev(out.nbytes + (cout.nbytes if cout is not None else 0))
+    out.delete()
+    if cout is not None:
+        cout.delete()
+
+
+# -- streamed triangular solves ---------------------------------------------
+
+
+def lu_solve_outofcore(fac: OutOfCoreLU, b) -> np.ndarray:
+    """Solve against a host-resident streamed factor: permute, then the
+    two blockwise substitutions of ``core.blocked
+    ._blockwise_substitution_scan`` with the factor's (panel, npad) block
+    rows STREAMED through the same double-buffered h2d pipeline (the
+    solution and diagonal-block inverses stay device-resident — they are
+    O(n * k) and O(nb * panel^2)). Returns float64, shaped like ``b``."""
+    import jax
+
+    from gauss_tpu.core import blocked
+
+    m_host, perm = fac.m, fac.perm
+    npad = m_host.shape[0]
+    nb, panel = fac.linv.shape[0], fac.panel
+    cdt = np.dtype(blocked.accum_dtype(m_host.dtype))
+    b = np.asarray(b)
+    was_vector = b.ndim == 1
+    b2 = b[:, None] if was_vector else b
+    n, k = b2.shape
+    bp = np.zeros((npad, k), dtype=cdt)
+    bp[:n] = b2
+    bp = bp[perm]
+
+    with _stats_scope(n=fac.n, panel=panel) as stats:
+        stats.solves += 1
+        rhs = jax.device_put(bp)
+        linv_dev = jax.device_put(fac.linv)
+        uinv_dev = jax.device_put(fac.uinv)
+        x = jax.device_put(np.zeros((npad, k), dtype=cdt))
+        for buf in (rhs, linv_dev, uinv_dev, x):
+            stats.add_dev(buf.nbytes)
+        x = _stream_substitution(stats, m_host, linv_dev, rhs, x, panel,
+                                 nb, lower=True)
+        # Backward sweep: the forward result becomes the rhs.
+        stats.sub_dev(rhs.nbytes)
+        rhs.delete()
+        rhs = x
+        x = jax.device_put(np.zeros((npad, k), dtype=cdt))
+        stats.add_dev(x.nbytes)
+        x = _stream_substitution(stats, m_host, uinv_dev, rhs, x, panel,
+                                 nb, lower=False)
+        with _timed(stats, "d2h_s", "outofcore.d2h", what="solution",
+                    bytes=x.nbytes):
+            out = np.asarray(x, dtype=np.float64)[:n]
+            stats.bytes_d2h += x.nbytes
+        for buf in (rhs, linv_dev, uinv_dev, x):
+            stats.sub_dev(buf.nbytes)
+            buf.delete()
+    return out[:, 0] if was_vector else out
+
+
+def _stream_substitution(stats, m_host, invs_dev, rhs, x, panel, nb,
+                         lower: bool):
+    """One streamed substitution sweep (same per-block math as the in-core
+    scan; block rows arrive from host, prefetched one ahead)."""
+    import jax
+    import jax.numpy as jnp
+
+    step = _subst_step(lower)
+    order = list(range(nb)) if lower else list(range(nb - 1, -1, -1))
+    itemsize = m_host.dtype.itemsize
+    npad = m_host.shape[0]
+
+    def _h2d(i):
+        with _timed(stats, "h2d_s", "outofcore.h2d", what="strip",
+                    bytes=panel * npad * itemsize):
+            s = jax.device_put(
+                np.ascontiguousarray(m_host[i * panel:(i + 1) * panel]))
+            jax.block_until_ready(s)
+            stats.add_dev(s.nbytes)
+            stats.bytes_h2d += s.nbytes
+        return s
+
+    pending = _h2d(order[0])
+    prev_strip = None
+    for pos, i in enumerate(order):
+        strip = pending
+        x = step(strip, invs_dev[i], rhs, x, jnp.int32(i))
+        pending = _h2d(order[pos + 1]) if pos + 1 < len(order) else None
+        if prev_strip is not None:
+            stats.sub_dev(prev_strip.nbytes)
+            prev_strip.delete()
+        prev_strip = strip
+    with _timed(stats, "compute_wait_s", "outofcore.compute_wait",
+                what="substitution"):
+        jax.block_until_ready(x)
+    if prev_strip is not None:
+        stats.sub_dev(prev_strip.nbytes)
+        prev_strip.delete()
+    return x
+
+
+# -- the refined giant solve -------------------------------------------------
+
+
+def _residual_chunked(a_np: np.ndarray, x: np.ndarray,
+                      b64: np.ndarray) -> np.ndarray:
+    """``b - A @ x`` in f64 without materializing a full f64 copy of a
+    giant operand: row blocks are upcast on the fly."""
+    r = np.empty_like(b64)
+    for r0 in range(0, a_np.shape[0], RESIDUAL_ROW_BLOCK):
+        r1 = min(r0 + RESIDUAL_ROW_BLOCK, a_np.shape[0])
+        blk = a_np[r0:r1]
+        if blk.dtype != np.float64:
+            blk = blk.astype(np.float64)
+        r[r0:r1] = b64[r0:r1] - blk @ x
+    return r
+
+
+def solve_outofcore(a, b, *, panel: Optional[int] = None,
+                    chunk: Optional[int] = None, ct: Optional[int] = None,
+                    iters: int = 3, tol: float = 0.0, dtype=None,
+                    abft: bool = False, checkpoint_path=None,
+                    checkpoint_every_groups: int = 1,
+                    gemm_precision: str = "highest") -> np.ndarray:
+    """Solve ``a @ x = b`` for systems beyond device memory: streamed
+    factorization + streamed triangular solves + host-f64 iterative
+    refinement (chunked residuals — no full f64 operand copy). Returns x
+    float64, shaped like ``b``. One :class:`StreamStats` record covers the
+    whole solve (``last_stream_stats()``; also emitted as an
+    ``outofcore`` obs event)."""
+    a_np = np.asarray(a)
+    n = a_np.shape[0]
+    b64 = np.asarray(b, dtype=np.float64)
+    with _stats_scope(n=n) as stats:
+        with obs.span("outofcore.solve", n=n):
+            fac = lu_factor_outofcore(
+                a_np, panel=panel, chunk=chunk, ct=ct, dtype=dtype,
+                abft=abft, checkpoint_path=checkpoint_path,
+                checkpoint_every_groups=checkpoint_every_groups,
+                gemm_precision=gemm_precision)
+            x = lu_solve_outofcore(fac, b64)
+            x2 = x[:, None] if x.ndim == 1 else x
+            b2 = b64[:, None] if b64.ndim == 1 else b64
+            tol_eff = (tol * min(1.0, float(np.linalg.norm(b64)))
+                       if tol > 0.0 else 0.0)
+            for _ in range(iters):
+                r = _residual_chunked(a_np, x2, b2)
+                if tol > 0.0 and float(np.linalg.norm(r)) <= tol_eff:
+                    break
+                d = lu_solve_outofcore(fac, r)
+                x2 = x2 + (d[:, None] if d.ndim == 1 else d)
+            x = x2[:, 0] if b64.ndim == 1 else x2
+        obs.emit("outofcore", event="solve_complete", **stats.to_dict())
+    return x

@@ -37,6 +37,11 @@ in docs/RESILIENCE.md):
     checkpoint.group        raise (simulated kill) or os._exit between
                             checkpointed factor groups —
                             gauss_tpu.resilience.checkpoint
+    outofcore.group         raise / os._exit between streamed out-of-core
+                            factor groups — gauss_tpu.outofcore.stream
+    outofcore.tile          corrupt one trailing tile on its way to the
+                            device (the abft=True rider's detection
+                            surface) — gauss_tpu.outofcore.stream
     fleet.worker.group      kill / stall / raise a supervised fleet worker
                             between sharded-checkpoint groups (``skip``
                             picks the group) — gauss_tpu.resilience

@@ -218,6 +218,17 @@ def _rung_numpy(a64, b64, panel, iters):
     return np.linalg.solve(a64, b64), None
 
 
+def _rung_outofcore(a64, b64, panel, iters):
+    """Host-streamed rung (gauss_tpu.outofcore): only the active panel
+    group plus a bounded tile window live on device — the serving layer's
+    giant-request lane. An ABFT-detected corruption or admission failure
+    raises typed and the ladder escalates (numpy_f64 is the usual tail)."""
+    from gauss_tpu import outofcore
+
+    return outofcore.solve_outofcore(a64, b64, panel=panel,
+                                     iters=max(2, iters)), None
+
+
 def _rung_abft(a64, b64, panel, iters):
     """Checksum-carrying blocked LU with in-rung detect/localize/replay
     (gauss_tpu.resilience.abft). A transient mid-solve corruption never
@@ -282,6 +293,7 @@ _RUNG_FNS: Dict[str, Callable] = {
     "blockdiag": _rung_blockdiag,
     "abft": _rung_abft,
     "abft_chol": _rung_abft_chol,
+    "outofcore": _rung_outofcore,
 }
 
 #: rungs backed by the checksum-carrying factorizations — the ladder

@@ -70,6 +70,23 @@ class ServeConfig:
     supervised_handoff: bool = False  # route oversized single-RHS solves
     #                                   through the fleet supervisor
     fleet_workers: int = 2          # world size for the supervised lane
+    outofcore_handoff: bool = False  # route handoff requests whose working
+    #                                  set exceeds the device budget
+    #                                  through the host-streamed engine
+    #                                  (gauss_tpu.outofcore) under the
+    #                                  recovery ladder — the giant-request
+    #                                  lane; only the active panel group +
+    #                                  a bounded tile window are ever
+    #                                  device-resident
+    device_budget: Optional[int] = None  # device-byte budget consulted by
+    #                                      the handoff routing (None = the
+    #                                      runtime-reported
+    #                                      device_memory_budget(); an
+    #                                      explicit value caps what the
+    #                                      batched/single-chip lanes may
+    #                                      claim and is how tests force
+    #                                      the out-of-core lane at smoke
+    #                                      sizes)
     abft: bool = False              # checksum-carrying (ABFT) solves on the
     #                                 single-request lanes (handoff): silent
     #                                 data corruption is detected within one

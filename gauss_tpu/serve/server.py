@@ -768,6 +768,27 @@ class SolverServer:
                         req.a.astype(np.float64), req.b.astype(np.float64),
                         workers=cfg.fleet_workers, panel=cfg.panel,
                         refine_iters=max(2, cfg.refine_steps)).x
+                elif (cfg.outofcore_handoff
+                      and not blocked.fits_single_chip(
+                          req.n, budget=cfg.device_budget)):
+                    # Giant-request lane (ISSUE 13): the working set
+                    # exceeds the device budget, so the request streams
+                    # from host memory through the out-of-core rung —
+                    # under the recovery ladder, so a streamed failure
+                    # (SDC detection, admission) escalates to the host
+                    # LAPACK tail instead of failing the request.
+                    from gauss_tpu.resilience import recover
+
+                    lane = "outofcore"
+                    obs.emit("route", tool="serve_handoff",
+                             lane="outofcore", n=req.n,
+                             budget=cfg.device_budget)
+                    rr = recover.solve_resilient(
+                        req.a.astype(np.float64),
+                        req.b.astype(np.float64),
+                        rungs=("outofcore", "numpy_f64"), panel=cfg.panel,
+                        refine_iters=max(2, cfg.refine_steps))
+                    x = rr.x
                 elif cfg.abft and blocked.fits_single_chip(req.n):
                     # ABFT-protected single-chip lane: the checksum-
                     # carrying ladder detects mid-solve corruption within
@@ -786,6 +807,7 @@ class SolverServer:
                 else:
                     x = blocked.solve_handoff(
                         req.a.astype(np.float64), req.b.astype(np.float64),
+                        budget=cfg.device_budget,
                         panel=cfg.panel, iters=max(2, cfg.refine_steps))
         except Exception as e:  # noqa: BLE001 — lane boundary
             if req.resolve(ServeResult(status=STATUS_FAILED, lane=lane,

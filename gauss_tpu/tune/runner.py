@@ -192,6 +192,42 @@ def _measure_panel_fused(n: int, dtype: str, params: Dict[str, Any],
     return best
 
 
+def _measure_outofcore(n: int, dtype: str, params: Dict[str, Any],
+                       seed: int, reps: int,
+                       prune_s: Optional[float]) -> Optional[float]:
+    """Best-of-``reps`` seconds for one host-streamed factor+solve
+    (gauss_tpu.outofcore) at the candidate (ct, chunk) window — the
+    streamed engine's window/group-size axis. The streamed path is
+    host-stepped (per-group jits), so the compile span wraps a full first
+    solve; timed reps then rerun the cached steps."""
+    from gauss_tpu import outofcore
+    from gauss_tpu.utils.timing import timed
+
+    a64, b64 = _seeded_system(n, seed)
+    ct = params.get("ct")
+    chunk = params.get("chunk")
+    kw = dict(ct=None if ct is None else int(ct),
+              chunk=None if chunk is None else int(chunk), iters=1)
+
+    def run_once():
+        return outofcore.solve_outofcore(a64, b64, **kw)
+
+    with obs.compile_span("tune_candidate", op="outofcore", n=n,
+                          **{k: v for k, v in params.items()
+                             if v is not None}):
+        run_once()  # per-group jit compiles land outside the timing
+    best = None
+    for r in range(max(1, reps)):
+        t, _ = timed(run_once, warmup=0, reps=1)
+        best = t if best is None else min(best, t)
+        if r == 0 and prune_s is not None and t > prune_s:
+            obs.emit("tune_sweep", event="pruned", op="outofcore", n=n,
+                     params=params, first_rep_s=round(t, 6),
+                     prune_s=round(prune_s, 6))
+            return None
+    return best
+
+
 #: the most recent converged refine count per (n, dtype-name) measured by
 #: _measure_lowered — read back by the concretizer so the store pins the
 #: MEASURED minimal budget, not the swept cap.
@@ -243,7 +279,8 @@ def _measure_lowered(n: int, dtype: str, params: Dict[str, Any],
 
 _MEASURERS = {"lu_factor": _measure_lu_factor, "matmul": _measure_matmul,
               "panel_fused": _measure_panel_fused,
-              "lowered": _measure_lowered}
+              "lowered": _measure_lowered,
+              "outofcore": _measure_outofcore}
 
 
 def _concrete_lu_factor(n: int, dtype: str,

@@ -99,6 +99,18 @@ ROWELIM_TILE_SEED = (256, 256)
 LOWERED_DTYPE_SEED = "float32"
 LOWERED_REFINE_SEED = 6
 
+#: out-of-core streamed factorization (gauss_tpu.outofcore): trailing
+#: tile width (columns per streamed H2D/D2H tile — trades per-tile MXU
+#: occupancy and transfer granularity against the device window), panels
+#: per streamed group (wider groups amortize the host round-trip per
+#: group but grow the device-resident group block), and the fraction of
+#: the device budget the streamed working set may claim (declared for
+#: operator recalibration, not swept — it encodes the headroom left for
+#: XLA's in-update transients).
+OUTOFCORE_CT_SEED = 4096
+OUTOFCORE_CHUNK_SEED = 16
+OUTOFCORE_DEVICE_FRAC_SEED = 0.25
+
 #: host-f64 refinement rounds per batched serve dispatch
 #: (serve.admission.ServeConfig.refine_steps).
 SERVE_REFINE_SEED = 1
@@ -181,6 +193,16 @@ SPACES: Dict[str, Tuple[Axis, ...]] = {
     "lowered": (
         Axis("dtype", LOWERED_DTYPE_SEED, ("bfloat16", "bf16x3")),
         Axis("refine_steps", LOWERED_REFINE_SEED, (2, 4, 8, 12)),
+    ),
+    # the host-streamed out-of-core engine (gauss_tpu.outofcore): window
+    # and group-size per (n-bucket, dtype, device) — consulted by
+    # outofcore_window / lu_factor_outofcore exactly like the kernel
+    # tiles; the device fraction is declared for operator recalibration.
+    "outofcore": (
+        Axis("ct", OUTOFCORE_CT_SEED, (2048, 8192)),
+        Axis("chunk", OUTOFCORE_CHUNK_SEED, (8, 32)),
+        Axis("device_frac", OUTOFCORE_DEVICE_FRAC_SEED, (),
+             sweep_default=False),
     ),
     # serve-layer knobs consulted at warmup (bucket growth is declared for
     # operators; the pow2 ladder stays the only implemented policy)

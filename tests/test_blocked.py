@@ -474,6 +474,27 @@ def test_device_memory_budget_runtime_reported(monkeypatch):
     assert blocked.device_memory_budget() == blocked.DEFAULT_CHIP_BYTES
 
 
+def test_device_memory_budget_direct_paths(monkeypatch):
+    """The remaining fallback corners, directly (ISSUE 13 satellite —
+    out-of-core admission now hangs off this number): a zero/falsy
+    bytes_limit and memory_stats() ITSELF raising (not just jax.devices)
+    both fall back to the conservative constant."""
+    import jax
+
+    from gauss_tpu.core import blocked
+
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: [_FakeDevice({"bytes_limit": 0})])
+    assert blocked.device_memory_budget() == blocked.DEFAULT_CHIP_BYTES
+
+    class _SickDevice:
+        def memory_stats(self):
+            raise RuntimeError("stats unavailable")
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [_SickDevice()])
+    assert blocked.device_memory_budget() == blocked.DEFAULT_CHIP_BYTES
+
+
 def test_fits_single_chip_uses_runtime_budget(monkeypatch):
     """fits_single_chip threads the runtime-reported budget: 3 copies of
     the f32 working set against 85% of bytes_limit."""
@@ -511,12 +532,29 @@ def test_solve_handoff_routes_by_size(rng):
     np.testing.assert_allclose(x, x_true, rtol=1e-8, atol=1e-8)
 
 
-def test_solve_handoff_single_device_error():
+def test_solve_handoff_single_device_streams(rng, monkeypatch):
+    """An oversized request with NO multi-device mesh now STREAMS through
+    the out-of-core engine instead of raising (ISSUE 13 — the explicit
+    error stopped being a capability); the typed sizing error remains only
+    when the host cannot admit the system either."""
+    from gauss_tpu import obs, outofcore
     from gauss_tpu.core import blocked
     from gauss_tpu.dist.mesh import make_mesh
+    from gauss_tpu.outofcore import stream as ooc_stream
 
-    a = np.eye(8)
-    b = np.zeros(8)
+    n = 96
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    x_true = rng.standard_normal(n)
+    b = a @ x_true
+    with obs.run() as rec:
+        x = blocked.solve_handoff(a, b, budget=16, mesh=make_mesh(1))
+    np.testing.assert_allclose(x, x_true, rtol=1e-8, atol=1e-8)
+    routes = [e for e in rec.events if e["type"] == "route"]
+    assert routes and routes[-1]["lane"] == "outofcore"
+
+    # Host cannot hold it either -> the explicit sizing error survives.
+    monkeypatch.setattr(ooc_stream, "host_memory_budget", lambda: 16)
+    assert not outofcore.outofcore_fits(n)
     with pytest.raises(ValueError, match="single-chip budget"):
         blocked.solve_handoff(a, b, budget=16, mesh=make_mesh(1))
 

@@ -1,0 +1,357 @@
+"""gauss_tpu.outofcore — the host-streamed engine (ISSUE 13).
+
+Covers: numerical identity with the in-core chunked factor (the shared
+_factor_group contract), the 1e-4 solve gate, streaming boundedness (the
+device-byte ledger), the transfer/compute span accounting, window sizing
++ admission, handoff routing (dtype-aware), checkpoint resume, the ABFT
+rider, the recovery rung, the serve lane, and the regress/bench plumbing.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs, outofcore
+from gauss_tpu.outofcore import stream as ooc_stream
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1349)
+
+
+def _system(rng, n, k=None):
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal(n if k is None else (n, k))
+    return a, b
+
+
+def test_factor_bit_identical_to_chunked(rng):
+    """The streamed factor IS the in-core chunked factor: same shared
+    per-group step, same trailing math — bit-identical m/perm/linv/uinv
+    on the CPU proxy (column-tiled trailing GEMMs do not change
+    per-element reduction order)."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    n = 384
+    a, _ = _system(rng, n)
+    fac = outofcore.lu_factor_outofcore(a, panel=64, chunk=2, ct=128)
+    ref = blocked.lu_factor_blocked_chunked(jnp.asarray(a, jnp.float32),
+                                            panel=64, chunk=2)
+    assert np.array_equal(fac.perm, np.asarray(ref.perm))
+    assert np.array_equal(fac.m, np.asarray(ref.m))
+    assert np.array_equal(fac.linv, np.asarray(ref.linv))
+    assert np.array_equal(fac.uinv, np.asarray(ref.uinv))
+    assert fac.min_abs_pivot == pytest.approx(
+        float(ref.min_abs_pivot), rel=0)
+
+
+def test_solve_gate_and_stream_stats(rng):
+    """The refined streamed solve lands far under the 1e-4 gate, and the
+    StreamStats accounting is coherent: the trailing region was tiled,
+    the full matrix was streamed at least once, and the measured device
+    ledger peak stays under half the in-core working set."""
+    n = 256
+    a, b = _system(rng, n)
+    x = outofcore.solve_outofcore(a, b, panel=64, chunk=1, ct=64)
+    rel = np.linalg.norm(a @ x - b) / np.linalg.norm(b)
+    assert rel < 1e-8
+    s = outofcore.last_stream_stats()
+    assert s.tiles >= 2 and s.groups == 4 and s.solves >= 2
+    assert s.bytes_h2d >= n * n * 4          # the matrix went down at least once
+    assert s.bytes_d2h >= n * n * 4          # ... and came back
+    assert 0 < s.peak_device_bytes < 0.5 * 3 * n * n * 4
+    assert s.live_device_bytes == 0          # every buffer accounted + dropped
+    assert 0.0 <= s.overlap_fraction <= 1.0
+    assert s.stall_fraction == pytest.approx(1.0 - s.overlap_fraction)
+
+
+def test_transfer_spans_recorded(rng):
+    """The obs stream carries the per-tile transfer/stall spans (what
+    obs.doctor attributes stream-vs-compute time from) plus the final
+    outofcore accounting event."""
+    n = 192
+    a, b = _system(rng, n)
+    with obs.run() as rec:
+        outofcore.solve_outofcore(a, b, panel=64, chunk=1, ct=64, iters=1)
+    spans = [e["name"] for e in rec.events if e["type"] == "span"]
+    for name in ("outofcore.h2d", "outofcore.d2h", "outofcore.compute_wait"):
+        assert name in spans, f"missing span {name}"
+    oev = [e for e in rec.events if e["type"] == "outofcore"]
+    assert any(e.get("event") == "solve_complete" for e in oev)
+    done = [e for e in oev if e.get("event") == "solve_complete"][0]
+    assert done["peak_device_bytes"] > 0 and done["tiles"] >= 2
+
+
+def test_multi_rhs(rng):
+    n, k = 192, 3
+    a, b = _system(rng, n, k)
+    x = outofcore.solve_outofcore(a, b, panel=64, chunk=1, ct=64)
+    assert x.shape == (n, k)
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-8,
+                               atol=1e-8)
+
+
+def test_window_sizing_and_tuned_consult(monkeypatch):
+    """outofcore_window sizes ct from the budget fraction (panel-multiple,
+    window + group block within OUTOFCORE_DEVICE_FRAC of the budget), and
+    a tuned store short-circuits it (op outofcore, axis ct)."""
+    from gauss_tpu.tune import apply as tapply
+
+    n, panel, chunk = 4096, 128, 4
+    budget = 64 * 2**20
+    ct = outofcore.outofcore_window(n, panel, chunk, itemsize=4,
+                                    budget=budget)
+    assert ct % panel == 0 and ct >= panel
+    workset = n * (chunk * panel + ooc_stream.PIPELINE_TILE_BUFFERS * ct) * 4
+    assert workset <= outofcore.OUTOFCORE_DEVICE_FRAC * budget
+
+    monkeypatch.setattr(tapply, "override",
+                        lambda op, n_, name, **kw: 512
+                        if (op, name) == ("outofcore", "ct") else None)
+    assert outofcore.outofcore_window(n, panel, chunk) == 512
+
+
+def test_admission(monkeypatch):
+    """outofcore_fits: host-side admission against OS RAM, device-side
+    against the budget fraction — the typed-no is the routing error's
+    last line of defense."""
+    assert outofcore.outofcore_fits(512)
+    monkeypatch.setattr(ooc_stream, "host_memory_budget", lambda: 10**6)
+    assert not outofcore.outofcore_fits(4096)
+    monkeypatch.undo()
+    assert not outofcore.outofcore_fits(1 << 20, budget=10**6)
+
+
+def test_handoff_dtype_aware_routing(rng):
+    """ISSUE 13 satellite: itemsize derives from the requested dtype — a
+    bf16 request near the budget routes single-chip where f32 would not,
+    and the route event carries the itemsize it was sized with."""
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+    from gauss_tpu.dist.mesh import make_mesh
+
+    n = 64
+    a, b = _system(rng, n)
+    budget = 3 * n * n * 3  # between the bf16 (2-byte) and f32 working sets
+    with obs.run() as rec:
+        blocked.solve_handoff(a, b, budget=budget, mesh=make_mesh(1),
+                              dtype=jnp.bfloat16, iters=6)
+    routes = [e for e in rec.events if e["type"] == "route"]
+    assert routes[-1]["lane"] == "single_chip"
+    assert routes[-1]["itemsize"] == 2
+    assert routes[-1]["est_bytes"] == 3 * n * n * 2
+
+    with obs.run() as rec:
+        blocked.solve_handoff(a, b, budget=budget, mesh=make_mesh(1))
+    routes = [e for e in rec.events if e["type"] == "route"]
+    assert routes[-1]["lane"] == "outofcore"      # f32 est busts the budget
+    assert routes[-1]["itemsize"] == 4
+
+    # An already-lowered OPERAND keeps its own itemsize too.
+    a32 = a.astype(np.float32)
+    with obs.run() as rec:
+        blocked.solve_handoff(a32, b.astype(np.float32),
+                              budget=3 * n * n * 4, mesh=make_mesh(1))
+    assert [e for e in rec.events
+            if e["type"] == "route"][-1]["itemsize"] == 4
+
+
+def test_handoff_engine_param(rng):
+    from gauss_tpu.core import blocked
+
+    n = 96
+    a, b = _system(rng, n)
+    x = blocked.solve_handoff(a, b, engine="outofcore")
+    np.testing.assert_allclose(x, np.linalg.solve(a, b), rtol=1e-8,
+                               atol=1e-8)
+    with pytest.raises(ValueError, match="unknown handoff engine"):
+        blocked.solve_handoff(a, b, engine="warp")
+    with pytest.raises(ValueError, match="do not apply"):
+        blocked.solve_handoff(a, b, engine="outofcore", unroll=True)
+
+
+def test_checkpoint_resume_bit_identical(rng, tmp_path):
+    """A streamed factorization killed between groups resumes from the
+    checkpoint.py-idiom carry and finishes BIT-IDENTICAL to an
+    uninterrupted run; the checkpoint files are cleaned on success."""
+    n = 256
+    a, _ = _system(rng, n)
+    full = outofcore.lu_factor_outofcore(a, panel=64, chunk=1, ct=64)
+    ck = tmp_path / "giant.ckpt"
+
+    orig = ooc_stream._group_step
+    calls = {"n": 0}
+
+    def preempt(*args, **kw):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("preempted")
+        return orig(*args, **kw)
+
+    ooc_stream._group_step = preempt
+    try:
+        with pytest.raises(RuntimeError, match="preempted"):
+            outofcore.lu_factor_outofcore(a, panel=64, chunk=1, ct=64,
+                                          checkpoint_path=ck)
+    finally:
+        ooc_stream._group_step = orig
+    assert ck.exists()
+    fac = outofcore.lu_factor_outofcore(a, panel=64, chunk=1, ct=64,
+                                        checkpoint_path=ck)
+    assert np.array_equal(fac.m, full.m)
+    assert np.array_equal(fac.perm, full.perm)
+    assert np.array_equal(fac.linv, full.linv)
+    assert not ck.exists()
+
+
+def test_checkpoint_mismatch_typed(rng, tmp_path):
+    """A checkpoint from a DIFFERENT operand is a typed mismatch, never a
+    silently wrong factor (the checkpoint.py digest contract, inherited)."""
+    from gauss_tpu.resilience.checkpoint import CheckpointMismatchError
+
+    n = 128
+    a, _ = _system(rng, n)
+    ck = tmp_path / "ooc.ckpt"
+    outofcore.lu_factor_outofcore(a, panel=64, chunk=1, ct=64,
+                                  checkpoint_path=ck, keep=True)
+    assert ck.exists()  # keep=True leaves the last intermediate carry
+    a2 = a + 1.0
+    with pytest.raises(CheckpointMismatchError):
+        outofcore.lu_factor_outofcore(a2, panel=64, chunk=1, ct=64,
+                                      checkpoint_path=ck)
+
+
+def test_abft_clean_run(rng):
+    n = 256
+    a, _ = _system(rng, n)
+    fac = outofcore.lu_factor_outofcore(a, panel=64, chunk=1, ct=64,
+                                        abft=True)
+    assert fac.abft_err is not None and fac.abft_err.shape == (4,)
+    from gauss_tpu.resilience.abft import default_tol
+
+    assert fac.abft_err.max() < default_tol(256, np.float32,
+                                            float(np.abs(a).max()))
+
+
+def test_abft_detects_tile_corruption(rng):
+    """A corrupted trailing tile (inject site outofcore.tile) trips the
+    per-tile checksum identity: typed SDCDetectedError, localized to the
+    group that produced it."""
+    from gauss_tpu.resilience import inject
+
+    n = 256
+    a, _ = _system(rng, n)
+    plan = inject.FaultPlan.parse("outofcore.tile=nan:seed=7")
+    inject.install(plan)
+    try:
+        with pytest.raises(outofcore.SDCDetectedError) as ei:
+            outofcore.lu_factor_outofcore(a, panel=64, chunk=1, ct=64,
+                                          abft=True)
+    finally:
+        inject.uninstall()
+    assert ei.value.group >= 0 and ei.value.err > 0
+
+
+def test_recover_rung(rng):
+    from gauss_tpu.resilience import recover
+
+    n = 96
+    a, b = _system(rng, n)
+    rr = recover.solve_resilient(a, b, rungs=("outofcore", "numpy_f64"))
+    assert rr.rung == "outofcore" and rr.rung_index == 0
+    np.testing.assert_allclose(rr.x, np.linalg.solve(a, b), rtol=1e-8,
+                               atol=1e-8)
+
+
+def test_serve_outofcore_lane(rng):
+    """ServeConfig(outofcore_handoff=True, device_budget=tiny): an
+    oversized handoff request streams (lane=outofcore) and verifies."""
+    from gauss_tpu.serve.admission import ServeConfig
+    from gauss_tpu.serve.server import SolverServer
+
+    n = 96
+    a, b = _system(rng, n)
+    srv = SolverServer(ServeConfig(ladder=(16, 32), outofcore_handoff=True,
+                                   device_budget=1024, verify_gate=1e-4))
+    srv.start()
+    try:
+        res = srv.submit(a, b).result(timeout=120)
+    finally:
+        srv.stop()
+    assert res.ok and res.lane == "outofcore"
+    np.testing.assert_allclose(res.x, np.linalg.solve(a, b), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_bench_summary_ingest(tmp_path):
+    """kind=outofcore_bench summaries regress-ingest into the streamed
+    metrics (single source: check.history_records)."""
+    from gauss_tpu.obs import regress
+
+    summary = {"kind": "outofcore_bench",
+               "smoke": {"n": 2048, "s_per_solve": 4.4,
+                         "stall_fraction": 0.13,
+                         "peak_device_frac": 0.33},
+               "giant": {"n": 32768, "s_per_solve": 400.0}}
+    p = tmp_path / "ooc.json"
+    p.write_text(json.dumps(summary))
+    recs = regress.ingest_file(p)
+    by = {r["metric"]: r["value"] for r in recs}
+    assert by["outofcore:s_per_solve"] == 4.4
+    assert by["outofcore:stall_fraction"] == 0.13
+    assert by["outofcore:peak_device_frac"] == 0.33
+    assert by["outofcore:n32768/s_per_solve"] == 400.0
+    assert all(r["kind"] == "outofcore" for r in recs)
+
+
+def test_committed_history_epochs():
+    """The repo ships >= 3 seeded outofcore_bench epochs, so the gate's
+    --regress-check has baselines from this PR on."""
+    hist = os.path.join(os.path.dirname(__file__), os.pardir, "reports",
+                        "history.jsonl")
+    metrics = []
+    with open(hist) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rec = json.loads(line)
+                if rec.get("kind") == "outofcore":
+                    metrics.append(rec["metric"])
+    assert metrics.count("outofcore:s_per_solve") >= 3
+    assert metrics.count("outofcore:stall_fraction") >= 3
+
+
+def test_tune_space_axes():
+    from gauss_tpu.tune import space
+
+    axes = {ax.name: ax for ax in space.space_for("outofcore")}
+    assert axes["ct"].seed == space.OUTOFCORE_CT_SEED
+    assert axes["chunk"].seed == space.OUTOFCORE_CHUNK_SEED
+    assert not axes["device_frac"].sweep_default
+    from gauss_tpu.tune.runner import _MEASURERS
+
+    assert "outofcore" in _MEASURERS
+
+
+def test_check_cli_smoke(tmp_path):
+    """The gate CLI end to end at micro sizes: verifies, asserts
+    boundedness + routing, writes the regress-ingestable summary."""
+    from gauss_tpu.outofcore import check
+
+    metrics = tmp_path / "ooc.jsonl"
+    summary = tmp_path / "summary.json"
+    rc = check.main(["--n", "256", "--panel", "64", "--ct", "64",
+                     "--chunk", "1", "--routing-n", "96", "--seed", "7",
+                     "--metrics-out", str(metrics),
+                     "--summary-json", str(summary)])
+    assert rc == 0
+    doc = json.loads(summary.read_text())
+    assert doc["kind"] == "outofcore_bench" and doc["ok"]
+    assert doc["smoke"]["verified"] and doc["smoke"]["streamed"]
+    assert doc["routing"]["verified"]
