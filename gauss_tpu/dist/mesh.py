@@ -53,3 +53,33 @@ def make_mesh_2d_auto(n_devices: Optional[int] = None,
     total = n_devices if n_devices is not None else len(devs)
     rows, cols = squarest_factors(total)
     return make_mesh_2d(rows, cols, devices=devs)
+
+
+BATCH_AXIS = "batch"
+
+
+def lane_slices(devices: Optional[Sequence] = None,
+                width: int = 1) -> list:
+    """Partition the visible devices into contiguous ``width``-device
+    slices — the mesh-serving placement (gauss_tpu.serve.lanes): one async
+    dispatch lane per slice. ``width=1`` is one lane per device (the
+    common case); a wider slice gives one lane a sub-mesh that GSPMD
+    shards the BATCH axis of oversized bucket executables over (see
+    :func:`lane_mesh`). Tail devices that do not fill a whole slice are
+    left unused rather than forming a ragged lane."""
+    devs = list(devices if devices is not None else jax.devices())
+    width = max(1, int(width))
+    if width > len(devs):
+        raise ValueError(f"lane width {width} exceeds the {len(devs)} "
+                         f"visible devices")
+    return [tuple(devs[i:i + width])
+            for i in range(0, len(devs) - width + 1, width)]
+
+
+def lane_mesh(devices: Sequence, axis: str = BATCH_AXIS) -> jax.sharding.Mesh:
+    """A 1-D mesh over one lane's device slice, axis-named for batch
+    sharding: the serve layer device_puts its (B, n, n) operand stacks
+    with ``NamedSharding(lane_mesh(devs), P("batch"))`` and jit/GSPMD
+    partitions the vmapped factor+solve across the slice — the SNIPPETS
+    [2] pattern (sharding is data placement; application code unchanged)."""
+    return jax.sharding.Mesh(np.array(list(devices)), (axis,))

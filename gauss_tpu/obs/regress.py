@@ -68,7 +68,17 @@ RATCHET_BASELINES = {"gauss_n2048_wallclock": 0.001476,
                      # Like the latency record: only ever moves DOWN.
                      "tput:float32/n256/b8/s_per_solve": 0.009319,
                      "tput:float32/n1024/b8/s_per_solve": 0.332399,
-                     "tput:float32/n2048/b8/s_per_solve": 1.430897}
+                     "tput:float32/n2048/b8/s_per_solve": 1.430897,
+                     # The MULTI-LANE record (ISSUE 14, bench.throughput
+                     # --lanes 4): 4 concurrent device-pinned dispatch
+                     # threads through ONE shared executable, best of 3
+                     # committed epochs on the 1-core CPU proxy — which
+                     # measures dispatch pipelining, not MXU scaling, so
+                     # the value sits at the single-lane record, not 4x
+                     # under it; the ratchet guards the dispatch path
+                     # from regressing. Generic ceiling (sub-100ms legs
+                     # see the documented scheduler jitter).
+                     "tput:float32/n256/b8/l4/s_per_solve": 0.010606}
 #: A fresh headline worse than ratchet * this ceiling fails the gate even
 #: when the median band would wave it through (the default ceiling reuses
 #: the documented epoch-drift envelope: beyond 1.5x the best-ever epoch,
@@ -207,6 +217,21 @@ def ingest_file(path) -> List[Dict[str, Any]]:
 
         for metric, value, unit in struct_hist(doc):
             rec = _record(metric, value, path, "structure", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
+    if isinstance(doc, dict) and doc.get("kind") == "mesh_serve":
+        # A mesh-serve-check summary (python -m gauss_tpu.serve.meshcheck
+        # --summary-json): the multi-lane serving plane's throughput /
+        # tail latency and the continuous-batching-vs-fixed-drain ratio
+        # enter history, so a lane-plane regression (slower lanes, a lost
+        # batching win) gates in CI like any perf regression. Derivation
+        # lives with the checker (single source); lazy import keeps jax
+        # out of this module.
+        from gauss_tpu.serve.meshcheck import history_records as mesh_hist
+
+        for metric, value, unit in mesh_hist(doc):
+            rec = _record(metric, value, path, "mesh_serve", unit=unit)
             if rec:
                 records.append(rec)
         return records

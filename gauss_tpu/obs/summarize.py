@@ -149,6 +149,8 @@ def serving_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     caches = [ev for ev in events if ev.get("type") == "serve_cache"]
     retries = [ev for ev in events if ev.get("type") == "serve_retry"]
     fallbacks = [ev for ev in events if ev.get("type") == "serve_fallback"]
+    steals = [ev for ev in events if ev.get("type") == "lane_steal"]
+    scales = [ev for ev in events if ev.get("type") == "lane_scale"]
     routes = [ev for ev in events if ev.get("type") == "route"
               and ev.get("tool") == "solve_handoff"]
     if not (reqs or batches or caches):
@@ -180,9 +182,23 @@ def serving_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     for ev in routes:
         lane = str(ev.get("lane", "?"))
         route_lanes[lane] = route_lanes.get(lane, 0) + 1
+    # Mesh-plane fold: serve_batch events carry ``lane`` when a LaneSet
+    # dispatched them; steal/scale events exist only on the mesh plane.
+    mesh_batches: Dict[str, int] = {}
+    for ev in batches:
+        if ev.get("lane") is not None:
+            k = str(ev["lane"])
+            mesh_batches[k] = mesh_batches.get(k, 0) + 1
+    mesh = {}
+    if mesh_batches or steals or scales:
+        mesh = {"lane_batches": mesh_batches, "steals": len(steals),
+                "stolen_requests": sum(int(ev.get("requests", 0) or 0)
+                                       for ev in steals),
+                "scale_events": len(scales)}
     return {
         "requests": by_status,
         "lanes": by_lane,
+        "mesh": mesh,
         "retries": len(retries),
         "fallbacks": len(fallbacks),
         "latency_s": {"count": len(lat),
@@ -217,6 +233,15 @@ def _serving_lines(sv: Dict[str, Any]) -> List[str]:
                  f"{_f(b['occupancy_mean'])}; cache: {c['hit']} hits / "
                  f"{c['miss']} misses (hit-rate {_f(c['hit_rate'])}), "
                  f"{c['evict']} evictions")
+    mesh = sv.get("mesh")
+    if mesh:
+        per = ", ".join(f"L{k}={v}" for k, v in
+                        sorted(mesh["lane_batches"].items(),
+                               key=lambda kv: int(kv[0])))
+        lines.append(f"  mesh: batches by lane: {per or '-'}; "
+                     f"{mesh['steals']} steal(s) "
+                     f"({mesh['stolen_requests']} request(s)), "
+                     f"{mesh['scale_events']} autoscale event(s)")
     if sv["retries"] or sv["fallbacks"]:
         lines.append(f"  degradation: {sv['retries']} retried batch "
                      f"attempt(s), {sv['fallbacks']} fallback-lane trip(s)")

@@ -142,6 +142,31 @@ def render(view: _View, url: str,
                if g("gauss_tune_store_hits_total") is not None
                or g("gauss_tune_store_misses_total") is not None else ""))
 
+    # Mesh serving plane (serve.lanes): per-lane occupancy/steal panel.
+    # Lane gauges are plain-named gauss_serve_lane<i>_<stat>; one row per
+    # lane index found, plus the set-wide steal/cb/active counters.
+    lane_samples = view.prefixed("gauss_serve_lane")
+    if lane_samples:
+        per: Dict[int, Dict[str, float]] = {}
+        for name, v in lane_samples.items():
+            m = re.match(r"gauss_serve_lane(\d+)_(\w+)", name)
+            if m:
+                per.setdefault(int(m.group(1)), {})[m.group(2)] = v
+        lines.append(
+            f"  mesh: {_fmt(g('gauss_serve_lanes_active'))} active "
+            f"lane(s), steals {_fmt(g('gauss_serve_steals_total', 0))}"
+            f"{rate('gauss_serve_steals_total')}, cb admits "
+            f"{_fmt(g('gauss_serve_cb_admits_total', 0))}"
+            f"{rate('gauss_serve_cb_admits_total')}, scale events "
+            f"{_fmt(g('gauss_serve_lane_scales_total', 0))}")
+        for idx in sorted(per):
+            s = per[idx]
+            lines.append(
+                f"    lane {idx}: depth {_fmt(s.get('queue_depth', 0))}, "
+                f"served {_fmt(s.get('served', 0))}, stolen "
+                f"{_fmt(s.get('stolen', 0))}, occupancy "
+                f"{_fmt(s.get('occupancy'))}")
+
     firing = view.labeled("gauss_slo_firing")
     if firing:
         burns = {(labels.get("slo"), labels.get("window")): v

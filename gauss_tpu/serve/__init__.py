@@ -44,7 +44,14 @@ from gauss_tpu.serve.buckets import (  # noqa: F401
 from gauss_tpu.serve.cache import (  # noqa: F401
     BatchedExecutable,
     CacheKey,
+    CacheView,
     ExecutableCache,
+    shared_cache,
+)
+from gauss_tpu.serve.lanes import (  # noqa: F401
+    Lane,
+    LaneSet,
+    compat_sig,
 )
 from gauss_tpu.serve.durable import (  # noqa: F401
     JournalError,

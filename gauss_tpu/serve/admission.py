@@ -138,6 +138,74 @@ class ServeConfig:
     heartbeat_path: Optional[str] = None  # worker-loop liveness file for
     #                                       the supervisor (durable
     #                                       .supervise); None = off
+    # -- mesh serving (gauss_tpu.serve.lanes) ------------------------------
+    lanes: int = 0                  # dispatch lanes across the device mesh:
+    #                                 0 (default) = the single-queue/
+    #                                 single-worker server, byte-identical
+    #                                 to the pre-mesh path; N > 0 = a
+    #                                 LaneSet of N async dispatch lanes,
+    #                                 each pinned to its own device (or
+    #                                 mesh slice), with key-affinity
+    #                                 placement, work stealing, and
+    #                                 continuous batching
+    lane_width: int = 1             # devices per lane (a mesh SLICE): 1 =
+    #                                 one device per lane; >1 device_puts
+    #                                 the batched operand stacks with a
+    #                                 NamedSharding over the slice's
+    #                                 "batch" axis, so GSPMD runs one
+    #                                 bucket executable data-parallel
+    #                                 across the slice (oversized buckets'
+    #                                 escape hatch; a batch not divisible
+    #                                 by the width falls back to the
+    #                                 slice's first device)
+    continuous_batching: bool = True  # lanes only: admission places a
+    #                                   compatible request (same bucket /
+    #                                   dtype / structure — the CacheKey
+    #                                   batch identity) into the lane's
+    #                                   open IN-FLIGHT forming slot
+    #                                   instead of the queue, and the next
+    #                                   slot forms WHILE the previous
+    #                                   batch computes. False = per-lane
+    #                                   fixed drain cycles (the
+    #                                   single-lane discipline, lingering
+    #                                   batch_linger_s per batch)
+    cb_window_s: float = 0.005      # batch-formation deadline: an
+    #                                 unfilled forming slot dispatches
+    #                                 this long after it opened — the
+    #                                 bound on latency-for-occupancy;
+    #                                 under load slots fill before it
+    #                                 fires and the deadline costs nothing
+    cb_deadline_margin_s: float = 0.01  # continuous batching is DEADLINE-
+    #                                 AWARE: a forming slot also closes
+    #                                 this margin before its earliest
+    #                                 member's request deadline, so
+    #                                 formation never lingers a member
+    #                                 into expiry (the fixed drain cycle
+    #                                 lingers blind — the A/B the
+    #                                 mesh-serve-check gate measures)
+    lane_warmup: bool = True        # lanes only: each lane warms its own
+    #                                 device's executable for every ladder
+    #                                 rung at startup (one backend compile
+    #                                 per (lane, rung) — jax compiles per
+    #                                 placement), so compiles land before
+    #                                 serving, not inside a request's
+    #                                 latency. False = lazy (tests)
+    steal_threshold: int = 2        # work stealing: an idle lane steals
+    #                                 from the deepest sibling queue once
+    #                                 it holds at least this many requests
+    #                                 (1 would steal work the owner is
+    #                                 about to form into a batch)
+    autoscale: bool = False         # lanes + live plane: grow the active
+    #                                 lane count while an SLO burn-rate
+    #                                 alert FIRES (add capacity, don't
+    #                                 just shed) and shrink it back to
+    #                                 min_lanes after a quiet period;
+    #                                 placement targets active lanes only
+    #                                 and dormant lanes' leftovers are
+    #                                 stolen by active ones
+    min_lanes: int = 1              # autoscale floor (and starting count)
+    autoscale_interval_s: float = 0.25  # min seconds between scale steps
+    autoscale_quiet_s: float = 2.0  # alert-free seconds before a shrink
 
 
 @dataclasses.dataclass

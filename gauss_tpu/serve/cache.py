@@ -151,7 +151,8 @@ class BatchedExecutable:
             fac = self._factor(np.ascontiguousarray(eye))
             jax.block_until_ready(self._solve(fac, zer))
 
-    def solve(self, a_pad: np.ndarray, b_pad: np.ndarray) -> np.ndarray:
+    def solve(self, a_pad: np.ndarray, b_pad: np.ndarray,
+              placement=None) -> np.ndarray:
         """Solve the padded batch; returns float64 (B, bucket_n, nrhs).
 
         ``a_pad``/``b_pad`` are host float64 stacks at the cached shape.
@@ -163,15 +164,32 @@ class BatchedExecutable:
         storage dtype and lean on the same refinement — the f32-accuracy
         corrections of the lu_solve precision contract make each round
         contract by ~the factor's storage error.
+
+        ``placement``: a jax Device or Sharding the operand stacks are
+        device_put onto before dispatch — how the mesh serving lanes
+        (gauss_tpu.serve.lanes) pin one executable's work to their own
+        device (or shard its batch axis over their mesh slice). The TRACE
+        is this one cached entry either way; jax compiles per distinct
+        placement, so the backend cost is one compile per lane, paid at
+        that lane's first dispatch, while every lane shares the Python-
+        level build + warmup this cache exists to bound. None (default)
+        is the pre-existing single-lane path, byte-identical.
         """
         dtype = storage_dtype(self.key.dtype)
-        fac = self._factor(a_pad.astype(dtype))
-        x = np.asarray(self._solve(fac, b_pad.astype(dtype)),
-                       dtype=np.float64)
+
+        def _stage(arr):
+            arr = arr.astype(dtype)
+            if placement is not None:
+                import jax
+
+                arr = jax.device_put(arr, placement)
+            return arr
+
+        fac = self._factor(_stage(a_pad))
+        x = np.asarray(self._solve(fac, _stage(b_pad)), dtype=np.float64)
         for _ in range(self.key.refine_steps):
             r = b_pad - np.einsum("bij,bjk->bik", a_pad, x)
-            d = np.asarray(self._solve(fac, r.astype(dtype)),
-                           dtype=np.float64)
+            d = np.asarray(self._solve(fac, _stage(r)), dtype=np.float64)
             x = x + d
         return x
 
@@ -186,44 +204,79 @@ class ExecutableCache:
         self._entries: "OrderedDict[CacheKey, BatchedExecutable]" = \
             OrderedDict()
         self._lock = threading.Lock()
+        #: in-flight builds, for miss coalescing: key -> Event set when the
+        #: owning builder finishes (successfully or not). Racing misses on
+        #: the SAME key wait here instead of compiling a duplicate — with
+        #: multiple dispatch lanes warming one shared cache, N lanes
+        #: hitting a cold bucket must pay ONE build, not N.
+        self._building: dict = {}
         self.hits = 0
         self.misses = 0
+        self.coalesced = 0
         self.evictions = 0
 
     def get(self, key: CacheKey,
             builder: Optional[Callable[[CacheKey], BatchedExecutable]] = None,
             panel: Optional[int] = None) -> BatchedExecutable:
         """The cached executable for ``key``, building (and possibly
-        evicting the least-recently-used entry) on a miss."""
-        with self._lock:
-            entry = self._entries.get(key)
-            if entry is not None:
-                self._entries.move_to_end(key)
-                self.hits += 1
-                obs.counter("serve.cache.hits")
-                obs.emit("serve_cache", event="hit", **key._asdict())
-                return entry
-            self.misses += 1
+        evicting the least-recently-used entry) on a miss. Concurrent
+        misses on the same key COALESCE: one caller builds, the rest block
+        on its completion and share the entry (counted as hits — they
+        never compiled)."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    obs.counter("serve.cache.hits")
+                    obs.emit("serve_cache", event="hit", **key._asdict())
+                    return entry
+                pending = self._building.get(key)
+                if pending is None:
+                    self._building[key] = threading.Event()
+                    self.misses += 1
+                    break
+                self.coalesced += 1
+            # Another thread owns this key's build: wait outside the lock
+            # (a hit on a DIFFERENT key never queues behind a compile),
+            # then re-check — normally a hit; if the build failed, the
+            # loop claims the build slot and retries it.
+            obs.counter("serve.cache.coalesced")
+            obs.emit("serve_cache", event="coalesced", **key._asdict())
+            pending.wait(timeout=600.0)
         # Build OUTSIDE the lock: compiles take seconds and a hit on a
         # different key must not wait behind them.
         obs.counter("serve.cache.misses")
         obs.emit("serve_cache", event="miss", **key._asdict())
-        if _inject.enabled():
-            # Hook point "serve.cache.compile": a simulated scoped-VMEM /
-            # compile failure on executable build — RuntimeError-shaped, so
-            # the server's transient-error retry/breaker path owns it.
-            _inject.maybe_raise("serve.cache.compile")
-        entry = (builder or (lambda k: BatchedExecutable(k, panel=panel)))(key)
-        with self._lock:
-            # A racing miss may have inserted the same key; last write wins
-            # and both callers hold a valid executable.
-            self._entries[key] = entry
-            self._entries.move_to_end(key)
-            while len(self._entries) > self.capacity:
-                evicted, _ = self._entries.popitem(last=False)
-                self.evictions += 1
-                obs.counter("serve.cache.evictions")
-                obs.emit("serve_cache", event="evict", **evicted._asdict())
+        try:
+            if _inject.enabled():
+                # Hook point "serve.cache.compile": a simulated scoped-VMEM
+                # / compile failure on executable build — RuntimeError-
+                # shaped, so the server's transient-error retry/breaker
+                # path owns it.
+                _inject.maybe_raise("serve.cache.compile")
+            entry = (builder
+                     or (lambda k: BatchedExecutable(k, panel=panel)))(key)
+            with self._lock:
+                self._entries[key] = entry
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.capacity:
+                    evicted, _ = self._entries.popitem(last=False)
+                    self.evictions += 1
+                    obs.counter("serve.cache.evictions")
+                    obs.emit("serve_cache", event="evict",
+                             **evicted._asdict())
+        finally:
+            # Release the build slot whether or not the build succeeded —
+            # strictly AFTER the entry insert, so a woken waiter always
+            # finds either the entry (hit) or a free slot to retry a
+            # FAILED build in (the failure still propagates to THIS
+            # caller — the injected-compile-fault contract).
+            with self._lock:
+                done = self._building.pop(key, None)
+            if done is not None:
+                done.set()
         return entry
 
     def __len__(self) -> int:
@@ -241,6 +294,59 @@ class ExecutableCache:
 
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
+                "coalesced": self.coalesced,
                 "evictions": self.evictions, "entries": len(self),
                 "capacity": self.capacity,
                 "hit_rate": round(self.hit_rate, 4)}
+
+
+#: Floor capacity of the process-shared cache: large enough that sharing
+#: it never introduces eviction churn a private default cache would not
+#: have had (the default ladder x a few dtype/structure variants).
+SHARED_CAPACITY_MIN = 64
+
+_shared: Optional[ExecutableCache] = None
+_shared_lock = threading.Lock()
+
+
+def shared_cache(capacity: int = SHARED_CAPACITY_MIN) -> ExecutableCache:
+    """The process-shared :class:`ExecutableCache` — what a
+    :class:`~gauss_tpu.serve.server.SolverServer` uses when its ctor is
+    not handed an explicit ``cache=``. Respawned/supervised server
+    incarnations, multi-lane warmup, and side-by-side servers in one
+    process all land on the same entries, so a bucket executable is
+    compiled once per process instead of once per server object (the
+    PR-12 ``cache=`` sharing, made the default). Capacity only ever
+    GROWS to the largest request seen — a later server asking for more
+    room must not shrink an earlier one's working set."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = ExecutableCache(max(int(capacity),
+                                          SHARED_CAPACITY_MIN))
+        elif int(capacity) > _shared.capacity:
+            _shared.capacity = int(capacity)
+        return _shared
+
+
+class CacheView:
+    """One dispatch lane's view over a shared :class:`ExecutableCache`.
+
+    The mesh serving plane (gauss_tpu.serve.lanes) runs one of these per
+    lane: every ``get`` delegates to the ONE shared cache (so the Python-
+    level build + warmup of a bucket executable is paid once per process —
+    racing lane warmups coalesce on the in-flight build), while the view
+    carries the lane-local state: which keys this lane has dispatched
+    (``warmed`` — the per-lane backend compile has landed once a key is
+    in it) and the lane's device placement, applied by the caller at
+    ``solve(placement=...)`` time."""
+
+    def __init__(self, cache: ExecutableCache):
+        self.cache = cache
+        self.warmed: set = set()
+
+    def get(self, key: CacheKey,
+            panel: Optional[int] = None) -> BatchedExecutable:
+        entry = self.cache.get(key, panel=panel)
+        self.warmed.add(key)
+        return entry

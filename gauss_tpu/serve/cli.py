@@ -119,6 +119,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--panel", type=int, default=None,
                    help="blocked-solver panel width (default: auto, "
                         "consulting the tuned store when one exists)")
+    # -- mesh serving (gauss_tpu.serve.lanes) ------------------------------
+    p.add_argument("--lanes", type=int, default=0,
+                   help="mesh serving: N async dispatch lanes across the "
+                        "device mesh (key-affinity placement, work "
+                        "stealing, continuous batching; 0 = the single-"
+                        "lane server, the pre-mesh path)")
+    p.add_argument("--lane-width", type=int, default=1,
+                   help="devices per lane (a mesh slice; >1 shards the "
+                        "batch axis over the slice via NamedSharding — "
+                        "the oversized-bucket escape hatch; default 1)")
+    p.add_argument("--cb-window", type=float, default=0.005, metavar="S",
+                   help="continuous batching formation deadline: an "
+                        "unfilled in-flight batch slot dispatches this "
+                        "long after opening (default 0.005)")
+    p.add_argument("--continuous-batching",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="lanes only: admit compatible requests into the "
+                        "next in-flight batch slot (--no-continuous-"
+                        "batching = per-lane fixed drain cycles, the A/B "
+                        "baseline)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="grow/shrink the active lane count on the SLO "
+                        "burn-rate alert (requires --live-port; grows on "
+                        "burn up to --lanes, shrinks to --min-lanes after "
+                        "a quiet period)")
+    p.add_argument("--min-lanes", type=int, default=1,
+                   help="autoscale floor and starting count (default 1)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="enable JAX's persistent compilation cache at DIR "
                         "(gauss_tpu.tune.compilecache; also honored from "
@@ -223,6 +250,10 @@ def main(argv=None) -> int:
         refine_steps=args.refine_steps, panel=args.panel,
         dtype=args.dtype, live_port=args.live_port, slo_shed=args.slo_shed,
         journal_dir=args.journal, resume=args.resume,
+        lanes=args.lanes, lane_width=args.lane_width,
+        continuous_batching=args.continuous_batching,
+        cb_window_s=args.cb_window, autoscale=args.autoscale,
+        min_lanes=args.min_lanes,
         heartbeat_path=os.environ.get("GAUSS_SERVE_HEARTBEAT") or None)
     cfg = LoadgenConfig(
         mix=args.mix, requests=args.requests, warmup=args.warmup,

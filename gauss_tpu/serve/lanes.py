@@ -1,0 +1,596 @@
+"""Mesh serving: per-device dispatch lanes, work stealing, continuous
+batching, and SLO-driven lane autoscaling (the pod-scale serving plane).
+
+The single-lane :class:`~gauss_tpu.serve.server.SolverServer` drains one
+queue into one executable lane on one device while the rest of the mesh
+idles. This module is the multi-lane replacement
+(``ServeConfig(lanes=N)``): a :class:`LaneSet` places the bucket
+executables across the devices of the mesh — one async dispatch lane per
+device (or per ``lane_width``-device mesh SLICE, over which GSPMD shards
+the batch axis via ``NamedSharding`` — the SNIPPETS [2] pattern: sharding
+is data placement, the application code is one shared executable). Four
+mechanisms:
+
+- **Key-affinity placement.** Admission routes a request to the lane
+  that owns its batch-compatibility signature (bucket, dtype, structure
+  — the CacheKey identity): the first time a signature is seen it is
+  assigned the next lane round-robin and the assignment STICKS, so
+  compatible traffic collects on a lane and batches densely instead of
+  being sprayed thin across every queue, while distinct signatures
+  spread across the set (a hash could collide them all onto one lane —
+  CRCs of small-bucket signatures do exactly that).
+- **Work stealing.** Affinity under a skewed token mix piles work onto
+  few lanes; an idle lane steals a compatible run from the TAIL of the
+  deepest sibling queue (the victim keeps its head-of-line FIFO order,
+  the thief gets a ready-to-dispatch same-key batch). Occupancy skew
+  self-corrects without a central balancer.
+- **Continuous batching** (the Orca-style admission discipline, Yu et
+  al. OSDI '22). Each lane publishes an open *forming slot* — the next
+  in-flight batch. Admission appends a compatible request directly into
+  the slot instead of the queue, and the slot for batch k+1 forms WHILE
+  batch k computes, so batching costs no lane idle time. A
+  **batch-formation deadline** (``cb_window_s``) bounds the wait for
+  company: under load slots fill before it fires; at idle it is the
+  only latency tax. ``continuous_batching=False`` keeps per-lane fixed
+  drain cycles (the single-lane discipline: drain what is queued, linger
+  ``batch_linger_s`` serially) — the A/B ``make mesh-serve-check``
+  measures.
+- **SLO-driven autoscaling.** With the live plane on and
+  ``autoscale=True``, a firing burn-rate alert GROWS the active lane
+  count (add capacity, don't just shed admission) and a quiet period
+  shrinks it back to ``min_lanes``; placement targets active lanes only
+  and active lanes steal dormant lanes' leftovers.
+
+Every lane owns a :class:`~gauss_tpu.serve.cache.CacheView` over the ONE
+shared :class:`~gauss_tpu.serve.cache.ExecutableCache`: the Python-level
+build/warmup of a bucket executable is paid once per process (racing lane
+warmups coalesce on the in-flight build), and each lane's device
+placement is applied at dispatch (jax compiles per placement — one
+backend compile per lane per key, landing at that lane's first dispatch).
+
+Request lifecycle invariants are unchanged from the single-lane server:
+admission increments the one global depth bound, ``resolve()`` keeps the
+first-wins terminal CAS, the journal hooks ride the request object — so
+stealing a journaled request across lanes moves WHERE it computes, never
+how many terminals it gets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gauss_tpu import obs
+from gauss_tpu.resilience import inject as _inject
+from gauss_tpu.serve import buckets
+from gauss_tpu.serve.cache import CacheView
+
+
+def compat_sig(req, ladder) -> Optional[Tuple]:
+    """The batch-compatibility signature admission, forming slots, and
+    steals all key on: (bucket, dtype, structure) — exactly the fields of
+    the CacheKey a batch compiles against, so two requests with equal
+    sigs can always share one executable dispatch. None = oversized for
+    the ladder (handoff lane; dispatches solo, never co-batched)."""
+    if req.n > ladder[-1]:
+        return None
+    return (buckets.bucket_for(req.n, ladder), req.dtype, req.structure)
+
+
+
+
+class _Forming:
+    """One in-flight batch slot: the batch currently being formed for a
+    lane's next dispatch. Published under the lane lock so admission can
+    join it (continuous batching) until it is closed or full. The close
+    bound is DEADLINE-AWARE: the slot closes at its formation window OR a
+    margin before the earliest member's request deadline, whichever is
+    sooner — formation never lingers a member into expiry (the fixed
+    drain cycle lingers blind; that delta is what mesh-serve-check's A/B
+    measures)."""
+
+    __slots__ = ("sig", "reqs", "deadline", "closed")
+
+    def __init__(self, sig: Optional[Tuple], deadline: float):
+        self.sig = sig
+        self.reqs: list = []
+        self.deadline = deadline        # time.perf_counter() close bound
+        self.closed = False
+
+    def note_member(self, req, margin: float) -> None:
+        """Tighten the close bound for a member's request deadline
+        (req.deadline is perf_counter-based, like the bound)."""
+        if req.deadline is not None:
+            self.deadline = min(self.deadline, req.deadline - margin)
+
+
+class Lane:
+    """One async dispatch lane: a device (or mesh slice), a deque, a
+    worker thread, an open forming slot, and the lane-local stats the
+    loadgen report / gauss-top panel render."""
+
+    def __init__(self, idx: int, devices: Sequence, cache):
+        self.idx = idx
+        self.devices = tuple(devices)
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.queue: deque = deque()
+        self.forming: Optional[_Forming] = None
+        self.closed = False             # leftover collection has started
+        self.thread: Optional[threading.Thread] = None
+        self.warm = threading.Event()   # set once startup warmup finished
+        self.cache_view = CacheView(cache)
+        self.mesh = None
+        if len(self.devices) > 1:
+            from gauss_tpu.dist import mesh as _mesh
+
+            self.mesh = _mesh.lane_mesh(self.devices)
+        # -- stats (written by this lane's thread + the steal path) -------
+        self.served = 0
+        self.batches = 0
+        self.stolen_in = 0              # requests this lane stole
+        self.stolen_out = 0             # requests stolen FROM this lane
+        self.cb_admits = 0              # requests admitted into a forming slot
+        self.occupancy_sum = 0.0
+        self.drain_rate = 0.0           # EWMA requests/s (retry-after input)
+
+    def placement_for(self, batch_bucket: int):
+        """The device placement for one dispatch: the slice-sharded
+        NamedSharding when this lane is wider than one device and the
+        batch bucket divides across it, else the slice's first device
+        (or None off-device — unit tests without placement)."""
+        if self.mesh is not None and batch_bucket % len(self.devices) == 0:
+            import jax
+
+            return jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(self.mesh.axis_names[0]))
+        return self.devices[0] if self.devices else None
+
+    def note_batch(self, served: int, occupancy: float):
+        self.batches += 1
+        self.served += served
+        self.occupancy_sum += occupancy
+        obs.gauge(f"serve.lane{self.idx}.served", self.served)
+        obs.gauge(f"serve.lane{self.idx}.occupancy", occupancy)
+        obs.gauge(f"serve.lane{self.idx}.queue_depth", len(self.queue))
+
+    def stats(self) -> dict:
+        return {
+            "lane": self.idx,
+            "devices": [str(d) for d in self.devices],
+            "served": self.served,
+            "batches": self.batches,
+            "stolen_in": self.stolen_in,
+            "stolen_out": self.stolen_out,
+            "cb_admits": self.cb_admits,
+            "occupancy_mean": (round(self.occupancy_sum / self.batches, 4)
+                               if self.batches else None),
+            "drain_rate": round(self.drain_rate, 4),
+            "queue_depth": len(self.queue),
+        }
+
+
+class LaneSet:
+    """The mesh serving plane: ``config.lanes`` dispatch lanes over the
+    visible devices, started/stopped by the server. See the module
+    docstring for the four mechanisms; the server keeps owning admission
+    bounds, journaling, verification, and terminal resolution."""
+
+    def __init__(self, server, devices: Optional[Sequence] = None,
+                 slo_firing=None):
+        cfg = server.config
+        self.server = server
+        self.cfg = cfg
+        # The SLO consult for autoscaling: default reads the server's
+        # live plane; tests inject a stub.
+        self._slo_firing = (slo_firing if slo_firing is not None
+                            else self._server_slo_firing)
+        count = max(1, int(cfg.lanes))
+        slices: List[Tuple] = []
+        if devices is None:
+            try:
+                import jax
+
+                devices = jax.devices()
+            except Exception:  # pragma: no cover — placement-less fallback
+                devices = []
+        if devices:
+            from gauss_tpu.dist import mesh as _mesh
+
+            slices = _mesh.lane_slices(devices, cfg.lane_width)
+        if not slices:
+            slices = [()]
+        # More lanes than slices oversubscribes round-robin (the CPU
+        # proxy's 8 virtual devices are one core anyway); fewer lanes
+        # than slices leaves devices unused.
+        self.lanes = [Lane(i, slices[i % len(slices)], server.cache)
+                      for i in range(count)]
+        self._active = (max(1, min(cfg.min_lanes, count)) if cfg.autoscale
+                        else count)
+        self._scale_lock = threading.Lock()
+        self._scale_last = 0.0
+        self._burn_last = 0.0
+        self._stop = threading.Event()
+        #: sticky sig -> lane-index affinity map (first seen = next lane
+        #: round-robin), guarded by _place_lock
+        self._sig_lane: dict = {}
+        self._rr = 0
+        self._place_lock = threading.Lock()
+        #: overflow wake-up: admission notifies here when a lane queue
+        #: reaches steal depth, so an IDLE lane steals immediately
+        #: instead of sampling sibling queues and missing the brief
+        #: windows a fast drain leaves them deep (the standard
+        #: work-stealing runtime shape: wake sleepers on overflow)
+        self._steal_cond = threading.Condition()
+        self.steals = 0
+        obs.gauge("serve.lanes_active", self._active)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "LaneSet":
+        for lane in self.lanes:
+            if lane.thread is None or not lane.thread.is_alive():
+                lane.thread = threading.Thread(
+                    target=self._worker, args=(lane,),
+                    name=f"gauss-serve-lane{lane.idx}", daemon=True)
+                lane.thread.start()
+        return self
+
+    def stop(self, timeout: float = 60.0):
+        """Stop the workers and collect every unserved request (queued or
+        in an unclosed forming slot). Returns ``(leftovers, joined)`` —
+        the server rejects the leftovers under its exactly-one-terminal
+        contract; ``joined`` False means a worker is wedged (the journal
+        must then NOT claim a clean shutdown)."""
+        self._stop.set()
+        for lane in self.lanes:
+            with lane.lock:
+                lane.cond.notify_all()
+        joined = True
+        for lane in self.lanes:
+            if lane.thread is not None:
+                lane.thread.join(timeout=timeout)
+                joined = joined and not lane.thread.is_alive()
+                lane.thread = None
+        leftovers: list = []
+        for lane in self.lanes:
+            with lane.lock:
+                lane.closed = True
+                leftovers.extend(lane.queue)
+                lane.queue.clear()
+                if lane.forming is not None and not lane.forming.closed:
+                    lane.forming.closed = True
+                    leftovers.extend(lane.forming.reqs)
+                lane.forming = None
+        return leftovers, joined
+
+    def kill(self) -> None:
+        """Chaos hook (server._crash): stop workers, ABANDON queued work
+        unresolved — the way a kill at a batch boundary leaves it."""
+        self._stop.set()
+        for lane in self.lanes:
+            with lane.lock:
+                lane.closed = True
+                lane.cond.notify_all()
+        for lane in self.lanes:
+            if lane.thread is not None:
+                lane.thread.join(timeout=60.0)
+                lane.thread = None
+
+    # -- admission side ----------------------------------------------------
+
+    def active_count(self) -> int:
+        with self._scale_lock:
+            return self._active
+
+    def active_lanes(self) -> List[Lane]:
+        return self.lanes[:self.active_count()]
+
+    def place(self, req) -> bool:
+        """Place one admitted request: join a compatible open forming
+        slot (continuous batching — the next in-flight batch), else the
+        affinity lane's queue. False = the lane set is closing and cannot
+        own the request (the caller rejects it; nothing is ever silently
+        dropped between admission and the lane queues)."""
+        sig = compat_sig(req, self.server.ladder)
+        active = self.active_lanes()
+        if sig is None:
+            # Oversized: no batching to optimize — least-loaded active lane.
+            home = min(active, key=lambda lane: len(lane.queue))
+        else:
+            with self._place_lock:
+                idx = self._sig_lane.get(sig)
+                if idx is None or idx >= len(active):
+                    # First sight (or its lane went dormant): assign the
+                    # next active lane round-robin and stick.
+                    idx = self._rr % len(active)
+                    self._rr += 1
+                    self._sig_lane[sig] = idx
+            home = active[idx]
+        if self.cfg.continuous_batching and sig is not None:
+            for cand in [home] + [ln for ln in active if ln is not home]:
+                with cand.lock:
+                    f = cand.forming
+                    if (not cand.closed and f is not None and not f.closed
+                            and f.sig == sig
+                            and len(f.reqs) < self.cfg.max_batch):
+                        f.reqs.append(req)
+                        f.note_member(req, self.cfg.cb_deadline_margin_s)
+                        cand.cb_admits += 1
+                        cand.cond.notify_all()
+                        obs.counter("serve.cb_admits")
+                        return True
+        with home.lock:
+            if home.closed:
+                return False
+            home.queue.append(req)
+            depth = len(home.queue)
+            obs.gauge(f"serve.lane{home.idx}.queue_depth", depth)
+            home.cond.notify_all()
+        # Wake idle workers: the home lane picks the request up, and at
+        # steal depth a sibling may get there first. Idle workers park on
+        # this one condition (not their lane cond), so every append must
+        # signal it.
+        with self._steal_cond:
+            self._steal_cond.notify_all()
+        return True
+
+    def drain_rate(self) -> float:
+        """Aggregate EWMA drain rate over the ACTIVE lanes — the
+        lane-set-wide retry-after input (a single global rate
+        over-estimates the wait once several lanes drain in parallel)."""
+        return sum(lane.drain_rate for lane in self.active_lanes())
+
+    # -- worker side -------------------------------------------------------
+
+    def wait_warm(self, timeout: float = 600.0) -> bool:
+        """Block until every lane finished its startup warmup (True) or
+        the timeout passed. With ``lane_warmup=False`` lanes are warm by
+        definition (compiles land lazily at first dispatch)."""
+        deadline = time.monotonic() + timeout
+        for lane in self.lanes:
+            if not lane.warm.wait(max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+    def _warm_lane(self, lane: Lane) -> None:
+        """Per-lane startup warmup: one dispatch per ladder rung at the
+        lane's own placement, so the per-placement backend compile (jax
+        compiles per device/sharding) lands HERE — inside warmup — and
+        never inside a request's latency window. The Python-level
+        build/warmup behind each key is still paid once process-wide
+        (shared cache; racing lanes coalesce). Lanes serve the full batch
+        slot (server._serve_batched pins the mesh batch bucket to
+        max_batch), so one key per rung covers the steady state."""
+        from gauss_tpu.serve.cache import CacheKey
+
+        cfg = self.cfg
+        for rung in self.server.ladder:
+            if self._stop.is_set():
+                break
+            key = CacheKey(bucket_n=int(rung), nrhs=1,
+                           batch=int(cfg.max_batch), dtype=cfg.dtype,
+                           engine=cfg.engine,
+                           refine_steps=cfg.refine_steps)
+            try:
+                exe = lane.cache_view.get(key, panel=cfg.panel)
+                eye = np.broadcast_to(
+                    np.eye(rung), (cfg.max_batch, rung, rung)).copy()
+                zer = np.zeros((cfg.max_batch, rung, 1))
+                with obs.span("lane_warm", lane=lane.idx, bucket_n=rung):
+                    exe.solve(eye, zer,
+                              placement=lane.placement_for(cfg.max_batch))
+            except Exception as e:  # noqa: BLE001 — warmup must not kill serving
+                obs.emit("lane", event="warm_error", lane=lane.idx,
+                         bucket_n=int(rung),
+                         error=f"{type(e).__name__}: {e}"[:200])
+
+    def _worker(self, lane: Lane) -> None:
+        srv = self.server
+        if self.cfg.lane_warmup:
+            self._warm_lane(lane)
+        lane.warm.set()
+        while not self._stop.is_set():
+            if lane.idx == 0 and srv.config.heartbeat_path is not None:
+                srv._heartbeat(srv.config.heartbeat_path)
+            self._maybe_autoscale()
+            if lane.idx >= self.active_count():
+                # Dormant (autoscale shrink): no pulls, no steals. Our
+                # queued leftovers are stolen by active lanes; placement
+                # no longer targets us.
+                with lane.lock:
+                    lane.cond.wait(0.05)
+                continue
+            batch = self._next_batch(lane)
+            if not batch:
+                continue
+            srv._depth_add(-len(batch))
+            if _inject.enabled():
+                # Hook point "serve.worker.dispatch" (parity with the
+                # single-lane worker): injected stall = deadline pressure.
+                _inject.maybe_delay("serve.worker.dispatch")
+            t0 = time.perf_counter()
+            served = srv._dispatch(batch, lane=lane)
+            dt = time.perf_counter() - t0
+            if served and dt > 0:
+                inst = served / dt
+                lane.drain_rate = (0.7 * lane.drain_rate + 0.3 * inst
+                                   if lane.drain_rate else inst)
+            if _inject.enabled():
+                # Hook point "serve.server.batch": the batch boundary
+                # (kind "server_kill" os._exits here — durable campaign).
+                _inject.maybe_kill("serve.server.batch")
+
+    def _next_batch(self, lane: Lane) -> Optional[list]:
+        """One formed batch for ``lane``: close the published forming
+        slot (waiting out its formation deadline if unfilled), seed the
+        next slot from the queue head so formation overlaps this batch's
+        compute, or — with an empty lane — steal from the deepest
+        sibling."""
+        cfg = self.cfg
+        with lane.lock:
+            f = lane.forming
+            if f is None and lane.queue:
+                f = self._open_forming(lane, lane.queue.popleft())
+            if f is not None:
+                self._fill_from_queue(lane, f)
+                while (not self._stop.is_set() and f.sig is not None
+                       and len(f.reqs) < cfg.max_batch):
+                    remaining = f.deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    lane.cond.wait(min(0.005, remaining))
+                    self._fill_from_queue(lane, f)
+                f.closed = True
+                lane.forming = None
+                batch = f.reqs
+                if cfg.continuous_batching and lane.queue:
+                    # The overlap that makes batching continuous: open
+                    # batch k+1's slot BEFORE dispatching batch k, so
+                    # admissions during k's compute join a live slot.
+                    nxt = self._open_forming(lane, lane.queue.popleft())
+                    self._fill_from_queue(lane, nxt)
+                obs.gauge(f"serve.lane{lane.idx}.queue_depth",
+                          len(lane.queue))
+                return batch
+        stolen = self._steal(lane)
+        if stolen:
+            return stolen
+        with lane.lock:
+            if lane.queue or lane.forming is not None:
+                return None
+        # Idle: sleep on the overflow condition — a sibling queue
+        # reaching steal depth wakes us for an immediate steal attempt
+        # (our own queue's appends wake us via the steal cond too, on
+        # the next loop's own-queue check).
+        with self._steal_cond:
+            self._steal_cond.wait(0.02)
+        return None
+
+    def _open_forming(self, lane: Lane, head) -> _Forming:
+        """Open (and publish) a forming slot seeded with ``head``. The
+        formation window is the continuous-batching deadline — tightened
+        per member by its request deadline — or, with continuous batching
+        off, the single-lane linger: the fixed-drain discipline the A/B
+        gate compares against, which lingers BLIND to member deadlines
+        exactly like serve.server._drain_same_bucket always has."""
+        sig = compat_sig(head, self.server.ladder)
+        cb = self.cfg.continuous_batching
+        window = self.cfg.cb_window_s if cb else self.cfg.batch_linger_s
+        f = _Forming(sig, time.perf_counter()
+                     + (window if sig is not None else 0.0))
+        f.reqs.append(head)
+        if cb:
+            f.note_member(head, self.cfg.cb_deadline_margin_s)
+        lane.forming = f
+        return f
+
+    def _fill_from_queue(self, lane: Lane, f: _Forming) -> None:
+        """Pull ``f.sig``-compatible requests from the lane's own queue
+        into the slot (callers hold the lane lock). Incompatible requests
+        keep their relative order at the queue front."""
+        if f.sig is None:
+            return
+        cb = self.cfg.continuous_batching
+        keep: deque = deque()
+        while lane.queue and len(f.reqs) < self.cfg.max_batch:
+            r = lane.queue.popleft()
+            if compat_sig(r, self.server.ladder) == f.sig:
+                f.reqs.append(r)
+                if cb:
+                    f.note_member(r, self.cfg.cb_deadline_margin_s)
+            else:
+                keep.append(r)
+        lane.queue.extendleft(reversed(keep))
+
+    def _steal(self, thief: Lane) -> Optional[list]:
+        """Steal a compatible run from the tail of the deepest sibling
+        queue (active or dormant). Returns a ready-to-dispatch batch —
+        same sig throughout — or None when no sibling is deep enough."""
+        cfg = self.cfg
+        best = None
+        for victim in self.lanes:
+            if victim is thief:
+                continue
+            depth = len(victim.queue)   # racy peek; confirmed under lock
+            if depth >= cfg.steal_threshold and (
+                    best is None or depth > len(best.queue)):
+                best = victim
+        if best is None:
+            return None
+        with best.lock:
+            if best.closed or len(best.queue) < cfg.steal_threshold:
+                return None
+            take = min(max(1, len(best.queue) // 2), cfg.max_batch)
+            got = [best.queue.pop()]
+            sig = compat_sig(got[0], self.server.ladder)
+            while (best.queue and len(got) < take
+                   and compat_sig(best.queue[-1],
+                                  self.server.ladder) == sig):
+                got.append(best.queue.pop())
+            best.stolen_out += len(got)
+            depth_after = len(best.queue)
+        got.reverse()                   # restore submission order
+        thief.stolen_in += len(got)
+        self.steals += 1
+        obs.counter("serve.steals")
+        obs.gauge(f"serve.lane{thief.idx}.stolen", thief.stolen_in)
+        obs.emit("lane_steal", thief=thief.idx, victim=best.idx,
+                 requests=len(got), victim_depth=depth_after)
+        return got
+
+    # -- autoscaling -------------------------------------------------------
+
+    def _server_slo_firing(self) -> bool:
+        live = getattr(self.server, "live", None)
+        return live is not None and live.slo_firing()
+
+    def _maybe_autoscale(self) -> None:
+        """Grow the active lane count while an SLO burn-rate alert fires
+        (capacity, not just shedding — the ISSUE-8 monitor driving the
+        ISSUE-14 plane), shrink after a quiet period. Rate-limited; one
+        step per interval so scaling never flaps batch-to-batch."""
+        cfg = self.cfg
+        if not cfg.autoscale:
+            return
+        now = time.monotonic()
+        with self._scale_lock:
+            if now - self._scale_last < cfg.autoscale_interval_s:
+                return
+            firing = self._slo_firing()
+            if firing:
+                self._burn_last = now
+                if self._active < len(self.lanes):
+                    self._active += 1
+                    self._scale_last = now
+                    obs.counter("serve.lane_scales")
+                    obs.gauge("serve.lanes_active", self._active)
+                    obs.emit("lane_scale", event="grow",
+                             active=self._active, reason="slo_burn")
+            elif (self._active > max(1, cfg.min_lanes)
+                  and now - self._burn_last > cfg.autoscale_quiet_s):
+                self._active -= 1
+                self._scale_last = now
+                obs.counter("serve.lane_scales")
+                obs.gauge("serve.lanes_active", self._active)
+                obs.emit("lane_scale", event="shrink",
+                         active=self._active, reason="burn_quiet")
+
+    # -- reporting ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The lane-set report block (loadgen summary / meshcheck gate)."""
+        return {
+            "lanes": len(self.lanes),
+            "active": self.active_count(),
+            "width": max(1, int(self.cfg.lane_width)),
+            "continuous_batching": bool(self.cfg.continuous_batching),
+            "cb_window_s": self.cfg.cb_window_s,
+            "steals": self.steals,
+            "cb_admits": sum(lane.cb_admits for lane in self.lanes),
+            "per_lane": [lane.stats() for lane in self.lanes],
+        }

@@ -374,6 +374,13 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
                      if k in ("entries", "capacity", "evictions")}},
         "verify_gate": cfg.verify_gate,
     }
+    mesh = server.lane_stats() if hasattr(server, "lane_stats") else None
+    if mesh is not None:
+        # The mesh serving plane was on: the lane-set report (lane count /
+        # active / steals / continuous-batching admits + per-lane
+        # served/stolen/occupancy) rides in the summary — the numbers the
+        # mesh-serve-check gate and the gauss-top lane panel read.
+        summary["mesh"] = mesh
     if getattr(server, "journal", None) is not None:
         # Durable admission was on: the journal's own accounting rides in
         # the report (and the overhead is visible as the delta between a
@@ -400,8 +407,12 @@ def history_records(summary: Dict) -> List[Tuple[str, float]]:
     """The (metric, value) pairs a loadgen summary contributes to the
     regression history (obs.regress ingests these via the serve_loadgen
     ingest path; metric names are mode-qualified so open- and closed-loop
-    epochs never pollute each other's baselines)."""
+    epochs never pollute each other's baselines — and LANE-qualified, so a
+    mesh run's throughput never drags the single-lane serve-check band)."""
     tag = f"serve:{summary.get('mode', 'closed')}"
+    mesh = summary.get("mesh")
+    if mesh:
+        tag += f":l{mesh.get('lanes')}"
     out = []
     tput = summary.get("throughput_rps")
     if isinstance(tput, (int, float)) and tput > 0:
@@ -458,6 +469,17 @@ def format_summary(summary: Dict) -> str:
         + (f"; {summary['retries']} retried batch attempt(s)"
            if summary.get("retries") else ""),
     ]
+    mesh = summary.get("mesh")
+    if mesh:
+        per = ", ".join(
+            f"L{p['lane']}: {p['served']} served/"
+            f"{p['stolen_in']} stolen/"
+            f"occ {_s(p['occupancy_mean'])}" for p in mesh["per_lane"])
+        lines.append(
+            f"  mesh: {mesh['lanes']} lane(s) x{mesh['width']} "
+            f"device(s), {mesh['active']} active, {mesh['steals']} "
+            f"steal(s), {mesh['cb_admits']} continuous-batching admit(s)")
+        lines.append(f"  per-lane: {per}")
     jr = summary.get("journal")
     if jr:
         lines.append(

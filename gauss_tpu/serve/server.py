@@ -45,6 +45,16 @@ keys (``submit(request_id=...)``) dedupe resubmissions against journaled
 terminals — exactly-once terminal statuses across ``kill -9``.
 ``journal_dir=None`` keeps the whole layer compiled out: one ``is None``
 check at admission, none anywhere else.
+
+With ``ServeConfig(lanes=N)`` the single worker is replaced by the MESH
+serving plane (gauss_tpu.serve.lanes): N async dispatch lanes placed
+across the device mesh — one per device, or per ``lane_width``-device
+slice with the batch axis GSPMD-sharded over it — with key-affinity
+placement, work stealing between lane queues, continuous batching
+(admission into the next in-flight batch slot, bounded by a formation
+deadline), and SLO-burn-driven lane autoscaling. Admission bounds,
+journaling, verification, and terminal resolution stay HERE, unchanged;
+``lanes=0`` (default) is the pre-mesh single-lane path, byte-identical.
 """
 
 from __future__ import annotations
@@ -93,11 +103,16 @@ class SolverServer:
         # ``cache``: share one executable cache across server incarnations
         # (the durable chaos campaign restarts dozens of servers; paying a
         # fresh compile set per incarnation would benchmark XLA, not the
-        # recovery protocol). Default: a private cache, as before.
+        # recovery protocol). Default: the PROCESS-SHARED instance
+        # (cache.shared_cache) — respawned/supervised servers and
+        # multi-lane warmup stop paying duplicate compiles; pass an
+        # explicit ExecutableCache for isolation.
         # ``is None``, not ``or``: an EMPTY shared cache is falsy
         # (len() == 0) and ``or`` would silently discard it.
+        from gauss_tpu.serve import cache as _cache_mod
+
         self.cache = (cache if cache is not None
-                      else ExecutableCache(self.config.cache_capacity))
+                      else _cache_mod.shared_cache(self.config.cache_capacity))
         self.health = LaneHealth(self.config.unhealthy_after,
                                  self.config.device_probe_cooldown_s)
         self._queue: "_queue.Queue[ServeRequest]" = _queue.Queue()
@@ -107,6 +122,10 @@ class SolverServer:
         self._drain_rate = 0.0            # EWMA requests/s, for retry-after
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        #: the mesh serving plane (None = single-lane; config.lanes > 0
+        #: builds a serve.lanes.LaneSet at start())
+        self._lanes = None
+        self._stats_lock = threading.Lock()  # batches/served under lanes
         self.batches = 0
         self.requests_served = 0
         self.retries = 0                  # retried batch attempts (total)
@@ -140,14 +159,36 @@ class SolverServer:
     def start(self) -> "SolverServer":
         if self._worker is not None and self._worker.is_alive():
             return self
+        if self._lanes is not None:
+            return self
         if self.config.live_port is not None and self._live_server is None:
             self._start_live()
         self._stop.clear()
         with self._depth_lock:
             self._closed = False
-        self._worker = threading.Thread(target=self._run, name="gauss-serve",
-                                        daemon=True)
-        self._worker.start()
+        if self.config.lanes:
+            # The mesh serving plane (gauss_tpu.serve.lanes): one async
+            # dispatch lane per device / mesh slice instead of the single
+            # worker — placement, stealing, continuous batching, and
+            # autoscaling live there; admission/journal/verify stay here.
+            from gauss_tpu.serve import lanes as _lanes
+
+            self._lanes = _lanes.LaneSet(self).start()
+            # Requests submitted before start() queued on the single-lane
+            # queue (nobody was draining either way); hand them to the
+            # lane set so they are owned by a lane, not orphaned.
+            while True:
+                try:
+                    early = self._queue.get_nowait()
+                except _queue.Empty:
+                    break
+                if early is not None and not self._lanes.place(early):
+                    self._queue.put(early)  # pragma: no cover — closing
+                    break
+        else:
+            self._worker = threading.Thread(target=self._run,
+                                            name="gauss-serve", daemon=True)
+            self._worker.start()
         if self.journal is not None and not self._resumed:
             self._resumed = True
             self._replay()
@@ -186,6 +227,13 @@ class SolverServer:
     def live_url(self) -> Optional[str]:
         """The live endpoint base URL (None when the plane is off)."""
         return self._live_server.url if self._live_server else None
+
+    def lane_stats(self) -> Optional[dict]:
+        """The mesh lane-set report (lanes/active/steals/cb_admits +
+        per-lane served/stolen/occupancy) — None single-lane. The loadgen
+        report and the mesh-serve-check gate both read this."""
+        lanes = self._lanes
+        return lanes.stats() if lanes is not None else None
 
     # -- durability (gauss_tpu.serve.durable) ------------------------------
 
@@ -271,7 +319,10 @@ class SolverServer:
                 continue
             replayed += 1
             self._depth_add(1)
-            self._queue.put(req)
+            if self._lanes is not None:
+                self._lanes.place(req)
+            else:
+                self._queue.put(req)
             obs.counter("serve.replayed")
             obs.emit("serve_admit", id=req.journal_id, trace=req.trace_id,
                      n=req.n, k=req.k, replayed=True,
@@ -290,6 +341,9 @@ class SolverServer:
         no terminal/flush bookkeeping runs. The in-process durable chaos
         campaign uses this where a subprocess would use os._exit."""
         self._stop.set()
+        if self._lanes is not None:
+            self._lanes.kill()      # abandon lane queues unresolved
+            self._lanes = None
         self._queue.put(None)  # type: ignore[arg-type]
         if self._worker is not None:
             self._worker.join(timeout=60.0)
@@ -314,7 +368,26 @@ class SolverServer:
         with self._depth_lock:
             self._closed = True
         joined = True
-        if self._worker is not None:
+        if self._lanes is not None:
+            if drain:
+                deadline = time.monotonic() + timeout
+                while self._depth_snapshot() and time.monotonic() < deadline:
+                    time.sleep(0.005)
+            self._stop.set()
+            leftovers, joined = self._lanes.stop(timeout=timeout)
+            self._lanes = None
+            # Leftovers (non-drain stop / drain timeout) are refused under
+            # the same exactly-one-terminal contract as the queue flush
+            # below — a lane-queued request can never be silently dropped.
+            for req in leftovers:
+                self._depth_add(-1)
+                if req.resolve(ServeResult(status=STATUS_REJECTED,
+                                           error="server stopped")):
+                    obs.counter("serve.rejected")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             trace=req.trace_id, status=STATUS_REJECTED,
+                             reason="server_stopped")
+        elif self._worker is not None:
             if drain:
                 deadline = time.monotonic() + timeout
                 while self._depth_snapshot() and time.monotonic() < deadline:
@@ -375,8 +448,17 @@ class SolverServer:
     def retry_after_hint(self) -> float:
         """Seconds until a full queue has likely drained one batch's worth
         (from the EWMA drain rate; a floor keeps the hint meaningful before
-        any batch has completed)."""
-        rate = max(self._drain_rate, 1e-3)
+        any batch has completed).
+
+        With the mesh plane on, the rate is the LANE SET's aggregate
+        (sum of the active lanes' EWMAs): the single global-queue rate
+        over-estimates the wait once several lanes drain in parallel, and
+        a client told to back off for the single-lane hint would sit out
+        N-1 lanes' worth of capacity."""
+        if self._lanes is not None:
+            rate = max(self._lanes.drain_rate(), 1e-3)
+        else:
+            rate = max(self._drain_rate, 1e-3)
         return round(min(60.0, max(0.01, self.config.max_batch / rate)), 4)
 
     def submit(self, a, b, deadline_s: Optional[float] = None,
@@ -408,6 +490,7 @@ class SolverServer:
         re-solving, which is what makes crash recovery exactly-once from
         the client's view."""
         jr = self.journal
+        lanes = self._lanes  # snapshot: a concurrent stop() nulls the attr
         if jr is not None and request_id:
             pending = self._rid_pending.get(request_id)
             if pending is not None:
@@ -484,7 +567,19 @@ class SolverServer:
                     if request_id:
                         self._rid_pending[request_id] = req
                 self._depth += 1
-                self._queue.put(req)
+                if lanes is None:
+                    self._queue.put(req)
+        if not closed and not full and lanes is not None:
+            # Lane placement happens OUTSIDE the depth lock (it takes
+            # per-lane locks; the worker threads take those and then the
+            # depth lock — nesting them here would order locks both
+            # ways). The accounting hole is closed on the other side: a
+            # place() refused by a closing lane set is rejected right
+            # here, and one that lands is owned by stop()'s leftover
+            # collection — either way exactly one terminal.
+            if not lanes.place(req):
+                self._depth_add(-1)
+                closed = True
         if closed:
             if req.resolve(ServeResult(status=STATUS_REJECTED,
                                        error="server stopped")):
@@ -608,9 +703,11 @@ class SolverServer:
 
     # -- dispatch ---------------------------------------------------------
 
-    def _dispatch(self, batch) -> int:
+    def _dispatch(self, batch, lane=None) -> int:
         """Serve one same-bucket batch (or one oversized request); returns
-        the number of requests resolved."""
+        the number of requests resolved. ``lane``: the dispatching mesh
+        lane (serve.lanes) — carries the device placement and takes the
+        per-lane stats; None is the single-lane worker."""
         now = time.perf_counter()
         live = []
         for req in batch:
@@ -634,14 +731,22 @@ class SolverServer:
             for req in live:
                 self._serve_handoff(req)
             return len(batch)
-        self._serve_batched(live)
+        self._serve_batched(live, lane=lane)
         return len(batch)
 
-    def _serve_batched(self, reqs) -> None:
+    def _serve_batched(self, reqs, lane=None) -> None:
         cfg = self.config
         bucket_n = buckets.bucket_for(reqs[0].n, self.ladder)
         nrhs = buckets.pow2_bucket(max(r.k for r in reqs))
-        bb = buckets.pow2_bucket(len(reqs), cap=cfg.max_batch)
+        # Mesh lanes serve a FIXED batch slot (always max_batch, identity-
+        # padded): jax compiles one executable per (key, placement), so a
+        # pow2 ladder of batch shapes would multiply the per-LANE backend
+        # compiles by its length — the fixed slot caps them at one per
+        # ladder rung per lane, all paid in lane warmup. Filling the slot
+        # is then exactly what continuous batching is for. The single-
+        # lane path keeps the pre-existing pow2 batch bucketing.
+        bb = (cfg.max_batch if lane is not None
+              else buckets.pow2_bucket(len(reqs), cap=cfg.max_batch))
         # Batch-level records carry the identity of EVERY member request
         # (the trace_id list + the request count), so per-request serving
         # percentiles and span trees are computable from per-batch spans —
@@ -675,23 +780,31 @@ class SolverServer:
             for i in range(len(reqs), bb):  # batch padding: identity systems
                 a_pad[i] = np.eye(bucket_n)
 
+        # Mesh lane dispatch: the executable comes through the lane's
+        # view of the ONE shared cache (build/warmup paid once across
+        # lanes — racing warmups coalesce) and the operand stacks are
+        # placed on the lane's device / sharded over its mesh slice.
+        placement = lane.placement_for(bb) if lane is not None else None
         t0 = time.perf_counter()
         x = None
         err: Optional[BaseException] = None
         for attempt in range(cfg.max_retries + 1):
             try:
-                exe = self.cache.get(key, panel=cfg.panel)
+                exe = (lane.cache_view.get(key, panel=cfg.panel)
+                       if lane is not None
+                       else self.cache.get(key, panel=cfg.panel))
                 with obs.span("serve_batch_solve", bucket_n=bucket_n,
                               batch=len(reqs), requests=len(reqs),
                               traces=traces):
-                    x = exe.solve(a_pad, b_pad)
+                    x = exe.solve(a_pad, b_pad, placement=placement)
                 err = None
                 break
             except Exception as e:  # noqa: BLE001 — lane boundary
                 err = e
                 if not is_transient_device_error(e):
                     break
-                self.retries += 1
+                with self._stats_lock:
+                    self.retries += 1
                 obs.counter("serve.retries")
                 obs.emit("serve_retry", attempt=attempt, bucket_n=bucket_n,
                          requests=len(reqs), traces=traces,
@@ -726,14 +839,18 @@ class SolverServer:
 
         self.health.record_success()
         obs.gauge("serve.breaker_open", 0.0)
-        self.batches += 1
+        with self._stats_lock:
+            self.batches += 1
         occupancy = len(reqs) / bb
+        if lane is not None:
+            lane.note_batch(len(reqs), occupancy)
         obs.counter("serve.batches")
         obs.histogram("serve.batch_occupancy", occupancy)
         obs.emit("serve_batch", bucket_n=bucket_n, nrhs=nrhs,
                  batch=len(reqs), batch_bucket=bb, occupancy=occupancy,
                  seconds=round(batch_s, 6), requests=len(reqs),
                  traces=traces,
+                 **({"lane": lane.idx} if lane is not None else {}),
                  **({"structure": reqs[0].structure}
                     if reqs[0].structure else {}))
         for i, req in enumerate(reqs):
@@ -877,7 +994,8 @@ class SolverServer:
                                        rel_residual=rel,
                                        sdc_detected=sdc_detected)):
             return  # cancelled mid-compute: the client owns the terminal
-        self.requests_served += 1
+        with self._stats_lock:
+            self.requests_served += 1
         obs.counter("serve.served")
         if sdc_detected:
             obs.counter("serve.sdc_detected")

@@ -402,11 +402,14 @@ def test_serve_dtype_lanes_end_to_end(rng):
     requests never share a batch or an executable, every solution passes
     the verify gate, and both dtype entries exist in the cache."""
     from gauss_tpu.serve.admission import ServeConfig
+    from gauss_tpu.serve.cache import ExecutableCache
     from gauss_tpu.serve.server import SolverServer
 
     cfg = ServeConfig(ladder=(32, 64), max_batch=4, refine_steps=2,
                       verify_gate=1e-4)
-    with SolverServer(cfg) as server:
+    # cache=: the exact-key-set assertion below needs isolation from the
+    # process-shared default cache other tests populate.
+    with SolverServer(cfg, cache=ExecutableCache(8)) as server:
         handles = []
         operands = []
         for i in range(6):

@@ -1,0 +1,505 @@
+"""Mesh serving plane tests (ISSUE 14): the LaneSet — per-device dispatch
+lanes, sticky key-affinity placement, work stealing, continuous batching
+with a deadline-aware formation window, SLO-driven lane autoscaling — plus
+the satellites: the process-shared default ExecutableCache with coalesced
+builds (two lanes warming one bucket compile once), the per-lane-set
+retry-after, the loadgen mesh report block and lane-qualified history
+tags, journal exactly-once across steals, the multi-lane throughput leg,
+and the mesh_serve regress ingest.
+
+All CPU; conftest forces 8 virtual devices, so real per-device placement
+(and the width>1 NamedSharding slice path) is exercised in-process.
+Servers here pass lane_warmup=False (the per-placement backend compiles
+land lazily and stay in the process-wide jit cache across tests) and
+share LADDER/max_batch so the compiled set stays small.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from gauss_tpu import obs
+from gauss_tpu.obs import regress, summarize
+from gauss_tpu.serve import (
+    CacheKey,
+    ExecutableCache,
+    LaneSet,
+    ServeConfig,
+    SolverServer,
+    compat_sig,
+    shared_cache,
+)
+from gauss_tpu.serve import loadgen
+from gauss_tpu.serve.cache import CacheView
+from gauss_tpu.verify import checks
+
+LADDER = (16, 32)
+
+
+def _system(rng, n, k=None):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    b = rng.standard_normal(n) if k is None else rng.standard_normal((n, k))
+    return a, b
+
+
+def _config(**over):
+    kw = dict(ladder=LADDER, max_batch=4, panel=16, refine_steps=1,
+              verify_gate=1e-4, lanes=2, lane_warmup=False,
+              cb_window_s=0.01)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+# -- lane set basics -------------------------------------------------------
+
+def test_multi_lane_serve_end_to_end(rng):
+    """lanes=N serves and verifies mixed-bucket traffic; every request
+    resolves OK, the lane stats account for all served requests, and the
+    single-lane path is untouched when lanes=0."""
+    with SolverServer(_config(lanes=2)) as srv:
+        assert srv._lanes is not None and srv._worker is None
+        handles = []
+        for i in range(12):
+            a, b = _system(rng, [8, 12, 16, 24][i % 4])
+            handles.append((a, b, srv.submit(a, b)))
+        for a, b, h in handles:
+            res = h.result(120)
+            assert res.ok, (res.status, res.error)
+            assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+        st = srv.lane_stats()
+        assert st["lanes"] == 2
+        assert sum(p["served"] for p in st["per_lane"]) == 12
+    # lanes=0 (default): the pre-mesh single-worker path, no LaneSet.
+    with SolverServer(_config(lanes=0)) as srv:
+        assert srv._lanes is None and srv._worker is not None
+        assert srv.lane_stats() is None
+
+
+def test_affinity_spreads_distinct_sigs(rng):
+    """Sticky first-seen placement: distinct compat signatures land on
+    distinct lanes (round-robin), and repeats stick to their lane."""
+    with SolverServer(_config(lanes=2, continuous_batching=False)) as srv:
+        ls = srv._lanes
+        a16, b16 = _system(rng, 12)   # bucket 16
+        a32, b32 = _system(rng, 24)   # bucket 32
+        srv.solve(a16, b16)
+        srv.solve(a32, b32)
+        srv.solve(a16, b16)
+        sigs = list(ls._sig_lane.items())
+        assert len(sigs) == 2
+        assert {idx for _, idx in sigs} == {0, 1}  # spread, not collided
+
+
+def test_work_stealing_under_skew(rng):
+    """All traffic shares ONE sig (affinity floods one lane); a burst
+    deeper than the hot lane's batch slot must engage the sibling's
+    steal path, and everything still serves exactly once."""
+    with SolverServer(_config(lanes=2, max_batch=2,
+                              continuous_batching=False)) as srv:
+        systems = [_system(rng, 12) for _ in range(16)]
+        handles = [srv.submit(a, b) for a, b in systems]
+        for (a, b), h in zip(systems, handles):
+            res = h.result(120)
+            assert res.ok
+            assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+        st = srv.lane_stats()
+        assert st["steals"] >= 1
+        assert sum(p["stolen_in"] for p in st["per_lane"]) == \
+            sum(p["stolen_out"] for p in st["per_lane"])
+        assert sum(p["served"] for p in st["per_lane"]) == 16
+
+
+def test_oversized_routes_handoff_in_mesh_mode(rng):
+    """Past the ladder top a request dispatches solo through the handoff
+    lane — compat_sig is None, never co-batched."""
+    a, b = _system(rng, 48)  # > LADDER[-1] = 32
+    with SolverServer(_config(lanes=2)) as srv:
+        res = srv.solve(a, b, timeout=300)
+        assert res.ok and res.lane in ("handoff", "fleet")
+
+    class _Req:
+        n = 48
+        dtype = None
+        structure = None
+
+    assert compat_sig(_Req(), LADDER) is None
+
+
+def test_stop_rejects_lane_leftovers(rng):
+    """A non-drain stop refuses queued lane work with exactly one
+    'rejected' terminal per request — nothing hangs, nothing doubles."""
+    srv = SolverServer(_config(lanes=2, continuous_batching=False,
+                               batch_linger_s=0.5, max_batch=2))
+    srv.start()
+    handles = [srv.submit(*_system(rng, 12)) for _ in range(8)]
+    srv.stop(drain=False, timeout=5.0)
+    statuses = [h.result(30).status for h in handles]
+    assert all(s in ("ok", "rejected") for s in statuses)
+    assert len(statuses) == 8
+
+
+# -- shared cache + coalesced builds (satellite) ----------------------------
+
+def test_default_cache_is_process_shared():
+    s1 = SolverServer(_config(lanes=0))
+    s2 = SolverServer(_config(lanes=0))
+    assert s1.cache is s2.cache is shared_cache()
+    # Explicit cache= keeps isolation (the pre-PR-14 behavior on request).
+    s3 = SolverServer(_config(lanes=0), cache=ExecutableCache(8))
+    assert s3.cache is not s1.cache
+    # Capacity only grows.
+    cap0 = shared_cache().capacity
+    assert shared_cache(cap0 + 7).capacity == cap0 + 7
+    assert shared_cache(4).capacity == cap0 + 7
+
+
+def test_racing_warmups_compile_once():
+    """Two lanes warming the same bucket pay ONE build: concurrent get()
+    misses on one key coalesce — a single builder call, the waiter counts
+    as a hit (it never compiled)."""
+    cache = ExecutableCache(8)
+    built = []
+    gate = threading.Event()
+
+    def slow_builder(key):
+        built.append(key)
+        gate.wait(5.0)  # hold the build so the second get must coalesce
+        return object()
+
+    key = CacheKey(bucket_n=16, nrhs=1, batch=4, dtype="float32",
+                   engine="blocked", refine_steps=1)
+    views = [CacheView(cache), CacheView(cache)]
+    got = [None, None]
+
+    def warm(i):
+        got[i] = cache.get(key, builder=slow_builder)
+        views[i].warmed.add(key)
+
+    t1 = threading.Thread(target=warm, args=(0,))
+    t2 = threading.Thread(target=warm, args=(1,))
+    t1.start()
+    t2.start()
+    time.sleep(0.2)       # let both reach the build/coalesce point
+    gate.set()
+    t1.join()
+    t2.join()
+    assert len(built) == 1                  # ONE compile
+    assert got[0] is got[1]                 # both lanes share the entry
+    assert cache.misses == 1 and cache.coalesced >= 1
+    assert views[0].warmed == views[1].warmed == {key}
+
+
+def test_failed_build_releases_coalesce_slot():
+    """A failing build propagates to its caller and lets the next caller
+    retry instead of deadlocking the key."""
+    cache = ExecutableCache(8)
+    key = CacheKey(bucket_n=16, nrhs=1, batch=1, dtype="float32",
+                   engine="blocked", refine_steps=1)
+    with pytest.raises(RuntimeError):
+        cache.get(key, builder=lambda k: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+    sentinel = object()
+    assert cache.get(key, builder=lambda k: sentinel) is sentinel
+
+
+# -- continuous batching ---------------------------------------------------
+
+def test_cb_admission_joins_inflight_batch(rng):
+    """Requests arriving while a slot forms join IN-FLIGHT instead of
+    waiting out a drain cycle: sequential submits inside one generous
+    window co-batch into a single dispatch."""
+    with SolverServer(_config(lanes=1, cb_window_s=0.5)) as srv:
+        batches0 = srv.batches
+        systems = [_system(rng, 12) for _ in range(4)]
+        handles = []
+        for a, b in systems:
+            handles.append(srv.submit(a, b))
+            time.sleep(0.02)    # arrivals spread across the window
+        for h in handles:
+            assert h.result(120).ok
+        assert srv.batches - batches0 == 1          # ONE batch
+        assert srv.lane_stats()["cb_admits"] >= 3   # joined the slot
+
+
+def test_cb_formation_deadline_fires_partial(rng):
+    """An unfilled slot dispatches at its formation deadline — latency is
+    window-bounded, not company-bounded."""
+    with SolverServer(_config(lanes=1, cb_window_s=0.05,
+                              max_batch=8)) as srv:
+        a, b = _system(rng, 12)
+        assert srv.solve(a, b, timeout=300).ok  # untimed: compiles land
+        t0 = time.perf_counter()
+        res = srv.solve(a, b, timeout=120)
+        elapsed = time.perf_counter() - t0
+        assert res.ok
+        assert elapsed < 2.0    # window + dispatch, not an 8-wide wait
+
+
+def test_cb_deadline_aware_close(rng):
+    """The slot closes BEFORE a member's request deadline: with a window
+    far past the deadline, the request still serves (a blind linger
+    would expire it — the fixed-drain A/B delta meshcheck gates)."""
+    cfg = _config(lanes=1, cb_window_s=2.0, cb_deadline_margin_s=0.05,
+                  max_batch=8)
+    with SolverServer(cfg) as srv:
+        a, b = _system(rng, 12)
+        res = srv.submit(a, b, deadline_s=0.4).result(120)
+        assert res.ok, (res.status, res.error)
+        # And the blind discipline really does expire it:
+    fixed = _config(lanes=1, continuous_batching=False,
+                    batch_linger_s=2.0, max_batch=8)
+    with SolverServer(fixed) as srv:
+        a, b = _system(rng, 12)
+        res = srv.submit(a, b, deadline_s=0.4).result(120)
+        assert res.status == "expired"
+
+
+def test_heterogeneous_arrivals_never_cobatch(rng):
+    """dtype- and structure-heterogeneous requests never share a slot or
+    an executable: same bucket, different sigs, separate batches."""
+    cache = ExecutableCache(8)
+    with SolverServer(_config(lanes=1, cb_window_s=0.3, refine_steps=2),
+                      cache=cache) as srv:
+        batches0 = srv.batches
+        systems = [_system(rng, 12) for _ in range(4)]
+        handles = []
+        for i, (a, b) in enumerate(systems):
+            handles.append(
+                srv.submit(a, b, dtype="bfloat16" if i % 2 else None))
+            time.sleep(0.02)
+        for (a, b), h in zip(systems, handles):
+            res = h.result(120)
+            assert res.ok
+            assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+        assert srv.batches - batches0 == 2   # one per dtype, never mixed
+        dtypes = {k.dtype for k in cache.keys()}
+        assert dtypes == {"float32", "bfloat16"}
+
+
+def test_journal_exactly_once_across_steal(rng, tmp_path):
+    """Stealing a journaled request across lanes moves WHERE it computes,
+    never how many terminals it gets: every admit holds exactly one
+    journaled terminal, and the steal path demonstrably engaged."""
+    from gauss_tpu.serve import durable
+
+    jd = str(tmp_path / "journal")
+    cfg = _config(lanes=2, max_batch=2, continuous_batching=False,
+                  journal_dir=jd, journal_fsync_batch=1)
+    with SolverServer(cfg) as srv:
+        systems = [_system(rng, 12) for _ in range(16)]
+        handles = [srv.submit(a, b) for a, b in systems]
+        for h in handles:
+            assert h.result(120).ok
+        steals = srv.lane_stats()["steals"]
+    assert steals >= 1
+    state = durable.scan(jd)
+    assert len(state.admits) == 16
+    assert set(state.terminals) == set(state.admits)    # exactly once
+    assert all(doc.get("status") == "ok"
+               for doc in state.terminals.values())
+    assert state.clean_shutdown
+
+
+# -- retry-after (satellite) -----------------------------------------------
+
+def test_retry_after_uses_lane_set_rate(rng):
+    """The hint divides by the ACTIVE lanes' aggregate drain rate — the
+    single-lane formula over-estimates the wait N-fold under multi-lane
+    drain."""
+    with SolverServer(_config(lanes=2)) as srv:
+        ls = srv._lanes
+        for lane in ls.lanes:
+            lane.drain_rate = 50.0
+        assert ls.drain_rate() == pytest.approx(100.0)
+        # max_batch=4 over 100 req/s aggregate:
+        assert srv.retry_after_hint() == pytest.approx(0.04)
+        # the single-lane formula with one lane's rate would say 0.08
+        ls.lanes[1].drain_rate = 0.0
+        assert srv.retry_after_hint() == pytest.approx(0.08)
+
+
+# -- width > 1: mesh slices -------------------------------------------------
+
+def test_lane_width_shards_batch_axis(rng):
+    """lane_width=2 lanes own a 2-device slice: a slot divisible by the
+    width dispatches with a batch-axis NamedSharding, a non-divisible one
+    falls back to the slice's first device — and solves verify either
+    way."""
+    with SolverServer(_config(lanes=2, lane_width=2)) as srv:
+        lane = srv._lanes.lanes[0]
+        assert len(lane.devices) == 2 and lane.mesh is not None
+        sharded = lane.placement_for(4)
+        assert isinstance(sharded, jax.sharding.NamedSharding)
+        assert lane.placement_for(3) == lane.devices[0]
+        a, b = _system(rng, 12)
+        res = srv.solve(a, b, timeout=300)
+        assert res.ok
+        assert checks.residual_norm(a, res.x, b, relative=True) <= 1e-4
+
+
+def test_lane_slices_partition():
+    from gauss_tpu.dist import mesh as _mesh
+
+    devs = jax.devices()
+    assert len(_mesh.lane_slices(devs, 1)) == len(devs)
+    pairs = _mesh.lane_slices(devs, 2)
+    assert len(pairs) == len(devs) // 2
+    assert all(len(p) == 2 for p in pairs)
+    with pytest.raises(ValueError):
+        _mesh.lane_slices(devs, len(devs) + 1)
+    m = _mesh.lane_mesh(pairs[0])
+    assert m.axis_names == ("batch",) and m.devices.size == 2
+
+
+# -- autoscaling -----------------------------------------------------------
+
+def test_autoscale_grows_on_burn_and_shrinks_quiet(rng):
+    """A firing SLO alert grows the active lane count; a quiet period
+    shrinks it back to min_lanes. Placement targets active lanes only."""
+    firing = {"on": False}
+    cfg = _config(lanes=3, autoscale=True, min_lanes=1,
+                  autoscale_interval_s=0.0, autoscale_quiet_s=0.05)
+    with obs.run() as rec:
+        with SolverServer(cfg) as srv:
+            ls = srv._lanes
+            ls._slo_firing = lambda: firing["on"]
+            assert ls.active_count() == 1
+            firing["on"] = True
+            for _ in range(100):
+                if ls.active_count() == 3:
+                    break
+                time.sleep(0.02)
+            assert ls.active_count() == 3
+            firing["on"] = False
+            for _ in range(100):
+                if ls.active_count() == 1:
+                    break
+                time.sleep(0.02)
+            assert ls.active_count() == 1
+            # still serves while scaled down
+            a, b = _system(rng, 12)
+            assert srv.solve(a, b, timeout=120).ok
+    scale = [e for e in rec.events if e["type"] == "lane_scale"]
+    assert any(e["event"] == "grow" and e["reason"] == "slo_burn"
+               for e in scale)
+    assert any(e["event"] == "shrink" for e in scale)
+
+
+# -- loadgen report + history tag (satellite) -------------------------------
+
+def test_loadgen_mesh_block_and_lane_tag(rng, tmp_path):
+    cfg = _config(lanes=2)
+    lg = loadgen.LoadgenConfig(mix="random:10*2,random:20", requests=8,
+                               warmup=2, concurrency=2, seed=7, serve=cfg)
+    with SolverServer(cfg) as srv:
+        with obs.run():
+            summary = loadgen.run_load(srv, lg)
+    assert summary["counts"]["ok"] == 8 and summary["incorrect"] == 0
+    mesh = summary["mesh"]
+    assert mesh["lanes"] == 2 and len(mesh["per_lane"]) == 2
+    assert sum(p["served"] for p in mesh["per_lane"]) >= 8
+    assert "mesh: 2 lane(s)" in loadgen.format_summary(summary)
+    # Lane-qualified history tag: mesh epochs never pollute the
+    # single-lane serve-check band.
+    recs = loadgen.history_records(summary)
+    assert recs and all(m.startswith("serve:closed:l2/") for m, _ in recs)
+    out = tmp_path / "mesh_loadgen.json"
+    loadgen.write_summary(summary, out)
+    ingested = regress.ingest_file(out)
+    assert any(r["metric"] == "serve:closed:l2/s_per_request"
+               for r in ingested)
+
+
+# -- obs: summarize + top ---------------------------------------------------
+
+def test_summarize_serving_mesh_section(rng):
+    with obs.run() as rec:
+        with SolverServer(_config(lanes=2, max_batch=2,
+                                  continuous_batching=False)) as srv:
+            handles = [srv.submit(*_system(rng, 12)) for _ in range(12)]
+            for h in handles:
+                assert h.result(120).ok
+    sv = summarize.serving_summary(rec.events)
+    assert sv["mesh"]["lane_batches"]
+    assert sum(sv["mesh"]["lane_batches"].values()) >= 1
+    text = summarize.summarize_events(rec.events)
+    assert "mesh: batches by lane" in text
+
+
+def test_top_renders_lane_panel():
+    from gauss_tpu.obs import top as _top
+
+    text = "\n".join([
+        "gauss_serve_served_total 12",
+        "gauss_serve_lanes_active 2",
+        "gauss_serve_steals_total 3",
+        "gauss_serve_cb_admits_total 7",
+        "gauss_serve_lane0_queue_depth 1",
+        "gauss_serve_lane0_served 8",
+        "gauss_serve_lane0_occupancy 0.75",
+        "gauss_serve_lane1_served 4",
+        "gauss_serve_lane1_stolen 4",
+    ])
+    frame = _top.render(_top._View(_top.parse_metrics(text)), "http://x")
+    assert "mesh: 2 active lane(s), steals 3" in frame
+    assert "lane 0: depth 1, served 8" in frame
+    assert "lane 1:" in frame and "stolen 4" in frame
+
+
+# -- throughput multi-lane leg + mesh_serve ingest --------------------------
+
+def test_throughput_multilane_leg(tmp_path):
+    from gauss_tpu.bench import throughput
+
+    with obs.run():
+        summary = throughput.measure_throughput(
+            ns=[16], batch=2, reps=1, seed=3, lanes=2)
+    leg = summary["legs"][0]
+    assert leg["lanes"] == 2 and leg["verified"]
+    recs = throughput.history_records(summary)
+    assert recs and recs[0][0] == "tput:float32/n16/b2/l2/s_per_solve"
+    assert "lanes=2" in throughput.format_summary(summary)
+    # single-lane metric names are untouched
+    with obs.run():
+        single = throughput.measure_throughput(ns=[16], batch=2, reps=1,
+                                               seed=3)
+    assert throughput.history_records(single)[0][0] == \
+        "tput:float32/n16/b2/s_per_solve"
+
+
+def test_meshcheck_history_and_ingest(tmp_path):
+    from gauss_tpu.serve import meshcheck
+
+    summary = {
+        "kind": "mesh_serve",
+        "smoke": {"throughput_rps": 100.0,
+                  "latency_s": {"p95": 0.02}},
+        "ab": {"cb_throughput_rps": 40.0, "fixed_over_cb": 0.5},
+    }
+    recs = dict((m, v) for m, v, _ in meshcheck.history_records(summary))
+    assert recs["mesh:smoke/s_per_request"] == pytest.approx(0.01)
+    assert recs["mesh:smoke/p95_s"] == pytest.approx(0.02)
+    assert recs["mesh:ab/cb_s_per_request"] == pytest.approx(0.025)
+    assert recs["mesh:ab/fixed_over_cb"] == pytest.approx(0.5)
+    out = tmp_path / "mesh.json"
+    import json
+
+    out.write_text(json.dumps(summary))
+    ingested = regress.ingest_file(out)
+    assert {r["metric"] for r in ingested} == set(recs)
+    assert all(r["kind"] == "mesh_serve" for r in ingested)
+
+
+def test_committed_mesh_epochs_present():
+    """The 3 seeded epochs the gate baselines against are committed."""
+    hist = regress.load_history(regress.default_history_path())
+    for metric in ("mesh:smoke/s_per_request", "mesh:ab/fixed_over_cb",
+                   "tput:float32/n256/b8/l4/s_per_solve"):
+        assert len([r for r in hist
+                    if r.get("metric") == metric]) >= 3, metric
+    assert "tput:float32/n256/b8/l4/s_per_solve" in regress.RATCHET_BASELINES

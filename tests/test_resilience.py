@@ -415,11 +415,16 @@ def test_stall_kind_sleeps_until_killed(tmp_path):
 def test_serve_numpy_lane_is_ladder_backed(rng):
     from gauss_tpu.serve import ServeConfig, SolverServer
 
+    from gauss_tpu.serve.cache import ExecutableCache
+
+    # cache=: this test patches cache.get; the default cache is process-
+    # shared now, so the patch must stay private to this server.
     srv = SolverServer(ServeConfig(ladder=(16, 32), panel=16,
                                    unhealthy_after=1, max_retries=0,
                                    retry_backoff_s=0.0,
                                    device_probe_cooldown_s=60.0,
-                                   verify_gate=1e-4))
+                                   verify_gate=1e-4),
+                       cache=ExecutableCache(8))
 
     def broken_get(key, builder=None, panel=None):
         raise RuntimeError("injected device failure")

@@ -263,9 +263,12 @@ def test_bad_request_shapes_raise(rng):
 # -- degradation -----------------------------------------------------------
 
 def test_numpy_fallback_lane_on_persistent_device_failure(rng):
+    # cache=: these degradation tests patch cache.get; the default cache
+    # is process-shared now, so the patch must stay private to this server.
     srv = SolverServer(_config(unhealthy_after=1, max_retries=1,
                                retry_backoff_s=0.0,
-                               device_probe_cooldown_s=60.0))
+                               device_probe_cooldown_s=60.0),
+                       cache=ExecutableCache(8))
 
     def broken_get(key, builder=None, panel=None):
         raise RuntimeError("injected transient device failure")
@@ -289,7 +292,7 @@ def test_numpy_fallback_lane_on_persistent_device_failure(rng):
 
 
 def test_nontransient_error_fails_without_retry(rng):
-    srv = SolverServer(_config())
+    srv = SolverServer(_config(), cache=ExecutableCache(8))  # patched below
 
     def broken_get(key, builder=None, panel=None):
         raise ValueError("deterministic bug — retrying replays it")
@@ -309,9 +312,13 @@ def test_breaker_cooldown_probe_success_restores_device_lane(rng):
     the device lane trips into numpy, the cooldown elapses, the probe batch
     goes back through the device lane, succeeds, and the lane is restored —
     the path test_serve.py never exercised before this PR."""
+    # Private cache: the default is the PROCESS-SHARED instance now, and
+    # this test monkeypatches cache.get — that must not leak into every
+    # other server in the test process.
     srv = SolverServer(_config(unhealthy_after=1, max_retries=0,
                                retry_backoff_s=0.0,
-                               device_probe_cooldown_s=0.15))
+                               device_probe_cooldown_s=0.15),
+                       cache=ExecutableCache(8))
     real_get = srv.cache.get
     broken = {"on": True}
 
@@ -339,7 +346,8 @@ def test_breaker_probe_failure_extends_cooldown(rng):
     for another full cooldown, and requests stay on the numpy lane."""
     srv = SolverServer(_config(unhealthy_after=1, max_retries=0,
                                retry_backoff_s=0.0,
-                               device_probe_cooldown_s=0.15))
+                               device_probe_cooldown_s=0.15),
+                       cache=ExecutableCache(8))  # patched below: isolate
     probes = []
 
     def broken_get(key, builder=None, panel=None):

@@ -274,10 +274,13 @@ def test_serve_structure_aware_lanes():
 
 def test_serve_structure_unaware_unchanged():
     from gauss_tpu.serve import ServeConfig, SolverServer
+    from gauss_tpu.serve.cache import ExecutableCache
 
     cfg = ServeConfig(ladder=(32,), max_batch=2, panel=16,
                       verify_gate=GATE)
-    with SolverServer(cfg) as srv:
+    # cache=: the all-keys-structure-None assertion below needs isolation
+    # from the process-shared default cache other tests tag keys into.
+    with SolverServer(cfg, cache=ExecutableCache(8)) as srv:
         res = srv.solve(synthetic.spd_matrix(16),
                         _rng(10).standard_normal(16))
         assert res.ok
