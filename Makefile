@@ -13,10 +13,11 @@ ABFT_SMOKE ?= /tmp/gauss_abft_check
 DURABLE_SMOKE ?= /tmp/gauss_durable_check
 OUTOFCORE_SMOKE ?= /tmp/gauss_outofcore_check
 MESH_SMOKE ?= /tmp/gauss_mesh_serve_check
+LINT_SMOKE ?= /tmp/gauss_lint_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
 	structure-check tune-check live-check abft-check durable-check \
-	outofcore-check mesh-serve-check clean
+	outofcore-check mesh-serve-check lint-check clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -306,6 +307,25 @@ mesh-serve-check:
 	print('mesh-serve-check: serving mesh summary ok:', sv[0]['mesh'])"
 	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.bench.throughput --ns 256 \
 	  --batch 8 --reps 2 --lanes 4 --seed 258458 --regress-check
+
+# The static-analysis gate (CI-callable): gauss-lint runs the jaxpr
+# auditor (every registered fast-path entry traced — callback-free plain
+# path, bf16->f32 accumulation, f64 confinement, donation survival,
+# registry completeness), the lockset checker (guarded-by annotations +
+# the terminal-emit CAS rule over the serving core), and the drift lint
+# (single-source tunables, API/OBSERVABILITY doc coverage, ratchet-vs-
+# history existence, the x-or-Ctor() ban) against the COMMITTED EMPTY
+# baseline — exit 1 on any new finding, with its file:line. The second
+# leg regress-checks the per-pass finding counts against the committed
+# 0-finding epochs in reports/history.jsonl, so the lint gate ratchets
+# exactly like the perf gates. Not timing-gated (pure tracing/AST), but
+# .NOTPARALLEL keeps it serial with the timing-gated targets anyway.
+lint-check:
+	rm -rf $(LINT_SMOKE) && mkdir -p $(LINT_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.analysis.cli \
+	  --json $(LINT_SMOKE)/lint.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.regress check $(LINT_SMOKE)/lint.json \
+	  --history reports/history.jsonl
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

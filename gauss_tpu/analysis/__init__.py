@@ -1,0 +1,166 @@
+"""gauss-lint: static verification of the contracts tests only sample.
+
+Three passes (see docs/ANALYSIS.md for the catalog and annotation
+grammar):
+
+- :mod:`gauss_tpu.analysis.jaxpr_audit` — trace the declared registry of
+  fast-path entry points (``gauss_tpu.core.entrypoints``) and statically
+  assert the callback-free plain path, the bf16->f32 accumulation
+  contract, the f64 confinement to declared refinement sites, and that
+  declared donations survive to the lowering's input/output aliasing.
+- :mod:`gauss_tpu.analysis.lockset` — AST guarded-by analysis over the
+  concurrent serving core (``serve/`` + ``resilience/``): shared mutable
+  attributes annotated ``# guarded by: self._lock`` must be accessed
+  under that lock (or an annotated owning thread), and terminal-status
+  events may only be emitted on the winning ``resolve()`` CAS path.
+- :mod:`gauss_tpu.analysis.driftlint` — single-source/doc drift: tunable
+  constants import from ``tune/space.py``, every ``ServeConfig`` field
+  and audited CLI flag has a ``docs/API.md`` row, every emitted obs
+  event name appears in ``docs/OBSERVABILITY.md``, every
+  ``RATCHET_BASELINES`` metric exists in ``reports/history.jsonl``, and
+  the ``x or Ctor()`` falsy-default anti-pattern (the PR-12
+  ``cache or ExecutableCache(...)`` bug) never recurs.
+
+Findings are typed (:class:`Finding`), carry ``file:line``, and are
+gated against a committed baseline (:func:`load_baseline` /
+:func:`check_against_baseline`) that may only ever SHRINK — grandfathered
+findings are a ratchet, not a suppression list. The repo ships with the
+baseline EMPTY. ``gauss-lint`` (``python -m gauss_tpu.analysis.cli``) is
+the CLI; ``make lint-check`` wires it into CI.
+
+This module is import-light (stdlib only) so the regress sentinel can
+derive history records from a lint report without loading jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Iterable, List, Tuple
+
+#: the three passes, in report order.
+PASSES = ("jaxpr", "lockset", "drift")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One typed lint finding.
+
+    ``key`` is the BASELINE identity: rule + path + symbol, deliberately
+    excluding the line number so grandfathered findings survive unrelated
+    edits shifting lines; the report still prints exact ``file:line``.
+    """
+
+    rule: str          # e.g. "jaxpr.callback", "lockset.unguarded"
+    path: str          # repo-relative file
+    line: int
+    message: str
+    symbol: str = ""   # entry/class.attr/event the finding is about
+
+    @property
+    def passname(self) -> str:
+        return self.rule.split(".", 1)[0]
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def rel(path: str, root: str) -> str:
+    try:
+        return os.path.relpath(os.path.abspath(path), root)
+    except ValueError:  # pragma: no cover — cross-drive (windows)
+        return path
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """key -> grandfathered count. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    counts = doc.get("findings", {}) if isinstance(doc, dict) else {}
+    return {str(k): int(v) for k, v in counts.items() if int(v) > 0}
+
+
+def save_baseline(findings: Iterable[Finding], path: str) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    doc = {"comment": "gauss-lint grandfathered findings — a RATCHET: "
+                      "counts may only shrink (docs/ANALYSIS.md); keep "
+                      "this empty unless a finding is consciously "
+                      "deferred",
+           "findings": dict(sorted(counts.items()))}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return counts
+
+
+def check_against_baseline(findings: List[Finding],
+                           baseline: Dict[str, int],
+                           ) -> Tuple[List[Finding], List[str]]:
+    """Split findings into (new — fail the gate) and ratchet notes.
+
+    A finding whose key holds baseline budget consumes one unit of it;
+    anything past the budget is NEW. Baseline keys whose current count
+    shrank (or vanished) produce ratchet notes: the committed baseline
+    should be tightened to match (the count may only move down)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    seen: Dict[str, int] = {}
+    for f in findings:
+        seen[f.key] = seen.get(f.key, 0) + 1
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            new.append(f)
+    notes = [f"ratchet: '{k}' fixed {v - seen.get(k, 0)} of {v} "
+             f"grandfathered finding(s) — shrink the baseline"
+             for k, v in sorted(baseline.items())
+             if seen.get(k, 0) < v]
+    return new, notes
+
+
+def history_records(summary: Dict[str, Any],
+                    source: str = "") -> List[Dict[str, Any]]:
+    """History records a ``kind: lint_report`` summary contributes: the
+    per-pass finding counts (0 is a real — and the desired — value, so
+    these records are built here rather than through regress._record,
+    which drops non-positive values)."""
+    out: List[Dict[str, Any]] = []
+    passes = summary.get("passes") or {}
+    src = source or f"lint:{summary.get('run_id', 'unknown')}"
+    for name in PASSES:
+        info = passes.get(name)
+        if not isinstance(info, dict):
+            continue
+        count = info.get("findings")
+        if isinstance(count, (int, float)) and count >= 0:
+            out.append({"metric": f"lint:{name}/findings",
+                        "value": float(count), "unit": "count",
+                        "source": src, "kind": "lint"})
+    total = summary.get("findings_total")
+    if isinstance(total, (int, float)) and total >= 0:
+        out.append({"metric": "lint:findings_total", "value": float(total),
+                    "unit": "count", "source": src, "kind": "lint"})
+    return out

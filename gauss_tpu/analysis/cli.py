@@ -1,0 +1,203 @@
+"""gauss-lint: run the static-analysis passes as one gate.
+
+``python -m gauss_tpu.analysis.cli`` (installed as ``gauss-lint``) runs
+the jaxpr auditor, the lockset checker, and the drift lint, prints every
+finding as ``file:line: [rule] message``, and exits nonzero when any
+finding is not covered by the committed baseline
+(``gauss_tpu/analysis/baseline.json`` — EMPTY in this tree, and ratcheted:
+a grandfathered count may only shrink; new findings always fail).
+
+``--json`` writes a ``kind: lint_report`` summary (finding counts per
+pass) that ``obs.regress`` ingests; ``--regress-check`` gates the counts
+against the committed epochs in ``reports/history.jsonl`` exactly like
+the perf gates (0 findings is the committed baseline value, so ANY
+finding is out-of-band there too). ``make lint-check`` runs both.
+
+``--check-file`` / ``--check-entry`` extend the audited surface with
+extra sources / registry entries — the seeded-violation path the tests
+and the acceptance criteria drive (a violation injected through them
+must exit nonzero with the correct file:line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import uuid
+from typing import List
+
+from gauss_tpu.analysis import (
+    PASSES,
+    Finding,
+    check_against_baseline,
+    default_baseline_path,
+    history_records,
+    load_baseline,
+    repo_root,
+    save_baseline,
+)
+
+
+def _load_extra_entries(specs: List[str]):
+    out = []
+    for spec in specs:
+        modname, _, attr = spec.partition(":")
+        obj = getattr(importlib.import_module(modname), attr)
+        out.extend(obj if isinstance(obj, (list, tuple)) else [obj])
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gauss-lint",
+        description="Static verification of the jaxpr, concurrency, and "
+                    "drift contracts (docs/ANALYSIS.md).")
+    p.add_argument("--passes", default=",".join(PASSES),
+                   help=f"comma-separated subset of {'/'.join(PASSES)} "
+                        f"(default: all)")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: this checkout)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="grandfathered-findings baseline (default: "
+                        "gauss_tpu/analysis/baseline.json; committed "
+                        "EMPTY — keep it that way)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the CURRENT findings "
+                        "(ratchet: only sensible when the count shrank; "
+                        "adding findings to the baseline is a review "
+                        "decision, not a default)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write the kind: lint_report summary JSON here")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate the per-pass finding counts against "
+                        "reports/history.jsonl (exit 1 out-of-band)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append this run's records to the history "
+                        "(default path when no value given); only on a "
+                        "green gate")
+    p.add_argument("--check-file", action="append", default=[],
+                   metavar="PATH",
+                   help="extra source file for the lockset pass and the "
+                        "drift falsy-default scan (seeded-violation "
+                        "surface)")
+    p.add_argument("--check-entry", action="append", default=[],
+                   metavar="MOD:ATTR",
+                   help="extra jaxpr-audit EntryPoint (or list of them) "
+                        "imported from MOD")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="findings and verdicts only, no per-pass stats")
+    args = p.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    wanted = [s.strip() for s in args.passes.split(",") if s.strip()]
+    unknown = [w for w in wanted if w not in PASSES]
+    if unknown:
+        p.error(f"unknown pass(es) {unknown}; options: {list(PASSES)}")
+
+    findings: List[Finding] = []
+    passes = {}
+    rc = 0
+    if "jaxpr" in wanted:
+        from gauss_tpu.analysis import jaxpr_audit
+
+        try:
+            extra = _load_extra_entries(args.check_entry)
+        except Exception as e:  # noqa: BLE001 — operator input
+            print(f"gauss-lint: cannot load --check-entry: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        got, stats = jaxpr_audit.run(extra_entries=extra)
+        findings += got
+        passes["jaxpr"] = {**stats, "findings": len(got)}
+    if "lockset" in wanted:
+        from gauss_tpu.analysis import lockset
+
+        files = list(lockset.DEFAULT_FILES) + list(args.check_file)
+        got, stats = lockset.run(files=files, root=root)
+        findings += got
+        passes["lockset"] = {**stats, "findings": len(got)}
+    if "drift" in wanted:
+        from gauss_tpu.analysis import driftlint
+
+        got, stats = driftlint.run(root=root,
+                                   extra_files=tuple(args.check_file))
+        findings += got
+        passes["drift"] = {**stats, "findings": len(got)}
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = load_baseline(baseline_path)
+    new, ratchet_notes = check_against_baseline(findings, baseline)
+
+    for f in findings:
+        marker = "" if f in new else "  (grandfathered)"
+        print(f.format() + marker)
+    for note in ratchet_notes:
+        print(note)
+    if not args.quiet:
+        for name in PASSES:
+            if name in passes:
+                print(f"pass {name}: {passes[name]}")
+
+    if args.update_baseline:
+        counts = save_baseline(findings, baseline_path)
+        print(f"baseline: {baseline_path} rewritten "
+              f"({sum(counts.values())} finding(s))")
+        new = []
+
+    summary = {
+        "kind": "lint_report",
+        "run_id": uuid.uuid4().hex[:12],
+        "clean": not findings,
+        "passes": passes,
+        "findings_total": len(findings),
+        "new_findings": len(new),
+        "baseline_findings": sum(baseline.values()),
+        "findings": [f.to_doc() for f in findings],
+    }
+    if args.json:
+        parent = os.path.dirname(args.json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        if not args.quiet:
+            print(f"summary: {args.json}")
+
+    if new:
+        print(f"gauss-lint: {len(new)} new finding(s) "
+              f"({len(findings)} total, "
+              f"{sum(baseline.values())} grandfathered)")
+        rc = 1
+    else:
+        print(f"gauss-lint: clean ({len(findings)} grandfathered, "
+              f"{sum(p.get('findings', 0) for p in passes.values())} "
+              f"finding(s) across {len(passes)} pass(es))")
+
+    if args.regress_check or args.history is not None:
+        from gauss_tpu.obs import regress
+
+        records = history_records(summary)
+        if args.regress_check and records:
+            history_path = os.path.join(root, "reports", "history.jsonl")
+            verdicts = regress.check_records(
+                records, regress.load_history(history_path))
+            print(regress.format_verdicts(verdicts))
+            if any(v["status"] == "out-of-band" for v in verdicts):
+                rc = rc or 1
+        if args.history is not None and rc == 0:
+            history_path = (args.history
+                            or os.path.join(root, "reports",
+                                            "history.jsonl"))
+            added = regress.append_history(records, history_path)
+            print(f"history: {added} record(s) appended to "
+                  f"{history_path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

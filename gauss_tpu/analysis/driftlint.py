@@ -1,0 +1,407 @@
+"""Drift lint: single-source and documentation invariants, as a gate.
+
+The repo carries several "X must stay in sync with Y" rules that have
+historically drifted silently until a reviewer noticed (the stale
+``matmul_pallas`` API row of ADVICE r5 #3, undocumented obs events, the
+PR-12 ``cache or ExecutableCache(...)`` falsy-default bug). This pass
+turns each into a checked invariant:
+
+- ``drift.tune_source`` — the PR-7 single-source rule: every declared
+  tunable constant's original home must derive it from ``tune/space.py``
+  (assignment or import-as), never a literal; ``matmul_pallas`` must
+  keep consuming ``MM_TILE_SEED``.
+- ``drift.config_doc`` — every ``ServeConfig`` dataclass field appears
+  in ``docs/API.md``.
+- ``drift.cli_doc`` — every long flag of the audited CLIs (``gauss-serve``,
+  ``gauss-lint``) appears in ``docs/API.md``.
+- ``drift.event_doc`` — every obs event name emitted anywhere in
+  ``gauss_tpu/`` (``obs.emit("<name>", ...)``) appears as a backticked
+  name in ``docs/OBSERVABILITY.md``.
+- ``drift.ratchet_history`` — every ``RATCHET_BASELINES`` metric has at
+  least one committed epoch in ``reports/history.jsonl`` (a ratchet
+  with no history cannot be re-derived or appealed).
+- ``drift.falsy_default`` — the ``x or Ctor()`` anti-pattern: a falsy-
+  but-valid operand (empty cache, zero-length container) is silently
+  discarded by ``or``; write ``x if x is not None else Ctor()``. A
+  deliberate use takes a ``# driftlint: ok — reason`` waiver.
+- ``drift.api_signature`` — the ``matmul_pallas`` API row's documented
+  ``bm/bn/bk`` defaults match the live signature (the ADVICE r5 #3
+  regression, pinned).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from gauss_tpu.analysis import Finding, rel, repo_root
+
+#: (file, constant, tune.space attribute) — the single-source table.
+TUNE_SOURCED = (
+    ("gauss_tpu/core/blocked.py", "CHUNK_DEFAULT", "CHUNK_SEED"),
+    ("gauss_tpu/core/blocked.py", "PANEL_VMEM_BUDGET",
+     "PANEL_VMEM_BUDGET_SEED"),
+    ("gauss_tpu/kernels/panel_pallas.py", "DEFAULT_SEG", "PANEL_SEG_SEED"),
+    ("gauss_tpu/kernels/rowelim_pallas.py", "DEFAULT_BM",
+     "ROWELIM_TILE_SEED"),
+    ("gauss_tpu/kernels/rowelim_pallas.py", "DEFAULT_BN",
+     "ROWELIM_TILE_SEED"),
+    ("gauss_tpu/outofcore/stream.py", "OUTOFCORE_DEVICE_FRAC",
+     "OUTOFCORE_DEVICE_FRAC_SEED"),
+)
+
+#: files that must REFERENCE a tune.space seed (no module-level constant
+#: of their own — the seed is consumed inline).
+TUNE_REFERENCED = (
+    ("gauss_tpu/kernels/matmul_pallas.py", "MM_TILE_SEED"),
+)
+
+#: CLIs whose long flags must have docs/API.md coverage.
+AUDITED_CLIS = (
+    ("gauss_tpu/serve/cli.py", "gauss-serve"),
+    ("gauss_tpu/analysis/cli.py", "gauss-lint"),
+)
+
+SERVE_CONFIG_FILE = "gauss_tpu/serve/admission.py"
+API_DOC = "docs/API.md"
+OBS_DOC = "docs/OBSERVABILITY.md"
+HISTORY = "reports/history.jsonl"
+MATMUL_KERNEL = "gauss_tpu/kernels/matmul_pallas.py"
+
+
+def _read(root: str, relpath: str) -> Optional[str]:
+    path = os.path.join(root, relpath)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return f.read()
+
+
+def _parse(root: str, relpath: str) -> Optional[ast.Module]:
+    text = _read(root, relpath)
+    return None if text is None else ast.parse(text, filename=relpath)
+
+
+#: excluded from the default scans: the seeded-violation fixture module
+#: exists to FAIL every pass and is only audited when fed back explicitly
+#: via ``--check-file`` / ``--check-entry`` (tests + the red-path
+#: acceptance check drive it).
+SELFTEST_FILE = os.path.join("gauss_tpu", "analysis", "selftest.py")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    base = os.path.join(root, "gauss_tpu")
+    skip = os.path.join(root, SELFTEST_FILE)
+    for dirpath, dirs, files in os.walk(base):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                if path != skip:
+                    out.append(path)
+    return out
+
+
+# -- drift.tune_source -------------------------------------------------------
+
+def _derives_from(tree: ast.Module, const: str, attr: str) -> Tuple[bool,
+                                                                    int]:
+    """Does the module bind ``const`` from tune.space's ``attr``
+    (assignment referencing it, or ``import ... as const``)? Returns
+    (ok, best line for the finding)."""
+    line = 1
+    space_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                "tune.space" in node.module:
+            for alias in node.names:
+                space_names.add(alias.asname or alias.name)
+                if alias.name == attr and (alias.asname or alias.name) \
+                        == const:
+                    return True, node.lineno
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, ast.Tuple):
+                names.extend(e.id for e in t.elts
+                             if isinstance(e, ast.Name))
+        if const not in names:
+            continue
+        line = node.lineno
+        for ref in ast.walk(node.value):
+            if isinstance(ref, ast.Attribute) and ref.attr == attr:
+                return True, line
+            if isinstance(ref, ast.Name) and ref.id == attr and \
+                    attr in space_names:
+                return True, line
+    return False, line
+
+
+def check_tune_source(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath, const, attr in TUNE_SOURCED:
+        tree = _parse(root, relpath)
+        if tree is None:
+            findings.append(Finding(
+                rule="drift.tune_source", path=relpath, line=1,
+                symbol=const,
+                message=f"declared single-source file missing (table in "
+                        f"analysis/driftlint.py names {const})"))
+            continue
+        ok, line = _derives_from(tree, const, attr)
+        if not ok:
+            findings.append(Finding(
+                rule="drift.tune_source", path=relpath, line=line,
+                symbol=const,
+                message=f"'{const}' must derive from tune.space.{attr} "
+                        f"(the PR-7 single-source rule) — a literal here "
+                        f"lets the code default and the tuner's seed "
+                        f"drift apart"))
+    for relpath, attr in TUNE_REFERENCED:
+        text = _read(root, relpath)
+        if text is None or attr not in text:
+            findings.append(Finding(
+                rule="drift.tune_source", path=relpath, line=1,
+                symbol=attr,
+                message=f"file no longer references tune.space.{attr} — "
+                        f"its tile defaults must stay tuner-sourced"))
+    return findings
+
+
+# -- drift.config_doc / drift.cli_doc ---------------------------------------
+
+def serve_config_fields(root: str) -> List[Tuple[str, int]]:
+    tree = _parse(root, SERVE_CONFIG_FILE)
+    if tree is None:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ServeConfig":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    out.append((stmt.target.id, stmt.lineno))
+    return out
+
+
+def check_config_doc(root: str) -> List[Finding]:
+    api = _read(root, API_DOC) or ""
+    findings = []
+    for field, line in serve_config_fields(root):
+        if not re.search(rf"\b{re.escape(field)}\b", api):
+            findings.append(Finding(
+                rule="drift.config_doc", path=SERVE_CONFIG_FILE,
+                line=line, symbol=f"ServeConfig.{field}",
+                message=f"ServeConfig field '{field}' has no docs/API.md "
+                        f"row — every serving knob must be documented"))
+    return findings
+
+
+def cli_flags(root: str, relpath: str) -> List[Tuple[str, int]]:
+    tree = _parse(root, relpath)
+    if tree is None:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and getattr(node.func, "attr", "") == "add_argument"):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value.startswith("--"):
+                    out.append((arg.value, node.lineno))
+    return out
+
+
+def check_cli_doc(root: str) -> List[Finding]:
+    api = _read(root, API_DOC) or ""
+    findings = []
+    for relpath, prog in AUDITED_CLIS:
+        for flag, line in cli_flags(root, relpath):
+            if flag not in api:
+                findings.append(Finding(
+                    rule="drift.cli_doc", path=relpath, line=line,
+                    symbol=f"{prog} {flag}",
+                    message=f"{prog} flag '{flag}' has no docs/API.md "
+                            f"coverage"))
+    return findings
+
+
+# -- drift.event_doc ---------------------------------------------------------
+
+def emitted_events(root: str, extra_files: Tuple[str, ...] = (),
+                   ) -> Dict[str, Tuple[str, int]]:
+    """event name -> (file, first line) for every obs.emit("name", ...)."""
+    out: Dict[str, Tuple[str, int]] = {}
+    files = _py_files(root) + [os.path.join(root, f) for f in extra_files
+                               if os.path.exists(os.path.join(root, f))]
+    for path in files:
+        try:
+            tree = ast.parse(open(path).read())
+        except SyntaxError:  # pragma: no cover
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "obs"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            out.setdefault(name, (rel(path, root), node.lineno))
+    return out
+
+
+def check_event_doc(root: str,
+                    extra_files: Tuple[str, ...] = ()) -> List[Finding]:
+    doc = _read(root, OBS_DOC) or ""
+    findings = []
+    for name, (path, line) in sorted(
+            emitted_events(root, extra_files).items()):
+        if f"`{name}`" not in doc:
+            findings.append(Finding(
+                rule="drift.event_doc", path=path, line=line, symbol=name,
+                message=f"obs event '{name}' is emitted here but has no "
+                        f"docs/OBSERVABILITY.md row — the event schema "
+                        f"table is the contract consumers read"))
+    return findings
+
+
+# -- drift.ratchet_history ---------------------------------------------------
+
+def check_ratchet_history(root: str) -> List[Finding]:
+    from gauss_tpu.obs import regress
+
+    findings = []
+    history = regress.load_history(os.path.join(root, HISTORY))
+    have = {r.get("metric") for r in history}
+    for metric in sorted(regress.RATCHET_BASELINES):
+        if metric not in have:
+            findings.append(Finding(
+                rule="drift.ratchet_history", path="gauss_tpu/obs/"
+                "regress.py", line=1, symbol=metric,
+                message=f"RATCHET_BASELINES metric '{metric}' has no "
+                        f"committed epoch in {HISTORY} — a ratchet with "
+                        f"no history cannot be re-derived or appealed"))
+    return findings
+
+
+# -- drift.falsy_default -----------------------------------------------------
+
+def check_falsy_default(root: str,
+                        extra_files: Tuple[str, ...] = ()) -> List[Finding]:
+    findings = []
+    files = _py_files(root) + [os.path.join(root, f) for f in extra_files
+                               if os.path.exists(os.path.join(root, f))]
+    for path in files:
+        try:
+            source = open(path).read()
+        except OSError:  # pragma: no cover
+            continue
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:  # pragma: no cover
+            continue
+        lines = source.splitlines()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.BoolOp)
+                    and isinstance(node.op, ast.Or)):
+                continue
+            last = node.values[-1]
+            if not isinstance(last, ast.Call):
+                continue
+            f = last.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else getattr(f, "id", ""))
+            if not name[:1].isupper():
+                continue
+            ln = node.lineno
+            if ln - 1 < len(lines) and "driftlint: ok" in lines[ln - 1]:
+                continue
+            findings.append(Finding(
+                rule="drift.falsy_default", path=rel(path, root), line=ln,
+                symbol=name,
+                message=f"'... or {name}(...)' discards a falsy-but-"
+                        f"valid left operand (the PR-12 empty-"
+                        f"ExecutableCache bug); write "
+                        f"'x if x is not None else {name}(...)' (or "
+                        f"waive with '# driftlint: ok — reason')"))
+    return findings
+
+
+# -- drift.api_signature -----------------------------------------------------
+
+def check_api_signature(root: str) -> List[Finding]:
+    """The matmul_pallas API row's bm/bn/bk defaults must match the live
+    signature — the ADVICE r5 #3 staleness, pinned as a rule."""
+    findings: List[Finding] = []
+    tree = _parse(root, MATMUL_KERNEL)
+    api = _read(root, API_DOC) or ""
+    if tree is None:
+        return findings
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "matmul_pallas"), None)
+    if fn is None:
+        findings.append(Finding(
+            rule="drift.api_signature", path=MATMUL_KERNEL, line=1,
+            symbol="matmul_pallas",
+            message="matmul_pallas not found — update the api_signature "
+                    "rule in analysis/driftlint.py"))
+        return findings
+    defaults = {}
+    kwonly = dict(zip([a.arg for a in fn.args.kwonlyargs],
+                      fn.args.kw_defaults))
+    for name in ("bm", "bn", "bk"):
+        node = kwonly.get(name)
+        if isinstance(node, ast.Constant):
+            defaults[name] = node.value
+    row = next((ln for ln in api.splitlines()
+                if ln.startswith("| `matmul_pallas`")), "")
+    if not row:
+        findings.append(Finding(
+            rule="drift.api_signature", path=API_DOC, line=1,
+            symbol="matmul_pallas",
+            message="docs/API.md has no matmul_pallas row"))
+        return findings
+    for name, default in defaults.items():
+        want = f"{name}={default}"
+        if want not in row:
+            findings.append(Finding(
+                rule="drift.api_signature", path=API_DOC, line=1,
+                symbol="matmul_pallas",
+                message=f"docs/API.md matmul_pallas row documents a "
+                        f"different default than the signature's "
+                        f"'{want}' (ADVICE r5 #3 — keep the row live)"))
+    return findings
+
+
+def run(root: Optional[str] = None,
+        extra_files: Tuple[str, ...] = ()) -> Tuple[List[Finding], dict]:
+    root = root or repo_root()
+    findings: List[Finding] = []
+    findings += check_tune_source(root)
+    findings += check_config_doc(root)
+    findings += check_cli_doc(root)
+    findings += check_event_doc(root, extra_files)
+    findings += check_ratchet_history(root)
+    findings += check_falsy_default(root, extra_files)
+    findings += check_api_signature(root)
+    stats = {
+        "tune_constants": len(TUNE_SOURCED) + len(TUNE_REFERENCED),
+        "config_fields": len(serve_config_fields(root)),
+        "cli_flags": sum(len(cli_flags(root, p)) for p, _ in AUDITED_CLIS),
+        "events": len(emitted_events(root)),
+        "findings": len(findings),
+    }
+    return findings, stats

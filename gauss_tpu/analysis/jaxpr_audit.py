@@ -1,0 +1,242 @@
+"""Jaxpr auditor: the fast-path contracts, derived from traced programs.
+
+The dynamic gates sample these contracts at a handful of sizes; this pass
+re-derives them from the CLOSED JAXPR of every entry in the declared
+registry (``gauss_tpu.core.entrypoints``), so they hold for the program
+FORM, not the sampled cell:
+
+- **callback-free plain path** (``jaxpr.callback``): no ``pure_callback``
+  / ``io_callback`` / ``debug_callback``-family primitive anywhere in a
+  registered entry's jaxpr unless the entry is registered host-stepped
+  (checkpoint / out-of-core / ABFT replay — their host step is the
+  feature). This is PR 10's fast-path contract as a static property: a
+  hook creeping back into a traced program is caught at lint time, not
+  when the forbidden-phase gate's smoke stream happens to cover it.
+- **bf16 accumulation** (``jaxpr.bf16_accum``): every ``dot_general``
+  consuming a bfloat16 operand must either declare
+  ``preferred_element_type=float32`` or produce a float32 output — the
+  PR-11 precision contract (one rounding on store) checked at every dot
+  in every registered lowered form, not just the ``_gdot`` sites tests
+  exercise.
+- **f64 confinement** (``jaxpr.f64``): no float64-producing equation
+  outside entries registered as refinement sites. TPUs are f32-native;
+  an accidental f64 op in a fast-path program silently doubles itemsize
+  (and on real TPUs decomposes into emulation).
+- **donation survival** (``jaxpr.donation``): entries that declare buffer
+  donation must carry the input/output alias in their LOWERING (and, for
+  ``compile_check`` entries, in the compiled executable) — CPU honors
+  donation in this container, but a silently-dropped alias (shape
+  mismatch, refactored staging) would only show up as a memory
+  regression nobody attributes.
+- **registry completeness** (``registry.*``): every discovered public
+  solve entry point is registered or explicitly exempted, and no
+  registered name is stale.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from gauss_tpu.analysis import Finding
+
+#: primitive-name fragments that mark a host callsite inside a jaxpr.
+CALLBACK_MARKERS = ("callback", "debug_print")
+
+#: where registry findings anchor (the registry is the fixable artifact).
+REGISTRY_PATH = "gauss_tpu/core/entrypoints.py"
+
+
+def _iter_eqns(jaxpr, seen: Optional[Set[int]] = None):
+    """Every equation of ``jaxpr`` and its sub-jaxprs (pjit/scan/cond
+    bodies ride in eqn params as Jaxpr or ClosedJaxpr values)."""
+    if seen is None:
+        seen = set()
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub, seen)
+
+
+def _sub_jaxprs(v):
+    inner = getattr(v, "jaxpr", None)
+    if inner is not None:
+        # ClosedJaxpr -> its Jaxpr; a bare Jaxpr has no .jaxpr attr
+        yield inner
+    elif hasattr(v, "eqns"):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for w in v:
+            yield from _sub_jaxprs(w)
+
+
+def _aval_dtype(var):
+    aval = getattr(var, "aval", None)
+    return getattr(aval, "dtype", None)
+
+
+def _trace_entry(entry) -> Tuple[object, Optional[str]]:
+    """(closed jaxpr, error) for one registry entry."""
+    import jax
+
+    try:
+        fn, args, kwargs = entry.trace()
+        return jax.make_jaxpr(fn)(*args, **kwargs), None
+    except Exception as e:  # noqa: BLE001 — a broken trace IS the finding
+        return None, f"{type(e).__name__}: {e}"
+
+
+def _anchor(entry) -> Tuple[str, int]:
+    return entry.where if entry.where is not None else (REGISTRY_PATH, 1)
+
+
+def audit_entry(entry) -> Tuple[List[Finding], int]:
+    """All jaxpr findings for one registry entry; returns
+    ``(findings, eqns_checked)``."""
+    import numpy as np
+
+    findings: List[Finding] = []
+    if entry.trace is None:
+        return findings, 0
+    closed, err = _trace_entry(entry)
+    apath, aline = _anchor(entry)
+    if closed is None:
+        findings.append(Finding(
+            rule="jaxpr.trace_error", path=apath, line=aline,
+            symbol=entry.name,
+            message=f"entry '{entry.name}' failed to trace: {err}"))
+        return findings, 0
+    checked = 0
+    f32 = np.dtype("float32")
+    f64 = np.dtype("float64")
+    try:
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover — jax always ships ml_dtypes
+        bf16 = None
+    for eqn in _iter_eqns(closed.jaxpr):
+        checked += 1
+        name = eqn.primitive.name
+        if not entry.host_stepped and any(m in name
+                                          for m in CALLBACK_MARKERS):
+            findings.append(Finding(
+                rule="jaxpr.callback", path=apath, line=aline,
+                symbol=entry.name,
+                message=f"entry '{entry.name}' traces a host callsite "
+                        f"(primitive '{name}') but is not registered "
+                        f"host-stepped — the fast-path contract forbids "
+                        f"callbacks in this program"))
+        if name == "dot_general" and bf16 is not None:
+            in_dtypes = [_aval_dtype(v) for v in eqn.invars]
+            if any(d == bf16 for d in in_dtypes):
+                pref = eqn.params.get("preferred_element_type")
+                outs = [_aval_dtype(v) for v in eqn.outvars]
+                ok = (pref is not None and np.dtype(pref) == f32) or \
+                    all(d == f32 for d in outs)
+                if not ok:
+                    findings.append(Finding(
+                        rule="jaxpr.bf16_accum", path=apath,
+                        line=aline, symbol=entry.name,
+                        message=f"entry '{entry.name}': dot_general on "
+                                f"bf16 operands without f32 accumulation "
+                                f"(preferred_element_type={pref!r}, "
+                                f"out={[str(d) for d in outs]}) — the "
+                                f"precision contract requires "
+                                f"accumulate-f32, one rounding on store"))
+        if not entry.refinement:
+            for v in eqn.outvars:
+                if _aval_dtype(v) == f64:
+                    findings.append(Finding(
+                        rule="jaxpr.f64", path=apath, line=aline,
+                        symbol=entry.name,
+                        message=f"entry '{entry.name}': primitive "
+                                f"'{name}' produces float64 outside a "
+                                f"declared refinement site"))
+                    break
+    return findings, checked
+
+
+def audit_donation(entry) -> List[Finding]:
+    findings: List[Finding] = []
+    if entry.lower_donating is None:
+        return findings
+    apath, aline = _anchor(entry)
+    try:
+        low = entry.lower_donating()
+        text = low.as_text()
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            rule="jaxpr.donation", path=apath, line=aline,
+            symbol=entry.name,
+            message=f"entry '{entry.name}' failed to lower for the "
+                    f"donation check: {type(e).__name__}: {e}"))
+        return findings
+    if "tf.aliasing_output" not in text:
+        findings.append(Finding(
+            rule="jaxpr.donation", path=apath, line=aline,
+            symbol=entry.name,
+            message=f"entry '{entry.name}' declares donation but its "
+                    f"lowering carries no input/output alias — the "
+                    f"donation was silently dropped (shape-mismatched "
+                    f"staging?)"))
+        return findings
+    if entry.compile_check:
+        compiled = low.compile()
+        ctext = compiled.as_text()
+        if "alias" not in ctext.lower():
+            findings.append(Finding(
+                rule="jaxpr.donation", path=apath, line=aline,
+                symbol=entry.name,
+                message=f"entry '{entry.name}': the donation alias did "
+                        f"not survive to the compiled executable"))
+    return findings
+
+
+def audit_registry() -> List[Finding]:
+    """Completeness: every public solve entry point registered or
+    exempted; no stale declarations."""
+    from gauss_tpu.core import entrypoints as ep
+
+    findings: List[Finding] = []
+    known = ep.REGISTERED_FUNCS | set(ep.EXEMPT_FUNCS)
+    for qual in ep.discover_public_solvers():
+        if qual not in known:
+            findings.append(Finding(
+                rule="registry.unregistered", path=REGISTRY_PATH, line=1,
+                symbol=qual,
+                message=f"public solve entry point '{qual}' is neither "
+                        f"registered nor exempted — add an EntryPoint "
+                        f"(or an EXEMPT_FUNCS reason)"))
+    for qual in ep.stale_declarations():
+        findings.append(Finding(
+            rule="registry.stale", path=REGISTRY_PATH, line=1,
+            symbol=qual,
+            message=f"registry declares '{qual}' but it no longer "
+                    f"resolves — update REGISTERED_FUNCS/EXEMPT_FUNCS"))
+    return findings
+
+
+def run(extra_entries=()) -> Tuple[List[Finding], dict]:
+    """The full pass. ``extra_entries``: additional EntryPoint objects
+    (the seeded-violation path tests and ``gauss-lint --check-entry``
+    use). Returns ``(findings, stats)``."""
+    from gauss_tpu.core import entrypoints as ep
+
+    findings: List[Finding] = []
+    entries = list(ep.entry_points()) + list(extra_entries)
+    eqns = 0
+    traced = 0
+    for entry in entries:
+        got, checked = audit_entry(entry)
+        findings.extend(got)
+        findings.extend(audit_donation(entry))
+        eqns += checked
+        traced += 1 if entry.trace is not None else 0
+    findings.extend(audit_registry())
+    stats = {"entries": len(entries), "traced": traced,
+             "eqns_checked": eqns, "findings": len(findings)}
+    return findings, stats

@@ -1,0 +1,454 @@
+"""Lockset checker: guarded-by discipline for the concurrent serving core.
+
+The serving plane holds ~47 lock sites across ``serve/`` and
+``resilience/`` after PR 14, and the discipline that makes them correct —
+which attribute is guarded by which lock, which field only one thread
+ever touches, which emit may only run on the winning ``resolve()`` CAS —
+lived entirely in reviewers' heads. This pass makes it DECLARED and
+machine-checked:
+
+**Annotation grammar** (full reference in docs/ANALYSIS.md):
+
+- ``self.x = ...  # guarded by: self._lock`` — on the field's declaring
+  assignment (same line or the line above): every later access to ``x``
+  (any receiver: ``self.x``, ``lane.x``) must be lexically inside
+  ``with <same-receiver>.<lock>:``.
+- ``self.x = ...  # owned by: worker`` — thread confinement: accesses
+  allowed only in methods annotated ``# lockset: thread worker`` (or in
+  the declaring method).
+- ``# lockset: holds self._lock`` — method-level: callers hold the lock,
+  the whole body counts as guarded by it.
+- ``# lockset: thread <name>`` — method-level: this method runs only on
+  thread ``<name>``.
+- ``... # lockset: ok — <reason>`` — line waiver for a deliberate racy
+  access (stats snapshots, EWMA hint reads); the reason is mandatory
+  culture, not syntax.
+
+**Rules:**
+
+- ``lockset.unguarded`` — a guarded field accessed outside its lock.
+- ``lockset.thread`` — an owned field accessed off its owning thread.
+- ``lockset.never_locked`` — a field annotated guarded-by a lock that is
+  never taken in any ``with`` across the checked files: the annotation
+  is wrong or the discipline is fictional; either way it must flag.
+- ``lockset.cas_terminal`` — CAS discipline: an
+  ``obs.emit("serve_request", ..., status=...)`` terminal emission that
+  is not guarded by a winning ``resolve()`` — the exactly-one-terminal
+  invariant requires every terminal event to sit on the CAS-won path
+  (``if req.resolve(...):`` / ``won = ...resolve(...); if won:`` /
+  ``if not ...resolve(...): return``).
+
+The checker is lexical and intra-procedural by design: it proves the
+DECLARED discipline is followed where the annotation says it applies,
+and every deliberate exception is a visible, reasoned waiver in the
+diff — not a heuristic race detector.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from typing import Dict, List, Optional, Tuple
+
+from gauss_tpu.analysis import Finding, rel, repo_root
+
+#: the concurrent core the pass checks by default (repo-relative).
+DEFAULT_FILES = (
+    "gauss_tpu/serve/server.py",
+    "gauss_tpu/serve/lanes.py",
+    "gauss_tpu/serve/cache.py",
+    "gauss_tpu/serve/admission.py",
+    "gauss_tpu/serve/durable.py",
+    "gauss_tpu/resilience/inject.py",
+)
+
+
+class GuardedField:
+    def __init__(self, cls: str, attr: str, lock_attr: Optional[str],
+                 owner: Optional[str], path: str, line: int,
+                 declaring_method: str):
+        self.cls = cls
+        self.attr = attr
+        self.lock_attr = lock_attr      # guarded-by lock attribute name
+        self.owner = owner              # owned-by thread name
+        self.path = path
+        self.line = line
+        self.declaring_method = declaring_method
+
+
+def _comments_by_line(source: str) -> Dict[int, Tuple[str, bool]]:
+    """line -> (comment text, own_line): a full-line comment may annotate
+    the statement BELOW it; a trailing comment annotates its own line
+    only."""
+    out: Dict[int, Tuple[str, bool]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = (tok.string, tok.start[1] == 0
+                                     or tok.line[:tok.start[1]].strip()
+                                     == "")
+    except tokenize.TokenizeError:  # pragma: no cover — ast parsed already
+        pass
+    return out
+
+
+def _expr_src(node) -> Optional[str]:
+    """Dotted-name source for receiver/lock matching ('self._lock',
+    'lane.cond'); None for anything fancier."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_src(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def _annotation(comments: Dict[int, Tuple[str, bool]], line: int,
+                keys: Tuple[str, ...],
+                end_line: Optional[int] = None) -> Optional[Tuple[str, str]]:
+    """(key, value) from a trailing comment on any line of the statement
+    (``line``..``end_line``), or a FULL-LINE comment on the line above.
+    The value is the first token after the key — prose may follow."""
+    lines = list(range(line, (end_line or line) + 1)) + [line - 1]
+    for ln in lines:
+        text, own_line = comments.get(ln, ("", False))
+        if ln == line - 1 and not own_line:
+            continue
+        for key in keys:
+            idx = text.find(key)
+            if idx >= 0:
+                toks = text[idx + len(key):].split()
+                if toks:
+                    return key, toks[0].rstrip(".,;")
+    return None
+
+
+def _method_annotations(comments, fn: ast.FunctionDef) -> Dict[str, str]:
+    """lockset method annotations ('thread', 'holds') from comments on
+    the def line(s), the line above, or the first body lines."""
+    out: Dict[str, str] = {}
+    first = fn.lineno
+    if fn.body:
+        head = fn.body[0]
+        # a docstring pushes the annotation window past its closing quote
+        is_doc = (isinstance(head, ast.Expr)
+                  and isinstance(head.value, ast.Constant)
+                  and isinstance(head.value.value, str))
+        first = (head.end_lineno or head.lineno) + 1 if is_doc \
+            else head.lineno
+    for ln in range(fn.lineno - 1, first + 1):
+        text = comments.get(ln, ("", False))[0]
+        idx = text.find("lockset:")
+        if idx < 0:
+            continue
+        rest = text[idx + len("lockset:"):].split()
+        if len(rest) >= 2 and rest[0] in ("thread", "holds"):
+            out[rest[0]] = rest[1].rstrip(".,;—")
+    return out
+
+
+def _waived(comments: Dict[int, Tuple[str, bool]], line: int) -> bool:
+    return "lockset: ok" in comments.get(line, ("", False))[0]
+
+
+def collect_fields(tree: ast.Module, comments: Dict[int, str],
+                   path: str) -> List[GuardedField]:
+    fields: List[GuardedField] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for fn in [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    ann = _annotation(comments, node.lineno,
+                                      ("guarded by:", "owned by:"),
+                                      end_line=node.end_lineno)
+                    if ann is None:
+                        continue
+                    key, value = ann
+                    lock_attr = owner = None
+                    if key == "guarded by:":
+                        lock_attr = value.split(".")[-1]
+                    else:
+                        owner = value
+                    fields.append(GuardedField(
+                        cls.name, t.attr, lock_attr, owner, path,
+                        node.lineno, fn.name))
+    return fields
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Check one function body: attribute accesses vs the with-lock
+    stack, the method's holds/thread annotations, and waivers."""
+
+    def __init__(self, checker: "LocksetChecker", path: str, source: str,
+                 cls: Optional[str], fn: ast.FunctionDef,
+                 comments: Dict[int, str]):
+        self.c = checker
+        self.path = path
+        self.cls = cls
+        self.fn = fn
+        self.comments = comments
+        ann = _method_annotations(comments, fn)
+        self.thread = ann.get("thread")
+        self.held: List[str] = [ann["holds"]] if "holds" in ann else []
+
+    def run(self):
+        for stmt in self.fn.body:
+            self.visit(stmt)
+
+    # Nested defs/lambdas keep the lexical lock stack (a closure called
+    # elsewhere is beyond a lexical checker; the held stack is the
+    # conservative-enough answer for the worker-loop closures here).
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self.generic_visit(node)
+
+    def visit_With(self, node):  # noqa: N802
+        entered = []
+        for item in node.items:
+            src = _expr_src(item.context_expr)
+            if src is not None:
+                entered.append(src)
+                self.c.locks_taken.add(src.split(".")[-1])
+        self.held.extend(entered)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(entered):]
+
+    def visit_Attribute(self, node):  # noqa: N802
+        self._check_access(node)
+        self.generic_visit(node)
+
+    def _check_access(self, node: ast.Attribute):
+        fields = self.c.fields_for(node.attr, self.cls,
+                                   isinstance(node.value, ast.Name)
+                                   and node.value.id == "self")
+        if not fields:
+            return
+        recv = _expr_src(node.value)
+        if recv is None:
+            recv = "<expr>"
+        for field in fields:
+            if self._satisfies(node, recv, field):
+                return
+        if _waived(self.comments, node.lineno):
+            return
+        field = fields[0]
+        if field.owner is not None:
+            self.c.findings.append(Finding(
+                rule="lockset.thread", path=self.path, line=node.lineno,
+                symbol=f"{field.cls}.{field.attr}",
+                message=f"'{recv}.{node.attr}' is owned by thread "
+                        f"'{field.owner}' but '{self._where()}' is not "
+                        f"annotated '# lockset: thread {field.owner}'"))
+            return
+        want = f"{recv}.{field.lock_attr}"
+        self.c.findings.append(Finding(
+            rule="lockset.unguarded", path=self.path, line=node.lineno,
+            symbol=f"{field.cls}.{field.attr}",
+            message=f"'{recv}.{node.attr}' accessed outside "
+                    f"'with {want}:' in {self._where()} (guarded field; "
+                    f"annotate a waiver with '# lockset: ok — reason' "
+                    f"if the race is deliberate)"))
+
+    def _satisfies(self, node, recv: str, field: GuardedField) -> bool:
+        """One candidate discipline satisfied by this access?"""
+        # the declaring method (construction precedes sharing) is exempt
+        if (self.cls == field.cls
+                and self.fn.name in (field.declaring_method, "__init__")):
+            return True
+        if field.owner is not None:
+            return self.thread == field.owner
+        if f"{recv}.{field.lock_attr}" in self.held:
+            return True
+        # a Condition built over the lock guards too (with lane.cond:)
+        alt = {h for h in self.held if h.startswith(f"{recv}.")}
+        return any(self.c.lock_aliases.get(h.split(".")[-1])
+                   == field.lock_attr for h in alt)
+
+    def _where(self) -> str:
+        return (f"{self.cls}.{self.fn.name}" if self.cls
+                else self.fn.name)
+
+
+class LocksetChecker:
+    def __init__(self):
+        self.fields: List[GuardedField] = []
+        self.findings: List[Finding] = []
+        self.locks_taken: set = set()     # lock attr names seen in withs
+        #: cond attr -> lock attr for Condition(self.lock) declarations
+        self.lock_aliases: Dict[str, str] = {}
+        self._parsed: List[Tuple[str, str, ast.Module,
+                                 Dict[int, str]]] = []
+
+    def fields_for(self, attr: str, cls: Optional[str],
+                   is_self: bool) -> List[GuardedField]:
+        """Candidate disciplines for an access to ``.attr``. Self
+        accesses bind to the enclosing class's own annotation; a non-self
+        receiver's class is unknown statically, so the access must
+        satisfy at least ONE declaring class's discipline (conservative:
+        an unguarded access fails every candidate and still flags)."""
+        hits = [f for f in self.fields if f.attr == attr]
+        if not hits:
+            return []
+        if is_self:
+            return [f for f in hits if f.cls == cls]
+        return hits
+
+    def load(self, paths: List[str], root: str):
+        for path in paths:
+            with open(path) as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+            comments = _comments_by_line(source)
+            rpath = rel(path, root)
+            self._parsed.append((rpath, source, tree, comments))
+            self.fields.extend(collect_fields(tree, comments, rpath))
+            self._collect_aliases(tree)
+
+    def _collect_aliases(self, tree: ast.Module):
+        """self.cond = threading.Condition(self.lock) — with self.cond:
+        acquires self.lock, so the cond attr aliases the lock attr."""
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)):
+                continue
+            call = node.value
+            if not (isinstance(call, ast.Call)
+                    and getattr(call.func, "attr", "") == "Condition"
+                    and call.args):
+                continue
+            lock_src = _expr_src(call.args[0])
+            if lock_src:
+                self.lock_aliases[node.targets[0].attr] = \
+                    lock_src.split(".")[-1]
+
+    def check(self):
+        for rpath, source, tree, comments in self._parsed:
+            for cls in [n for n in ast.walk(tree)
+                        if isinstance(n, ast.ClassDef)]:
+                for fn in [n for n in cls.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]:
+                    _FunctionChecker(self, rpath, source, cls.name, fn,
+                                     comments).run()
+            self._check_cas(rpath, tree)
+        for f in self.fields:
+            if f.lock_attr is not None and \
+                    f.lock_attr not in self.locks_taken:
+                self.findings.append(Finding(
+                    rule="lockset.never_locked", path=f.path, line=f.line,
+                    symbol=f"{f.cls}.{f.attr}",
+                    message=f"'{f.cls}.{f.attr}' is annotated guarded by "
+                            f"'{f.lock_attr}' but that lock is never "
+                            f"taken in any 'with' across the checked "
+                            f"files — the annotation (or the code) is "
+                            f"wrong"))
+
+    # -- CAS discipline ----------------------------------------------------
+
+    def _check_cas(self, rpath: str, tree: ast.Module):
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            resolve_names = self._resolve_assigned_names(fn)
+            for node in ast.walk(fn):
+                if self._is_terminal_emit(node) and \
+                        not self._cas_guarded(fn, node, resolve_names):
+                    self.findings.append(Finding(
+                        rule="lockset.cas_terminal", path=rpath,
+                        line=node.lineno, symbol=fn.name,
+                        message=f"terminal serve_request emission in "
+                                f"'{fn.name}' is not guarded by a "
+                                f"winning resolve() — terminal events "
+                                f"may only be emitted on the CAS-won "
+                                f"path (exactly-one-terminal "
+                                f"invariant)"))
+
+    @staticmethod
+    def _is_terminal_emit(node) -> bool:
+        return (isinstance(node, ast.Call)
+                and getattr(node.func, "attr", "") == "emit"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "serve_request"
+                and any(kw.arg == "status" for kw in node.keywords))
+
+    @staticmethod
+    def _contains_resolve(node) -> bool:
+        return any(isinstance(n, ast.Call)
+                   and getattr(n.func, "attr", "") == "resolve"
+                   for n in ast.walk(node))
+
+    @staticmethod
+    def _resolve_assigned_names(fn) -> set:
+        names = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and LocksetChecker._contains_resolve(node.value)):
+                names.add(node.targets[0].id)
+        return names
+
+    def _cas_guarded(self, fn, emit, resolve_names: set) -> bool:
+        # pattern a/b: an enclosing `if <resolve-call>` / `if <name>`
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            in_body = any(emit is d or any(emit is dd for dd in
+                                           ast.walk(d))
+                          for d in node.body)
+            if not in_body:
+                continue
+            test = node.test
+            if self._contains_resolve(test) and not \
+                    isinstance(test, ast.UnaryOp):
+                return True
+            if isinstance(test, ast.Name) and test.id in resolve_names:
+                return True
+        # pattern c: an earlier `if not ...resolve(...): return`
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.If) and node.lineno < emit.lineno
+                    and isinstance(node.test, ast.UnaryOp)
+                    and isinstance(node.test.op, ast.Not)
+                    and self._contains_resolve(node.test)
+                    and any(isinstance(s, ast.Return)
+                            for s in node.body)):
+                return True
+        return False
+
+
+def run(files=None, root: Optional[str] = None,
+        ) -> Tuple[List[Finding], dict]:
+    """The full pass over ``files`` (default: the serving core)."""
+    root = root or repo_root()
+    paths = [os.path.join(root, f) for f in (files or DEFAULT_FILES)]
+    checker = LocksetChecker()
+    checker.load([p for p in paths if os.path.exists(p)], root)
+    checker.check()
+    # dedupe repeated accesses on one line (load+store of an AugAssign,
+    # two reads in one condition) — one finding per (rule, line, field)
+    seen = set()
+    findings = []
+    for f in checker.findings:
+        ident = (f.rule, f.path, f.line, f.symbol)
+        if ident not in seen:
+            seen.add(ident)
+            findings.append(f)
+    stats = {"files": len(checker._parsed),
+             "guarded_fields": len(checker.fields),
+             "locks_taken": len(checker.locks_taken),
+             "findings": len(findings)}
+    return findings, stats

@@ -1,0 +1,190 @@
+"""Seeded-violation fixtures: one deliberate violation per lint rule.
+
+This module exists to FAIL gauss-lint. It is excluded from every default
+scan (``driftlint.SELFTEST_FILE``; not in ``lockset.DEFAULT_FILES``; its
+entries are not in ``core.entrypoints``) and is fed back explicitly:
+
+    gauss-lint --check-file gauss_tpu/analysis/selftest.py \\
+               --check-entry gauss_tpu.analysis.selftest:SELFTEST_ENTRIES
+
+must exit nonzero with one finding per rule below, each anchored at this
+file and the line the tables at the bottom record. tests/test_analysis.py
+asserts exactly that — the fixtures are the proof that every rule can
+actually fire (a lint gate that never fails is indistinguishable from a
+gate that checks nothing), and the red-path half of the acceptance
+criteria.
+
+Nothing here runs on any production path; the functions are traced or
+parsed, never called for effect.
+"""
+
+from __future__ import annotations
+
+import threading
+
+SELFTEST_PATH = "gauss_tpu/analysis/selftest.py"
+
+
+# -- jaxpr-pass fixtures (traced via --check-entry) --------------------------
+
+def _callback_entry():
+    """A jitted program carrying a pure_callback — jaxpr.callback must
+    flag it because the entry is NOT registered host-stepped."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.zeros((4, 4), jnp.float32)
+
+        def fn(m):
+            probe = jax.pure_callback(
+                lambda x: x, jax.ShapeDtypeStruct((), jnp.float32),
+                m[0, 0])
+            return m + probe
+        return fn, (a,), {}
+    return build
+
+
+def _bf16_dot_entry():
+    """A dot_general on bf16 operands with neither
+    preferred_element_type=f32 nor an f32 output — jaxpr.bf16_accum."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.zeros((8, 8), jnp.bfloat16)
+
+        def fn(m):
+            return jax.lax.dot_general(
+                m, m, dimension_numbers=(((1,), (0,)), ((), ())))
+        return fn, (a,), {}
+    return build
+
+
+def _f64_entry():
+    """An f64-producing program on an entry NOT registered as a
+    refinement site — jaxpr.f64."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.zeros((4,), jnp.float32)
+
+        def fn(v):
+            # x64 is off globally (the repo computes f64 on host); the
+            # scoped enable is how an f64 op would sneak into a program.
+            with jax.experimental.enable_x64():
+                return jnp.cumsum(v.astype(jnp.float64))
+        return fn, (a,), {}
+    return build
+
+
+def selftest_entries():
+    """Fresh EntryPoint objects per call (the registry dataclass is
+    frozen; building here keeps import of this module jax-free)."""
+    from gauss_tpu.core.entrypoints import EntryPoint
+
+    def where(builder):
+        return (SELFTEST_PATH, builder.__code__.co_firstlineno)
+
+    return [
+        EntryPoint("selftest/callback", _callback_entry(),
+                   where=where(_callback_entry)),
+        EntryPoint("selftest/bf16_dot", _bf16_dot_entry(),
+                   where=where(_bf16_dot_entry)),
+        EntryPoint("selftest/f64", _f64_entry(),
+                   where=where(_f64_entry)),
+    ]
+
+
+#: what --check-entry gauss_tpu.analysis.selftest:SELFTEST_ENTRIES loads.
+#: (A property-style callable is not importable by name; the CLI accepts
+#: a list, so materialize lazily through __getattr__ below.)
+def __getattr__(name):
+    if name == "SELFTEST_ENTRIES":
+        return selftest_entries()
+    raise AttributeError(name)
+
+
+# -- lockset-pass fixtures (parsed via --check-file) -------------------------
+
+class SelftestRacyCounter:
+    """Every lockset rule in one class. Line numbers are recorded in
+    EXPECTED_FINDINGS below; keep them in sync when editing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0                  # guarded by: self._lock
+        self.phantom = 0                # guarded by: self._ghost_lock
+        self.inbox: list = []           # owned by: selftest_worker
+
+    def bump(self):
+        with self._lock:
+            self.ticks += 1             # guarded — must NOT flag
+
+    def racy_read(self):
+        return self.ticks               # VIOLATION: lockset.unguarded
+
+    def off_thread_touch(self):
+        self.inbox.append(1)            # VIOLATION: lockset.thread
+
+    # lockset: thread selftest_worker
+    def worker_only(self):
+        self.inbox.append(2)            # confined — must NOT flag
+
+    def waived_read(self):
+        return self.ticks               # lockset: ok — fixture for the waiver path
+
+
+def selftest_unguarded_terminal(obs, req, result):
+    """A terminal serve_request emission with no winning resolve() CAS
+    around it — lockset.cas_terminal."""
+    obs.emit("serve_request", status="ok", rid=req)
+    return result
+
+
+# -- drift-pass fixtures (scanned via --check-file) --------------------------
+
+class SelftestCtor:
+    pass
+
+
+def selftest_falsy_default(cache=None):
+    """The PR-12 anti-pattern verbatim — drift.falsy_default."""
+    return cache or SelftestCtor()
+
+
+def selftest_undocumented_event():
+    """Emits an event name no docs/OBSERVABILITY.md row documents —
+    drift.event_doc."""
+    from gauss_tpu import obs
+
+    obs.emit("selftest_phantom_event", value=1)
+
+
+def _lineno(obj) -> int:
+    return obj.__code__.co_firstlineno
+
+
+#: rule -> (path, line) the seeded violation must be reported at; the
+#: red-path test drives gauss-lint and asserts each appears verbatim.
+def expected_findings():
+    return {
+        "jaxpr.callback": (SELFTEST_PATH, _lineno(_callback_entry)),
+        "jaxpr.bf16_accum": (SELFTEST_PATH, _lineno(_bf16_dot_entry)),
+        "jaxpr.f64": (SELFTEST_PATH, _lineno(_f64_entry)),
+        "lockset.unguarded":
+            (SELFTEST_PATH, _lineno(SelftestRacyCounter.racy_read) + 1),
+        "lockset.thread":
+            (SELFTEST_PATH,
+             _lineno(SelftestRacyCounter.off_thread_touch) + 1),
+        "lockset.never_locked":
+            (SELFTEST_PATH,
+             _lineno(SelftestRacyCounter.__init__) + 3),
+        "lockset.cas_terminal":
+            (SELFTEST_PATH, _lineno(selftest_unguarded_terminal) + 3),
+        "drift.falsy_default":
+            (SELFTEST_PATH, _lineno(selftest_falsy_default) + 2),
+        "drift.event_doc":
+            (SELFTEST_PATH, _lineno(selftest_undocumented_event) + 5),
+    }

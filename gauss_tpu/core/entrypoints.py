@@ -1,0 +1,464 @@
+"""The declared registry of fast-path solve entry points.
+
+Three load-bearing contracts in this tree are promises about TRACED
+PROGRAMS, not about any particular test size: the PR-10 fast-path contract
+(``resolve_factor`` with keyword defaults is ONE fully-jitted program with
+no host callsites), the PR-11 precision contract (every trailing dot on
+bf16 operands accumulates f32), and the donation contract (declared
+donations survive to the executable's input/output aliasing — CPU honors
+donation in this container, so a silently-dropped alias is invisible to
+behavioral tests). The test suite samples them at a few sizes; the static
+auditor (``gauss_tpu.analysis.jaxpr_audit`` / ``gauss-lint``) re-derives
+them from the closed jaxpr of EVERY registered entry point.
+
+This module is the single source of what "every registered entry point"
+means:
+
+- :data:`ENTRY_POINTS` — one :class:`EntryPoint` per audited program
+  form: a ``trace()`` builder returning ``(callable, args, kwargs)`` for
+  ``jax.make_jaxpr``, flags for the host-stepped routes (callbacks
+  allowed) and refinement sites (f64 allowed), and an optional
+  ``lower()`` builder for entries that declare buffer donation.
+- :data:`REGISTERED_FUNCS` — the public functions those entries cover.
+- :data:`EXEMPT_FUNCS` — public solve entry points deliberately NOT
+  traced, each with the reason (host drivers/routers over registered
+  engines, mesh-requiring dist forms). The registry-completeness rule
+  (and tests/test_analysis.py) asserts every discovered public solve
+  entry point is in exactly one of the two sets, so a new solve API
+  cannot ship unaudited by accident.
+
+Adding a fast-path entry: append an :class:`EntryPoint` to
+:func:`entry_points` AND its function name to :data:`REGISTERED_FUNCS`
+(docs/ANALYSIS.md walks through it). Keep trace sizes small (n=64,
+panel=16): tracing never executes the program, so the audit stays
+seconds, not minutes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: audit trace geometry: small enough that make_jaxpr is milliseconds,
+#: large enough that every panel/group code path appears in the trace.
+AUDIT_N = 64
+AUDIT_PANEL = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One audited fast-path entry: how to trace it and what it may do."""
+
+    name: str
+    #: ``() -> (callable, args, kwargs)`` handed to ``jax.make_jaxpr``;
+    #: None for host-stepped entries (registered for completeness and the
+    #: callback exemption, but there is no single program to trace).
+    trace: Optional[Callable[[], Tuple[Callable, tuple, dict]]] = None
+    #: the ONLY entries allowed host callbacks (checkpoint / out-of-core /
+    #: ABFT replay runners — their per-group host step is the feature).
+    host_stepped: bool = False
+    #: declared refinement site: f64 ops allowed in the traced program.
+    refinement: bool = False
+    #: ``() -> jax Lowered`` for entries that declare buffer donation; the
+    #: auditor asserts the lowering carries the input/output alias.
+    lower_donating: Optional[Callable[[], object]] = None
+    #: additionally compile ``lower_donating`` and assert the alias
+    #: survives to the executable (one entry is enough to pin backend
+    #: behavior; compiles cost ~a second each on the CPU proxy).
+    compile_check: bool = False
+    note: str = ""
+    #: (repo-relative path, line) findings anchor to; None = the
+    #: registry itself (extra entries — tests, selftest — point home).
+    where: Optional[Tuple[str, int]] = None
+
+
+def _system(n: int = AUDIT_N, dtype="float32"):
+    """A deterministic well-conditioned audit operand (never executed —
+    tracing only needs shapes/dtypes, but concrete operands keep host-side
+    numpy preludes in wrapped entries working)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((n, n)).astype(np.float64)
+    a += n * np.eye(n)
+    b = rng.standard_normal(n).astype(np.float64)
+    if dtype == "float64":
+        return a, b
+    import jax.numpy as jnp
+
+    return jnp.asarray(a, dtype=dtype), jnp.asarray(b, dtype=dtype)
+
+
+def _factor_entry(unroll, **kw):
+    def build():
+        from gauss_tpu.core import blocked
+
+        a, _ = _system()
+        factor = blocked.resolve_factor(AUDIT_N, unroll, **kw)
+        return (lambda m: factor(m, panel=AUDIT_PANEL)), (a,), {}
+    return build
+
+
+def _bf16_factor_entry(fn_name):
+    def build():
+        from gauss_tpu.core import blocked
+
+        a, _ = _system(dtype="bfloat16")
+        fn = getattr(blocked, fn_name)
+        return (lambda m: fn(m, panel=AUDIT_PANEL)), (a,), {}
+    return build
+
+
+def _bf16x3_factor_entry():
+    def build():
+        from gauss_tpu.core import blocked
+
+        a, _ = _system()
+        return (lambda m: blocked.lu_factor_blocked(
+            m, panel=AUDIT_PANEL, gemm_precision="bf16x3")), (a,), {}
+    return build
+
+
+def _lu_solve_entry(dtype="float32"):
+    def build():
+        from gauss_tpu.core import blocked
+
+        a, b = _system(dtype=dtype)
+        def fn(m, rhs):
+            fac = blocked.lu_factor_blocked(m, panel=AUDIT_PANEL)
+            return blocked.lu_solve(fac, rhs)
+        return fn, (a, b), {}
+    return build
+
+
+def _gauss_solve_entry():
+    def build():
+        from gauss_tpu.core import gauss
+
+        a, b = _system()
+        return gauss.gauss_solve, (a, b), {}
+    return build
+
+
+def _gauss_solve_blocked_entry():
+    def build():
+        from gauss_tpu.core import blocked
+
+        a, b = _system()
+        return (lambda m, rhs: blocked.gauss_solve_blocked(
+            m, rhs, panel=AUDIT_PANEL)), (a, b), {}
+    return build
+
+
+def _refine_ds_entry():
+    def build():
+        import numpy as np
+
+        from gauss_tpu.core import blocked, dsfloat
+
+        a, b = _system()
+        a64 = np.asarray(a, np.float64)
+        fac = blocked.lu_factor_blocked(a, panel=AUDIT_PANEL)
+        at_ds = dsfloat.to_ds(a64.T)
+        b_ds = dsfloat.to_ds(np.asarray(b, np.float64))
+        x0 = blocked.lu_solve(fac, b_ds.hi)
+        return (lambda x: dsfloat.refine_ds(fac, at_ds, b_ds, x,
+                                            iters=2)), (x0,), {}
+    return build
+
+
+def _chol_entry(solve: bool):
+    def build():
+        import numpy as np
+
+        from gauss_tpu.structure import cholesky
+
+        a, b = _system(dtype="float64")
+        spd = np.asarray(a @ a.T + AUDIT_N * np.eye(AUDIT_N), np.float32)
+        rhs = np.asarray(b, np.float32)
+        if solve:
+            def fn(m, r):
+                fac = cholesky.cholesky_factor_blocked(m, panel=AUDIT_PANEL)
+                return cholesky.cholesky_solve(fac, r)
+            return fn, (spd, rhs), {}
+        return (lambda m: cholesky.cholesky_factor_blocked(
+            m, panel=AUDIT_PANEL)), (spd,), {}
+    return build
+
+
+def _tridiag_entry():
+    def build():
+        import numpy as np
+
+        from gauss_tpu.structure import banded
+
+        rng = np.random.default_rng(1)
+        n = AUDIT_N
+        d = (4.0 + rng.random(n)).astype(np.float32)
+        dl = rng.random(n).astype(np.float32)   # dl[0] ignored
+        du = rng.random(n).astype(np.float32)   # du[-1] ignored
+        b = rng.random(n).astype(np.float32)
+        return banded.solve_tridiag, (dl, d, du, b), {}
+    return build
+
+
+def _band_blocklu_entry():
+    def build():
+        import numpy as np
+
+        from gauss_tpu.structure import banded
+
+        rng = np.random.default_rng(2)
+        n, bw = AUDIT_N, 4
+        a = np.zeros((n, n), np.float64)
+        for k in range(-bw, bw + 1):
+            a += np.diag(rng.random(n - abs(k)), k)
+        a += 4.0 * (2 * bw + 1) * np.eye(n)
+        a32 = a.astype(np.float32)
+        b32 = rng.random(n).astype(np.float32)
+        # solve_band_blocklu stages its block diagonals on host (numpy);
+        # the program it dispatches is the jitted two-scan form — trace
+        # exactly that, on the staged operands.
+        D, E, F, npad = banded._block_diagonals(a32, bw)
+        B = b32.reshape(-1, 1)
+        Bp = np.zeros((npad, 1), np.float32)
+        Bp[:n] = B
+        Bp = Bp.reshape(D.shape[0], bw, 1)
+        return banded._band_run_jit(), (D, E, F, Bp), {}
+    return build
+
+
+def _serve_exe(dtype: str):
+    from gauss_tpu.serve.cache import BatchedExecutable, CacheKey
+
+    key = CacheKey(bucket_n=32, nrhs=1, batch=2, dtype=dtype,
+                   engine="blocked", refine_steps=1)
+    return BatchedExecutable(key, panel=AUDIT_PANEL)
+
+
+def _serve_entry(dtype: str, solve: bool):
+    def build():
+        import numpy as np
+
+        from gauss_tpu.serve.cache import storage_dtype
+
+        exe = _serve_exe(dtype)
+        sd = storage_dtype(dtype)
+        a = np.stack([np.eye(32, dtype=sd)] * 2)
+        if not solve:
+            return (lambda m: exe._factor(m)), (a,), {}
+        fac = exe._factor(a.copy())
+        b = np.zeros((2, 32, 1), dtype=sd)
+        return (lambda f, r: exe._solve(f, r)), (fac, b), {}
+    return build
+
+
+def _lower_factor_donating():
+    import jax.numpy as jnp
+
+    from gauss_tpu.core import blocked
+
+    a, _ = _system()
+    return blocked.lu_factor_blocked_donating.lower(jnp.asarray(a),
+                                                    panel=AUDIT_PANEL)
+
+
+def _lower_serve_solve_donating():
+    import numpy as np
+
+    exe = _serve_exe("float32")
+    a = np.stack([np.eye(32, dtype=np.float32)] * 2)
+    fac = exe._factor(a)
+    return exe._solve.lower(fac, np.zeros((2, 32, 1), np.float32))
+
+
+def entry_points() -> List[EntryPoint]:
+    """The audited registry (rebuilt per call: entries capture live
+    callables, and the serve entries build/warm real executables)."""
+    return [
+        # resolve_factor across every unroll policy — the PR-10 contract.
+        EntryPoint("factor/auto", _factor_entry("auto")),
+        EntryPoint("factor/unrolled", _factor_entry(True)),
+        EntryPoint("factor/flat", _factor_entry(False)),
+        EntryPoint("factor/chunked", _factor_entry("chunked")),
+        # the checksum-carrying single program: still callback-free.
+        EntryPoint("factor/abft", _factor_entry("auto", abft=True)),
+        # donation: declared on the twin, must survive lowering+compile.
+        EntryPoint("factor/donating", _factor_entry("auto", donate=True),
+                   lower_donating=_lower_factor_donating,
+                   compile_check=True),
+        # the lowered/bf16 forms — the PR-11 precision contract surface.
+        EntryPoint("factor/bf16", _bf16_factor_entry("lu_factor_blocked")),
+        EntryPoint("factor/bf16/chunked",
+                   _bf16_factor_entry("lu_factor_blocked_chunked")),
+        EntryPoint("factor/bf16x3", _bf16x3_factor_entry(),
+                   note="f32 storage; split-GEMM trailing updates"),
+        EntryPoint("lu_solve", _lu_solve_entry()),
+        EntryPoint("lu_solve/bf16", _lu_solve_entry(dtype="bfloat16"),
+                   note="f32-accuracy solves against bf16 factors"),
+        EntryPoint("gauss_solve", _gauss_solve_entry()),
+        EntryPoint("gauss_solve_blocked", _gauss_solve_blocked_entry()),
+        # the double-single refinement loop — the declared f64/refinement
+        # site every refined solver shares.
+        EntryPoint("refine_ds", _refine_ds_entry(), refinement=True),
+        # structured engines.
+        EntryPoint("chol/factor", _chol_entry(solve=False)),
+        EntryPoint("chol/solve", _chol_entry(solve=True)),
+        EntryPoint("banded/thomas", _tridiag_entry()),
+        EntryPoint("banded/blocklu", _band_blocklu_entry()),
+        # the serve plane's compiled lanes (vmap-batched factor+solve).
+        EntryPoint("serve/factor", _serve_entry("float32", solve=False)),
+        EntryPoint("serve/solve", _serve_entry("float32", solve=True),
+                   lower_donating=_lower_serve_solve_donating),
+        EntryPoint("serve/factor/bf16",
+                   _serve_entry("bfloat16", solve=False)),
+        EntryPoint("serve/solve/bf16", _serve_entry("bfloat16", solve=True)),
+        # host-stepped routes: registered so the callback exemption is a
+        # DECLARED property, not a scan hole; there is no single jaxpr.
+        EntryPoint("factor/checkpointed", host_stepped=True,
+                   note="resilience.checkpoint — the only host-stepped "
+                        "resolve_factor route"),
+        EntryPoint("outofcore", host_stepped=True,
+                   note="gauss_tpu.outofcore — host-streamed by design"),
+        EntryPoint("abft/replay", host_stepped=True,
+                   note="resilience.abft runners — per-group host "
+                        "verify/replay is the feature"),
+    ]
+
+
+#: public functions the registry's entries cover (module:function).
+REGISTERED_FUNCS = {
+    "gauss_tpu.core.gauss:gauss_solve",
+    "gauss_tpu.core.blocked:lu_factor_blocked",
+    "gauss_tpu.core.blocked:lu_factor_blocked_unrolled",
+    "gauss_tpu.core.blocked:lu_factor_blocked_chunked",
+    "gauss_tpu.core.blocked:lu_factor_blocked_donating",
+    "gauss_tpu.core.blocked:lu_factor_blocked_unrolled_donating",
+    "gauss_tpu.core.blocked:lu_factor_blocked_chunked_donating",
+    "gauss_tpu.core.blocked:lu_solve",
+    "gauss_tpu.core.blocked:gauss_solve_blocked",
+    "gauss_tpu.core.blocked:resolve_factor",
+    "gauss_tpu.core.dsfloat:refine_ds",
+    "gauss_tpu.structure.cholesky:cholesky_factor_blocked",
+    "gauss_tpu.structure.cholesky:cholesky_factor_blocked_unrolled",
+    "gauss_tpu.structure.cholesky:cholesky_solve",
+    "gauss_tpu.structure.cholesky:resolve_chol_factor",
+    "gauss_tpu.structure.banded:solve_tridiag",
+    "gauss_tpu.structure.banded:solve_band_blocklu",
+    "gauss_tpu.outofcore.stream:lu_factor_outofcore",
+    "gauss_tpu.outofcore.stream:lu_solve_outofcore",
+    "gauss_tpu.outofcore.stream:solve_outofcore",
+    "gauss_tpu.resilience.checkpoint:lu_factor_blocked_chunked_checkpointed",
+    "gauss_tpu.resilience.abft:lu_factor_abft",
+    "gauss_tpu.resilience.abft:solve_lu_abft",
+    "gauss_tpu.resilience.abft:cholesky_factor_abft",
+    "gauss_tpu.resilience.abft:solve_chol_abft",
+}
+
+#: public solve entry points deliberately NOT traced, with the reason —
+#: host drivers/routers over registered engines, or forms whose program
+#: shape needs an environment the auditor does not stand up (meshes).
+EXEMPT_FUNCS: Dict[str, str] = {
+    "gauss_tpu.core.blocked:solve_refined":
+        "host driver: numpy f64 residual loop around the registered "
+        "factor/solve programs",
+    "gauss_tpu.core.blocked:solve_handoff":
+        "host router over registered engines (single_chip/dist/outofcore); "
+        "its routing decision is audited dynamically via route events",
+    "gauss_tpu.core.blocked:lu_factor_blocked_phased":
+        "host-stepped diagnostic path (--phase-profile), never on the "
+        "fast path",
+    "gauss_tpu.core.dsfloat:solve_ds":
+        "host staging around refine_ds (registered)",
+    "gauss_tpu.core.dsfloat:solve_once_ds":
+        "host staging around refine_ds (registered); bench slope chain",
+    "gauss_tpu.core.lowered:solve_lowered":
+        "host ladder driver over the registered bf16/bf16x3 factor forms",
+    "gauss_tpu.core.lowered:solve_lowered_auto":
+        "host demotion ladder over solve_lowered",
+    "gauss_tpu.structure.cholesky:cholesky_factor":
+        "host entry: NotSPD witness check around the registered "
+        "chol/factor program",
+    "gauss_tpu.structure.cholesky:solve_spd":
+        "host entry over cholesky_factor + cholesky_solve (both "
+        "registered)",
+    "gauss_tpu.structure.cholesky:solve_spd_refined":
+        "host refinement driver over chol/factor + chol/solve",
+    "gauss_tpu.structure.cholesky:solve_spd_ds":
+        "host staging around refine_ds(solve_fn=cholesky_solve)",
+    "gauss_tpu.structure.banded:solve_banded":
+        "host bandwidth-measuring router over the registered banded "
+        "engines",
+    "gauss_tpu.structure.banded:solve_banded_refined":
+        "host refinement driver over solve_banded",
+    "gauss_tpu.structure.blockdiag:solve_blockdiag":
+        "host-orchestrated vmap batching through the serve executable "
+        "cache (serve/factor + serve/solve are the traced programs)",
+    "gauss_tpu.structure.router:solve_auto":
+        "host detect->route->recovery-ladder driver",
+    "gauss_tpu.resilience.recover:solve_resilient":
+        "host recovery ladder over registered/exempt rungs",
+}
+
+#: modules the completeness rule scans for public solve entry points.
+AUDIT_MODULES = (
+    "gauss_tpu.core.gauss",
+    "gauss_tpu.core.blocked",
+    "gauss_tpu.core.dsfloat",
+    "gauss_tpu.core.lowered",
+    "gauss_tpu.structure.cholesky",
+    "gauss_tpu.structure.banded",
+    "gauss_tpu.structure.blockdiag",
+    "gauss_tpu.structure.router",
+    "gauss_tpu.outofcore.stream",
+    "gauss_tpu.resilience.recover",
+    "gauss_tpu.resilience.abft",
+    "gauss_tpu.resilience.checkpoint",
+)
+
+#: a public callable with one of these prefixes is a solve entry point.
+_SOLVE_PREFIXES = ("solve_", "gauss_solve", "lu_factor", "lu_solve",
+                   "cholesky_factor", "cholesky_solve", "resolve_")
+
+
+def stale_declarations() -> List[str]:
+    """Registered/exempt names that no longer resolve to a module
+    attribute — a renamed entry point must update the registry, not
+    silently fall out of the audit."""
+    import importlib
+
+    out: List[str] = []
+    for qual in sorted(REGISTERED_FUNCS | set(EXEMPT_FUNCS)):
+        modname, name = qual.split(":")
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:
+            out.append(qual)
+            continue
+        if not hasattr(mod, name):
+            out.append(qual)
+    return out
+
+
+def discover_public_solvers() -> List[str]:
+    """Every public solve entry point in :data:`AUDIT_MODULES`
+    (``module:function`` strings) — what the completeness rule compares
+    against REGISTERED_FUNCS | EXEMPT_FUNCS."""
+    import importlib
+
+    found: List[str] = []
+    for modname in AUDIT_MODULES:
+        mod = importlib.import_module(modname)
+        for name in sorted(vars(mod)):
+            if name.startswith("_") or not name.startswith(_SOLVE_PREFIXES):
+                continue
+            obj = getattr(mod, name)
+            if not callable(obj):
+                continue
+            owner = getattr(obj, "__module__", modname)
+            # jit/wrapper objects may not carry __module__; treat names
+            # whose wrapped function came from elsewhere as re-exports.
+            if owner is not None and owner != modname:
+                continue
+            found.append(f"{modname}:{name}")
+    return found

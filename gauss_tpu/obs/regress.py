@@ -326,6 +326,16 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "lint_report":
+        # A gauss-lint --json summary: per-pass finding counts enter
+        # history so the static gates ratchet like perf metrics — with
+        # the committed epochs at 0, ANY finding is out-of-band here too.
+        # Counts are built by the analysis package (single source, jax-
+        # free) rather than _record, which by design drops the 0 values
+        # that are this gate's healthy state.
+        from gauss_tpu.analysis import history_records as lint_hist
+
+        return lint_hist(doc, source=os.path.basename(os.fspath(path)))
     if isinstance(doc, list):  # bench-grid --json cells
         for cell in doc:
             if isinstance(cell, dict) and cell.get("verified"):

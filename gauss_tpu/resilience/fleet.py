@@ -333,7 +333,8 @@ def solve_supervised(a, b, *, config: Optional[FleetConfig] = None,
     only supervises — and, at the last elastic rung, finishes the job
     itself from the last good checkpoint generation.
     """
-    cfg = dataclasses.replace(config or FleetConfig(), **overrides)
+    cfg = dataclasses.replace(
+        config if config is not None else FleetConfig(), **overrides)
     if cfg.workers < 1:
         raise ValueError(f"workers must be >= 1, got {cfg.workers}")
     a64 = np.asarray(a, np.float64)

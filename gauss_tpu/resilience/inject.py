@@ -229,9 +229,9 @@ class ActivePlan:
         self._rngs = [np.random.default_rng(
             np.random.SeedSequence((plan.seed, sp.seed, _site_key(sp.site))))
             for sp in plan.specs]
-        self.polls: Dict[str, int] = {}
-        self.triggers: List[int] = [0] * len(plan.specs)
-        self._skips_left: List[int] = [sp.skip for sp in plan.specs]
+        self.polls: Dict[str, int] = {}           # guarded by: self._lock
+        self.triggers: List[int] = [0] * len(plan.specs)  # guarded by: self._lock
+        self._skips_left: List[int] = [sp.skip for sp in plan.specs]  # guarded by: self._lock
 
     def poll(self, site: str) -> Optional[FaultSpec]:
         """One hook-point visit: returns the spec that fires, or None. At

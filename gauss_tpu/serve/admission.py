@@ -300,7 +300,7 @@ class ServeRequest:
                               if deadline_s is not None else None)
         self._done = threading.Event()
         self._resolve_lock = threading.Lock()
-        self._result: Optional[ServeResult] = None
+        self._result: Optional[ServeResult] = None  # guarded by: self._resolve_lock
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -365,7 +365,7 @@ class ServeRequest:
                 raise TimeoutError(
                     f"request {self.id} timed out after {timeout} s and "
                     f"was cancelled")
-        return self._result  # type: ignore[return-value]
+        return self._result  # lockset: ok — read after the done event; _done.set() under the lock is the happens-before edge
 
     @property
     def done(self) -> bool:
@@ -385,8 +385,8 @@ class LaneHealth:
         self.unhealthy_after = max(1, int(unhealthy_after))
         self.cooldown_s = float(cooldown_s)
         self._lock = threading.Lock()
-        self._consecutive = 0
-        self._open_until: Optional[float] = None
+        self._consecutive = 0           # guarded by: self._lock
+        self._open_until: Optional[float] = None  # guarded by: self._lock
 
     def record_success(self) -> None:
         with self._lock:

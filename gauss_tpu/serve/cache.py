@@ -201,6 +201,7 @@ class ExecutableCache:
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        # guarded by: self._lock
         self._entries: "OrderedDict[CacheKey, BatchedExecutable]" = \
             OrderedDict()
         self._lock = threading.Lock()
@@ -209,11 +210,11 @@ class ExecutableCache:
         #: the SAME key wait here instead of compiling a duplicate — with
         #: multiple dispatch lanes warming one shared cache, N lanes
         #: hitting a cold bucket must pay ONE build, not N.
-        self._building: dict = {}
-        self.hits = 0
-        self.misses = 0
-        self.coalesced = 0
-        self.evictions = 0
+        self._building: dict = {}       # guarded by: self._lock
+        self.hits = 0                   # guarded by: self._lock
+        self.misses = 0                 # guarded by: self._lock
+        self.coalesced = 0              # guarded by: self._lock
+        self.evictions = 0              # guarded by: self._lock
 
     def get(self, key: CacheKey,
             builder: Optional[Callable[[CacheKey], BatchedExecutable]] = None,
@@ -289,13 +290,13 @@ class ExecutableCache:
 
     @property
     def hit_rate(self) -> float:
-        total = self.hits + self.misses
-        return self.hits / total if total else 0.0
+        total = self.hits + self.misses  # lockset: ok — stats snapshot
+        return self.hits / total if total else 0.0  # lockset: ok — stats snapshot
 
     def stats(self) -> dict:
-        return {"hits": self.hits, "misses": self.misses,
-                "coalesced": self.coalesced,
-                "evictions": self.evictions, "entries": len(self),
+        return {"hits": self.hits, "misses": self.misses,  # lockset: ok — stats snapshot
+                "coalesced": self.coalesced,  # lockset: ok — stats snapshot
+                "evictions": self.evictions, "entries": len(self),  # lockset: ok — stats snapshot
                 "capacity": self.capacity,
                 "hit_rate": round(self.hit_rate, 4)}
 

@@ -259,19 +259,19 @@ class RequestJournal:
             seq = int(last[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)]) + 1
         else:
             seq = 0
-        self._seq = seq
-        self._path = self._segment_path(seq)
-        self._f = open(self._path, "ab", buffering=0)
-        self._live_records = 0
+        self._seq = seq                 # guarded by: self._lock
+        self._path = self._segment_path(seq)  # guarded by: self._lock
+        self._f = open(self._path, "ab", buffering=0)  # guarded by: self._lock
+        self._live_records = 0          # guarded by: self._lock
         #: rotate once the live segment holds this many records; reset
         #: past each compaction to carried + rotate_records, so a large
         #: carried set cannot re-trigger rotation on every append.
-        self._rotate_at = self.rotate_records
-        self._since_fsync = 0
+        self._rotate_at = self.rotate_records  # guarded by: self._lock
+        self._since_fsync = 0           # guarded by: self._lock
         self.appends = 0
         self.fsyncs = 0
         self.rotations = 0
-        self.closed = False
+        self.closed = False             # guarded by: self._lock
         if self.recovered.torn_dropped:
             obs.emit("journal", event="torn_tail",
                      dropped=self.recovered.torn_dropped, dir=self.dir)

@@ -93,13 +93,13 @@ class _Forming:
     drain cycle lingers blind; that delta is what mesh-serve-check's A/B
     measures)."""
 
-    __slots__ = ("sig", "reqs", "deadline", "closed")
+    __slots__ = ("sig", "reqs", "deadline", "sealed")
 
     def __init__(self, sig: Optional[Tuple], deadline: float):
         self.sig = sig
         self.reqs: list = []
         self.deadline = deadline        # time.perf_counter() close bound
-        self.closed = False
+        self.sealed = False
 
     def note_member(self, req, margin: float) -> None:
         """Tighten the close bound for a member's request deadline
@@ -118,9 +118,10 @@ class Lane:
         self.devices = tuple(devices)
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
-        self.queue: deque = deque()
-        self.forming: Optional[_Forming] = None
-        self.closed = False             # leftover collection has started
+        self.queue: deque = deque()     # guarded by: self.lock
+        self.forming: Optional[_Forming] = None  # guarded by: self.lock
+        self.closed = False             # guarded by: self.lock — leftover
+        #                                 collection has started
         self.thread: Optional[threading.Thread] = None
         self.warm = threading.Event()   # set once startup warmup finished
         self.cache_view = CacheView(cache)
@@ -156,7 +157,7 @@ class Lane:
         self.occupancy_sum += occupancy
         obs.gauge(f"serve.lane{self.idx}.served", self.served)
         obs.gauge(f"serve.lane{self.idx}.occupancy", occupancy)
-        obs.gauge(f"serve.lane{self.idx}.queue_depth", len(self.queue))
+        obs.gauge(f"serve.lane{self.idx}.queue_depth", len(self.queue))  # lockset: ok — gauge snapshot
 
     def stats(self) -> dict:
         return {
@@ -170,7 +171,7 @@ class Lane:
             "occupancy_mean": (round(self.occupancy_sum / self.batches, 4)
                                if self.batches else None),
             "drain_rate": round(self.drain_rate, 4),
-            "queue_depth": len(self.queue),
+            "queue_depth": len(self.queue),  # lockset: ok — stats snapshot
         }
 
 
@@ -210,15 +211,15 @@ class LaneSet:
         self.lanes = [Lane(i, slices[i % len(slices)], server.cache)
                       for i in range(count)]
         self._active = (max(1, min(cfg.min_lanes, count)) if cfg.autoscale
-                        else count)
+                        else count)     # guarded by: self._scale_lock
         self._scale_lock = threading.Lock()
-        self._scale_last = 0.0
-        self._burn_last = 0.0
+        self._scale_last = 0.0          # guarded by: self._scale_lock
+        self._burn_last = 0.0           # guarded by: self._scale_lock
         self._stop = threading.Event()
         #: sticky sig -> lane-index affinity map (first seen = next lane
-        #: round-robin), guarded by _place_lock
+        #: round-robin); guarded by: self._place_lock
         self._sig_lane: dict = {}
-        self._rr = 0
+        self._rr = 0                    # guarded by: self._place_lock
         self._place_lock = threading.Lock()
         #: overflow wake-up: admission notifies here when a lane queue
         #: reaches steal depth, so an IDLE lane steals immediately
@@ -262,8 +263,8 @@ class LaneSet:
                 lane.closed = True
                 leftovers.extend(lane.queue)
                 lane.queue.clear()
-                if lane.forming is not None and not lane.forming.closed:
-                    lane.forming.closed = True
+                if lane.forming is not None and not lane.forming.sealed:
+                    lane.forming.sealed = True
                     leftovers.extend(lane.forming.reqs)
                 lane.forming = None
         return leftovers, joined
@@ -300,7 +301,7 @@ class LaneSet:
         active = self.active_lanes()
         if sig is None:
             # Oversized: no batching to optimize — least-loaded active lane.
-            home = min(active, key=lambda lane: len(lane.queue))
+            home = min(active, key=lambda lane: len(lane.queue))  # lockset: ok — racy depth peek; any lane is correct
         else:
             with self._place_lock:
                 idx = self._sig_lane.get(sig)
@@ -315,7 +316,7 @@ class LaneSet:
             for cand in [home] + [ln for ln in active if ln is not home]:
                 with cand.lock:
                     f = cand.forming
-                    if (not cand.closed and f is not None and not f.closed
+                    if (not cand.closed and f is not None and not f.sealed
                             and f.sig == sig
                             and len(f.reqs) < self.cfg.max_batch):
                         f.reqs.append(req)
@@ -445,7 +446,7 @@ class LaneSet:
                         break
                     lane.cond.wait(min(0.005, remaining))
                     self._fill_from_queue(lane, f)
-                f.closed = True
+                f.sealed = True
                 lane.forming = None
                 batch = f.reqs
                 if cfg.continuous_batching and lane.queue:
@@ -478,6 +479,7 @@ class LaneSet:
         off, the single-lane linger: the fixed-drain discipline the A/B
         gate compares against, which lingers BLIND to member deadlines
         exactly like serve.server._drain_same_bucket always has."""
+        # lockset: holds lane.lock — callers publish under the lane lock
         sig = compat_sig(head, self.server.ladder)
         cb = self.cfg.continuous_batching
         window = self.cfg.cb_window_s if cb else self.cfg.batch_linger_s
@@ -493,6 +495,7 @@ class LaneSet:
         """Pull ``f.sig``-compatible requests from the lane's own queue
         into the slot (callers hold the lane lock). Incompatible requests
         keep their relative order at the queue front."""
+        # lockset: holds lane.lock
         if f.sig is None:
             return
         cb = self.cfg.continuous_batching
@@ -516,9 +519,9 @@ class LaneSet:
         for victim in self.lanes:
             if victim is thief:
                 continue
-            depth = len(victim.queue)   # racy peek; confirmed under lock
+            depth = len(victim.queue)   # lockset: ok — racy peek; confirmed under lock below
             if depth >= cfg.steal_threshold and (
-                    best is None or depth > len(best.queue)):
+                    best is None or depth > len(best.queue)):  # lockset: ok — racy victim ranking; confirmed under lock below
                 best = victim
         if best is None:
             return None

@@ -97,7 +97,10 @@ class SolverServer:
 
     def __init__(self, config: Optional[ServeConfig] = None, *,
                  cache: Optional[ExecutableCache] = None):
-        self.config = config or ServeConfig()
+        # ``is None``, not ``or``: the falsy-default anti-pattern silently
+        # discards a falsy-but-valid operand (the PR-12 empty-cache bug);
+        # gauss-lint's drift pass now bans the shape outright.
+        self.config = config if config is not None else ServeConfig()
         self.ladder = buckets.validate_ladder(
             self.config.ladder or buckets.DEFAULT_LADDER)
         # ``cache``: share one executable cache across server incarnations
@@ -116,19 +119,19 @@ class SolverServer:
         self.health = LaneHealth(self.config.unhealthy_after,
                                  self.config.device_probe_cooldown_s)
         self._queue: "_queue.Queue[ServeRequest]" = _queue.Queue()
-        self._depth = 0                   # admission-visible queue depth
+        self._depth = 0                   # guarded by: self._depth_lock
         self._depth_lock = threading.Lock()
-        self._closed = False              # guarded by _depth_lock
-        self._drain_rate = 0.0            # EWMA requests/s, for retry-after
+        self._closed = False              # guarded by: self._depth_lock
+        self._drain_rate = 0.0            # owned by: worker — EWMA req/s
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
         #: the mesh serving plane (None = single-lane; config.lanes > 0
         #: builds a serve.lanes.LaneSet at start())
         self._lanes = None
         self._stats_lock = threading.Lock()  # batches/served under lanes
-        self.batches = 0
-        self.requests_served = 0
-        self.retries = 0                  # retried batch attempts (total)
+        self.batches = 0                  # guarded by: self._stats_lock
+        self.requests_served = 0          # guarded by: self._stats_lock
+        self.retries = 0                  # guarded by: self._stats_lock
         #: the live telemetry plane (None until start() with a live_port)
         self.live = None                  # obs.live.LiveAggregator
         self._live_server = None          # obs.export.LiveServer
@@ -143,7 +146,7 @@ class SolverServer:
         #: surface): {"replayed", "expired", "clean", ...}; None before
         #: any journaled start.
         self.last_resume = None
-        self._hb_last = 0.0               # heartbeat write throttle
+        self._hb_last = 0.0               # owned by: worker — hb throttle
         if self.config.journal_dir:
             from gauss_tpu.serve import durable as _durable
 
@@ -416,7 +419,7 @@ class SolverServer:
                 obs.emit("serve_request", id=req.id, n=req.n,
                          trace=req.trace_id, status=STATUS_REJECTED,
                          reason="server_stopped")
-        if self.journal is not None and not self.journal.closed:
+        if self.journal is not None and not self.journal.closed:  # lockset: ok — stop() is the only closer; close() re-checks under its lock
             # Graceful drain's final act: the clean-shutdown marker — but
             # only when the stop actually completed (worker joined). A
             # wedged worker might still be computing a journaled admit;
@@ -458,7 +461,7 @@ class SolverServer:
         if self._lanes is not None:
             rate = max(self._lanes.drain_rate(), 1e-3)
         else:
-            rate = max(self._drain_rate, 1e-3)
+            rate = max(self._drain_rate, 1e-3)  # lockset: ok — racy EWMA read; a hint, not state
         return round(min(60.0, max(0.01, self.config.max_batch / rate)), 4)
 
     def submit(self, a, b, deadline_s: Optional[float] = None,
@@ -622,6 +625,7 @@ class SolverServer:
     # -- worker loop ------------------------------------------------------
 
     def _run(self) -> None:
+        # lockset: thread worker — the single-lane dispatch loop
         hb_path = self.config.heartbeat_path
         while not self._stop.is_set():
             if hb_path is not None:
@@ -655,6 +659,8 @@ class SolverServer:
                 _inject.maybe_kill("serve.server.batch")
 
     def _heartbeat(self, path: str) -> None:
+        # lockset: thread worker — called only from the dispatch loop
+        # (single-lane _run, or lane 0 of the mesh plane; never both)
         """Supervisor liveness (durable.supervise): touch the heartbeat
         file from the worker loop, throttled — a wedged worker stops
         touching it and the supervisor calls the stall."""
@@ -666,7 +672,7 @@ class SolverServer:
             with open(path, "w") as f:
                 f.write(json.dumps({"pid": os.getpid(),
                                     "time_unix": time.time(),
-                                    "batches": self.batches}))
+                                    "batches": self.batches}))  # lockset: ok — stats snapshot for liveness
         except OSError:  # pragma: no cover — liveness must not kill serving
             pass
 

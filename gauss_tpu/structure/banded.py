@@ -31,6 +31,8 @@ mis-tag signal the recovery ladder consumes.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from gauss_tpu.structure.detect import BANDED_MAX_DIVISOR, \
@@ -144,9 +146,7 @@ def solve_band_blocklu(a, b, bandwidth: int):
     """Blocked band LU: block-tridiagonal elimination with (b, b) blocks,
     one ``lax.scan`` each way — O(n * b^2) total, static shapes, no
     pivoting (the band's deal; see module docstring)."""
-    import jax
     import jax.numpy as jnp
-    from jax import lax
 
     a = np.asarray(a)
     n = a.shape[0]
@@ -161,10 +161,29 @@ def solve_band_blocklu(a, b, bandwidth: int):
     bp[:n] = b2
     B = jnp.asarray(bp.reshape(nb, s, k))
 
-    dtype = D.dtype
+    x = _band_run_jit()(D, E, F, B)[:n]
+    return x[:, 0] if was_vector else x
+
+
+@functools.lru_cache(maxsize=None)
+def _band_run_jit():
+    """The blocked band LU's jitted two-scan program (built once per
+    process instead of a fresh closure per call, so repeat solves reuse
+    the compile cache). Module-level so the jaxpr auditor
+    (gauss_tpu.core.entrypoints entry "banded/blocklu") can trace the
+    exact program solve_band_blocklu dispatches; every shape/dtype it
+    needs derives from its operands, so the traced program is unchanged
+    from the original closure form."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
 
     @jax.jit
     def run(D, E, F, B):
+        nb, s, _ = D.shape
+        k = B.shape[2]
+        dtype = D.dtype
+
         def fwd(carry, inp):
             dpinv_prev, y_prev = carry
             Di, Ei, Bi, Fprev = inp
@@ -187,8 +206,7 @@ def solve_band_blocklu(a, b, bandwidth: int):
                          (dpinvs, ys, F), reverse=True)
         return xs.reshape(nb * s, k)
 
-    x = run(D, E, F, B)[:n]
-    return x[:, 0] if was_vector else x
+    return run
 
 
 def solve_banded(a, b, bandwidth: int | None = None,
