@@ -14,10 +14,11 @@ DURABLE_SMOKE ?= /tmp/gauss_durable_check
 OUTOFCORE_SMOKE ?= /tmp/gauss_outofcore_check
 MESH_SMOKE ?= /tmp/gauss_mesh_serve_check
 LINT_SMOKE ?= /tmp/gauss_lint_check
+FLIGHT_SMOKE ?= /tmp/gauss_flight_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
 	structure-check tune-check live-check abft-check durable-check \
-	outofcore-check mesh-serve-check lint-check clean
+	outofcore-check mesh-serve-check lint-check flight-check clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -326,6 +327,34 @@ lint-check:
 	  --json $(LINT_SMOKE)/lint.json --regress-check
 	$(PYTHON) -m gauss_tpu.obs.regress check $(LINT_SMOKE)/lint.json \
 	  --history reports/history.jsonl
+
+# The flight-recorder gate (CI-callable): a journaled, flight-recording
+# server child SIGKILLed (kill -9) mid-load once its mmap ring shows the
+# batch budget; the resume run's automatic unclean_resume post-mortem
+# bundle must pass gauss-debug --check and reconstruct the final >= 5
+# batches with trace ids that cross-check against the journal, and an
+# in-flight request set equal to the journal's unterminated admits
+# EXACTLY (exit 2 on any miss). The torn-tail leg re-scans the ring cut
+# at EVERY data-region byte offset (plus a wrapped-ring damage sweep):
+# the scan must never raise and never fabricate a record. The overhead
+# leg measures flight-on seconds-per-request against the same flight-off
+# plan (best-of-2, warm shared cache) and gates it against the 3
+# committed epochs AND the flight ratchet (the always-on ring's cost only
+# ratchets down). The bundle capture fires inside the resume subprocess
+# (not the gate's own obs stream), so the follow-up assertion reads the
+# summary JSON, not a summarize section. Timing-gated: honor the
+# serial-ordering note above.
+flight-check:
+	rm -rf $(FLIGHT_SMOKE) && mkdir -p $(FLIGHT_SMOKE)
+	timeout -k 10 420 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.obs.flightcheck --seed 258458 --tmpdir $(FLIGHT_SMOKE) \
+	  --metrics-out $(FLIGHT_SMOKE)/flight.jsonl \
+	  --summary-json $(FLIGHT_SMOKE)/summary.json --regress-check
+	$(PYTHON) -c "import json; s=json.load(open('$(FLIGHT_SMOKE)/summary.json')); \
+	assert s['invariant_ok'], s; \
+	k=s['kill']; assert k['cause'] == 'unclean_resume' and k['bundle_check_rc'] == 0, k; \
+	print('flight-check: bundle %s reconstructed %d batch(es), %d in flight' \
+	  % (k['bundle'].rsplit('/', 1)[-1], k['batches_reconstructed'], k['in_flight_at_death']))"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -24,6 +24,12 @@ turns each into a checked invariant:
   but-valid operand (empty cache, zero-length container) is silently
   discarded by ``or``; write ``x if x is not None else Ctor()``. A
   deliberate use takes a ``# driftlint: ok — reason`` waiver.
+- ``drift.postmortem_owner`` — every inject kill/stall hook site
+  (``inject.maybe_kill("<site>")``) names the post-mortem cause its
+  death surfaces as (``KILL_SITE_CAUSE``), and every
+  ``postmortem.KNOWN_CAUSES`` entry names a live capture owner
+  (``POSTMORTEM_OWNERS``, ``file::symbol``) — a kill site with no owner
+  is a process that can die with no bundle to explain it.
 - ``drift.api_signature`` — the ``matmul_pallas`` API row's documented
   ``bm/bn/bk`` defaults match the live signature (the ADVICE r5 #3
   regression, pinned).
@@ -62,6 +68,7 @@ TUNE_REFERENCED = (
 AUDITED_CLIS = (
     ("gauss_tpu/serve/cli.py", "gauss-serve"),
     ("gauss_tpu/analysis/cli.py", "gauss-lint"),
+    ("gauss_tpu/obs/debug.py", "gauss-debug"),
 )
 
 SERVE_CONFIG_FILE = "gauss_tpu/serve/admission.py"
@@ -339,6 +346,123 @@ def check_falsy_default(root: str,
     return findings
 
 
+# -- drift.postmortem_owner --------------------------------------------------
+
+#: inject kill/stall hook site -> the postmortem.KNOWN_CAUSES entry the
+#: death surfaces as when it fires under a supervisor. Adding a
+#: ``maybe_kill`` site without a row here fails the gate: the new fault
+#: would kill a process nobody owns a post-mortem capture for.
+KILL_SITE_CAUSE = {
+    "serve.server.batch": "supervisor_death",
+    "outofcore.group": "supervisor_death",
+    "dist.multihost.worker": "fleet_worker_dead",
+    "checkpoint.group": "fleet_worker_dead",
+    "fleet.worker.group": "fleet_worker_dead",
+}
+
+#: post-mortem cause -> ``file::symbol`` of the code that owns capturing
+#: the bundle when that cause fires (the other half of the contract the
+#: KNOWN_CAUSES table in obs/postmortem.py promises). The symbol must be
+#: a live ``def`` in the named file — a renamed owner fails the gate.
+POSTMORTEM_OWNERS = {
+    "supervisor_death": "gauss_tpu/serve/durable.py::supervise",
+    "supervisor_stall": "gauss_tpu/serve/durable.py::supervise",
+    "fleet_worker_dead": "gauss_tpu/resilience/fleet.py::_supervise",
+    "fleet_worker_stalled": "gauss_tpu/resilience/fleet.py::_supervise",
+    "unclean_resume": "gauss_tpu/serve/server.py::_replay",
+    "slo_alert": "gauss_tpu/obs/live.py::observe_slo",
+    "sdc_detected": "gauss_tpu/resilience/recover.py::solve_resilient",
+    "manual": "gauss_tpu/obs/debug.py::main",
+}
+
+DRIFTLINT_FILE = "gauss_tpu/analysis/driftlint.py"
+
+
+def kill_sites(root: str, extra_files: Tuple[str, ...] = (),
+               ) -> Dict[str, Tuple[str, int]]:
+    """site -> (file, first line) for every ``*.maybe_kill("<site>")``."""
+    out: Dict[str, Tuple[str, int]] = {}
+    files = _py_files(root) + [os.path.join(root, f) for f in extra_files
+                               if os.path.exists(os.path.join(root, f))]
+    for path in files:
+        if path.endswith(os.path.join("resilience", "inject.py")):
+            continue  # the hook's own definition/docstring, not a site
+        try:
+            tree = ast.parse(open(path).read())
+        except (OSError, SyntaxError):  # pragma: no cover
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "maybe_kill"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            out.setdefault(node.args[0].value, (rel(path, root),
+                                                node.lineno))
+    return out
+
+
+def check_postmortem_owner(root: str,
+                           extra_files: Tuple[str, ...] = (),
+                           ) -> List[Finding]:
+    from gauss_tpu.obs import postmortem
+
+    findings: List[Finding] = []
+    sites = kill_sites(root, extra_files)
+    for site, (path, line) in sorted(sites.items()):
+        if site not in KILL_SITE_CAUSE:
+            findings.append(Finding(
+                rule="drift.postmortem_owner", path=path, line=line,
+                symbol=site,
+                message=f"inject kill/stall site '{site}' has no "
+                        f"KILL_SITE_CAUSE row (analysis/driftlint.py) — "
+                        f"a process this fault kills would die with no "
+                        f"owner on the hook to capture its post-mortem "
+                        f"bundle"))
+    for site, cause in sorted(KILL_SITE_CAUSE.items()):
+        if site not in sites:
+            findings.append(Finding(
+                rule="drift.postmortem_owner", path=DRIFTLINT_FILE,
+                line=1, symbol=site,
+                message=f"KILL_SITE_CAUSE names '{site}' but no "
+                        f"maybe_kill(\"{site}\") hook exists — stale "
+                        f"registry row"))
+        if cause not in postmortem.KNOWN_CAUSES:
+            findings.append(Finding(
+                rule="drift.postmortem_owner", path=DRIFTLINT_FILE,
+                line=1, symbol=site,
+                message=f"KILL_SITE_CAUSE maps '{site}' to '{cause}', "
+                        f"which is not in postmortem.KNOWN_CAUSES"))
+    for cause in postmortem.KNOWN_CAUSES:
+        if cause not in POSTMORTEM_OWNERS:
+            findings.append(Finding(
+                rule="drift.postmortem_owner",
+                path="gauss_tpu/obs/postmortem.py", line=1, symbol=cause,
+                message=f"KNOWN_CAUSES entry '{cause}' has no "
+                        f"POSTMORTEM_OWNERS row — every cause must name "
+                        f"the code that captures its bundle"))
+    for cause, owner in sorted(POSTMORTEM_OWNERS.items()):
+        if cause not in postmortem.KNOWN_CAUSES:
+            findings.append(Finding(
+                rule="drift.postmortem_owner", path=DRIFTLINT_FILE,
+                line=1, symbol=cause,
+                message=f"POSTMORTEM_OWNERS names unknown cause "
+                        f"'{cause}' (not in postmortem.KNOWN_CAUSES)"))
+        path, _, symbol = owner.partition("::")
+        text = _read(root, path)
+        if text is None or not re.search(
+                rf"^\s*def {re.escape(symbol)}\b", text, re.M):
+            findings.append(Finding(
+                rule="drift.postmortem_owner", path=DRIFTLINT_FILE,
+                line=1, symbol=cause,
+                message=f"POSTMORTEM_OWNERS owner '{owner}' for "
+                        f"'{cause}' does not resolve to a def — the "
+                        f"capture owner moved or was renamed"))
+    return findings
+
+
 # -- drift.api_signature -----------------------------------------------------
 
 def check_api_signature(root: str) -> List[Finding]:
@@ -396,12 +520,14 @@ def run(root: Optional[str] = None,
     findings += check_event_doc(root, extra_files)
     findings += check_ratchet_history(root)
     findings += check_falsy_default(root, extra_files)
+    findings += check_postmortem_owner(root, extra_files)
     findings += check_api_signature(root)
     stats = {
         "tune_constants": len(TUNE_SOURCED) + len(TUNE_REFERENCED),
         "config_fields": len(serve_config_fields(root)),
         "cli_flags": sum(len(cli_flags(root, p)) for p, _ in AUDITED_CLIS),
         "events": len(emitted_events(root)),
+        "kill_sites": len(kill_sites(root)),
         "findings": len(findings),
     }
     return findings, stats

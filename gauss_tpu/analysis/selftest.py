@@ -162,6 +162,14 @@ def selftest_undocumented_event():
     obs.emit("selftest_phantom_event", value=1)
 
 
+def selftest_unowned_kill_site():
+    """Polls an inject kill/stall at a site no KILL_SITE_CAUSE row owns —
+    drift.postmortem_owner."""
+    from gauss_tpu.resilience import inject
+
+    inject.maybe_kill("selftest.phantom.site")
+
+
 def _lineno(obj) -> int:
     return obj.__code__.co_firstlineno
 
@@ -187,4 +195,6 @@ def expected_findings():
             (SELFTEST_PATH, _lineno(selftest_falsy_default) + 2),
         "drift.event_doc":
             (SELFTEST_PATH, _lineno(selftest_undocumented_event) + 5),
+        "drift.postmortem_owner":
+            (SELFTEST_PATH, _lineno(selftest_unowned_kill_site) + 5),
     }

@@ -41,11 +41,13 @@ from gauss_tpu.obs.spans import (  # noqa: F401
     counter,
     current_trace,
     emit,
+    flight_sink,
     gauge,
     histogram,
     live_sink,
     record_span,
     run,
+    set_flight_sink,
     set_live_sink,
     span,
     trace_context,
@@ -56,4 +58,6 @@ from gauss_tpu.obs.spans import (  # noqa: F401
 # importing them from the package __init__ would trip runpy's double-import
 # warning. The live plane (obs.live / obs.slo / obs.export) is imported
 # lazily by its users (SolverServer --live-port, gauss-fleet --live-port)
-# so unobserved processes never pay for it.
+# so unobserved processes never pay for it; likewise the flight recorder
+# (obs.flight / obs.postmortem) — installed only when a flight_dir is
+# configured, so the crash ring costs nothing where it isn't wanted.

@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
@@ -40,6 +41,34 @@ from gauss_tpu.obs.live import LiveAggregator
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 _QUANTILES = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+
+
+def flight_status() -> Dict[str, Any]:
+    """The flight-recorder section of ``/snapshot``: whether this process
+    is recording, its ring position, and the LAST post-mortem bundle
+    pointer (cause/time parsed from the filename — no body read per
+    scrape). ``{"recording": False}`` when no sink is installed."""
+    from gauss_tpu.obs import spans
+
+    sink = spans.flight_sink()
+    out: Dict[str, Any] = {"recording": sink is not None}
+    if sink is None:
+        return out
+    try:
+        out["flight_dir"] = sink.flight_dir
+        out["ring"] = sink.position()
+    except Exception:  # pragma: no cover — a scrape never takes serving down
+        return out
+    try:
+        from gauss_tpu.obs import postmortem
+
+        last = postmortem.latest_bundle(
+            postmortem.default_bundles_dir(sink.flight_dir))
+        if last:
+            out["last_bundle"] = postmortem.bundle_info(last)
+    except Exception:  # pragma: no cover
+        pass
+    return out
 
 
 def metric_name(name: str, prefix: str = "gauss") -> str:
@@ -59,12 +88,17 @@ def _fmt_value(v: float) -> str:
     return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def render_prometheus(snapshot: Dict[str, Any], prefix: str = "gauss") -> str:
+def render_prometheus(snapshot: Dict[str, Any], prefix: str = "gauss",
+                      flight: Optional[Dict[str, Any]] = None) -> str:
     """Render an aggregator snapshot as the Prometheus text format.
 
     Deterministic (sorted by metric name) so the format has a golden test;
     one ``# TYPE`` line per family, counters suffixed ``_total``, windows
-    rendered as summaries (quantile labels + _count/_sum)."""
+    rendered as summaries (quantile labels + _count/_sum). ``flight`` (the
+    :func:`flight_status` dict) adds the flight-ring position gauges and —
+    because Prometheus values are numeric-only — the last post-mortem's
+    CAUSE as a label on its age gauge:
+    ``gauss_postmortem_last_age_s{cause="..."}``."""
     lines = []
 
     def family(name: str, typ: str, help_: Optional[str] = None):
@@ -126,6 +160,25 @@ def render_prometheus(snapshot: Dict[str, Any], prefix: str = "gauss") -> str:
         for s in sorted(slos, key=lambda s: s.get("name", "")):
             lines.append(f'{viol}{{slo="{s["name"]}"}} '
                          f"{_fmt_value(s.get('violation_rate', 0.0))}")
+
+    if flight and flight.get("recording"):
+        rec = metric_name("flight.recording", prefix)
+        family(rec, "gauge", "1 while the flight recorder ring is on")
+        lines.append(f"{rec} 1")
+        ring = flight.get("ring") or {}
+        for key in ("wpos", "seq", "capacity", "dropped_oversize"):
+            if key in ring:
+                m = metric_name(f"flight.ring_{key}", prefix)
+                family(m, "gauge")
+                lines.append(f"{m} {_fmt_value(ring[key])}")
+        last = flight.get("last_bundle")
+        if last and isinstance(last.get("time_unix"), (int, float)):
+            m = metric_name("postmortem.last_age_s", prefix)
+            family(m, "gauge",
+                   "seconds since the last post-mortem bundle was captured")
+            cause = str(last.get("cause") or "unknown").replace('"', "'")
+            age = max(0.0, time.time() - float(last["time_unix"]))
+            lines.append(f'{m}{{cause="{cause}"}} {_fmt_value(age)}')
     return "\n".join(lines) + "\n"
 
 
@@ -156,7 +209,9 @@ class _Handler(BaseHTTPRequestHandler):
         agg = self.agg
         if url.path == "/metrics":
             agg.on_counter("live.scrapes")
-            self._reply(200, render_prometheus(agg.snapshot()),
+            self._reply(200,
+                        render_prometheus(agg.snapshot(),
+                                          flight=flight_status()),
                         "text/plain; version=0.0.4; charset=utf-8")
         elif url.path == "/healthz":
             snap = agg.snapshot()
@@ -169,7 +224,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/slo":
             self._json(200, {"slo": agg.slo_status()})
         elif url.path == "/snapshot":
-            self._json(200, agg.snapshot())
+            snap = agg.snapshot()
+            snap["flight"] = flight_status()
+            self._json(200, snap)
         elif url.path == "/trace":
             self._trace(parse_qs(url.query))
         else:

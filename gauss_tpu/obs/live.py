@@ -212,6 +212,20 @@ class LiveAggregator:
             _spans.counter("slo.alerts" if tr["state"] == "firing"
                            else "slo.clears")
             _spans.emit("alert", **tr)
+            if tr["state"] == "firing":
+                # In-process crash detection: a firing burn-rate alert
+                # freezes the flight ring into a post-mortem bundle while
+                # the degradation is still observable. The trigger is a
+                # no-op unless the server armed it (flight_dir set) and is
+                # throttled there — a flapping alert cannot bundle-storm.
+                try:
+                    from gauss_tpu.obs import postmortem as _postmortem
+
+                    _postmortem.trigger("slo_alert", slo=tr.get("slo"),
+                                        burn_short=tr.get("burn_short"),
+                                        burn_long=tr.get("burn_long"))
+                except Exception:  # pragma: no cover — capture is best-effort
+                    pass
 
     def slo_firing(self) -> bool:
         """Is any SLO alert currently firing? (The shed-wiring consult:

@@ -78,7 +78,17 @@ RATCHET_BASELINES = {"gauss_n2048_wallclock": 0.001476,
                      # under it; the ratchet guards the dispatch path
                      # from regressing. Generic ceiling (sub-100ms legs
                      # see the documented scheduler jitter).
-                     "tput:float32/n256/b8/l4/s_per_solve": 0.010606}
+                     "tput:float32/n256/b8/l4/s_per_solve": 0.010606,
+                     # The FLIGHT-RECORDER overhead record (ISSUE 16,
+                     # obs.flightcheck): best committed flight-ON
+                     # seconds-per-request through a recording server on
+                     # the CPU proxy (best-of-2 passes, warm cache, 3
+                     # seeded epochs in history.jsonl). The always-on
+                     # ring getting more expensive can only ratchet DOWN;
+                     # sub-ms dispatches see the documented scheduler
+                     # jitter, so the generic 1.5x ceiling applies (no
+                     # RATCHET_CEILINGS entry on purpose).
+                     "flight:ring_s_per_request": 0.000466}
 #: A fresh headline worse than ratchet * this ceiling fails the gate even
 #: when the median band would wave it through (the default ceiling reuses
 #: the documented epoch-drift envelope: beyond 1.5x the best-ever epoch,
@@ -323,6 +333,23 @@ def ingest_file(path) -> List[Dict[str, Any]]:
 
         for metric, value, unit in durable_hist(doc):
             rec = _record(metric, value, path, "durable", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
+    if isinstance(doc, dict) and doc.get("kind") == "flight_check":
+        # A flight-recorder gate summary (python -m gauss_tpu.obs
+        # .flightcheck --summary-json): the measured ring-on overhead
+        # ratio, ring-on seconds-per-solve, and the kill-to-bundle
+        # campaign cost enter history — the always-on recorder getting
+        # more expensive gates exactly like a perf regression (the
+        # bundle/timeline INVARIANTS are hard exit-2s, not bands).
+        # Derivation lives with the checker (single source); lazy import
+        # keeps jax out of this module.
+        from gauss_tpu.obs.flightcheck import history_records as \
+            flight_hist
+
+        for metric, value, unit in flight_hist(doc):
+            rec = _record(metric, value, path, "flight", unit=unit)
             if rec:
                 records.append(rec)
         return records

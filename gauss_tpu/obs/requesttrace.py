@@ -121,6 +121,47 @@ def request_traces(events: List[Dict[str, Any]],
     return trees
 
 
+def _fold_key(ev: Dict[str, Any]) -> str:
+    """Content identity of an event, ignoring sink-specific stamps: the
+    recorder adds run/seq/t, the flight ring adds tu — the same emit seen
+    through both sinks must collapse to one stage."""
+    skip = ("run", "seq", "t", "tu")
+    return json.dumps({k: v for k, v in ev.items() if k not in skip},
+                      sort_keys=True, default=str)
+
+
+def fold_ring_events(events: List[Dict[str, Any]],
+                     ring_events: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """Merge flight-ring records (:func:`gauss_tpu.obs.flight.scan`) into a
+    recorded stream so a crash-spanning trace completes: the dead
+    incarnation's ring carries the admit/batch stages the recorder lost
+    with the process, the survivor's stream carries the terminal the
+    journal resume produced. Ring events come first (they predate the
+    surviving stream); duplicates — both sinks saw the same emit — fold to
+    one stage. Ring ``tu`` doubles as the stage ``t`` when absent."""
+    out: List[Dict[str, Any]] = []
+    seen = set()
+    for ev in ring_events:
+        if ev.get("type") not in _STAGE_TYPES:
+            continue
+        key = _fold_key(ev)
+        if key in seen:
+            continue
+        seen.add(key)
+        ev = dict(ev)
+        if "t" not in ev and "tu" in ev:
+            ev["t"] = ev["tu"]
+        out.append(ev)
+    for ev in events:
+        key = _fold_key(ev)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    return out
+
+
 def check_traces(trees: Dict[str, Dict[str, Any]]) -> List[str]:
     """The exactly-one-trace-per-terminal invariant, as a problem list
     (empty = healthy). Used by tests and ``make live-check``."""

@@ -8,7 +8,7 @@ check (leaf spans vs the root's duration). Spans measure HOST wall-clock;
 callers bounding device work must block/fetch before the span closes, same
 rule as ``PhaseTimer.phase(block_on=...)``.
 
-Two sinks hang off these hooks:
+Three sinks hang off these hooks:
 
 - the **recorder** (per-run JSONL, post-hoc analysis) — one process-global
   handed over by :func:`run`;
@@ -16,7 +16,12 @@ Two sinks hang off these hooks:
   window in-memory views the ``/metrics`` exposition serves while the
   process runs. Installed by :func:`set_live_sink`; every hook forwards to
   it with the same zero-cost-when-absent contract the recorder has (one
-  module-global read).
+  module-global read);
+- the **flight sink** (:class:`gauss_tpu.obs.flight.FlightSink`) — a
+  crash-surviving mmap ring of the most recent events, harvested by
+  post-mortem capture after a kill. Installed by :func:`set_flight_sink`;
+  same contract again, so ``flight_dir=None`` processes pay exactly one
+  ``is None`` read per hook.
 
 Additionally, a thread-local **trace context** (:func:`trace_context`)
 stamps every event emitted inside it with a ``trace`` id, so request-scoped
@@ -41,6 +46,7 @@ from gauss_tpu.obs import registry as _registry
 _state_lock = threading.Lock()
 _active: Optional[_registry.Recorder] = None
 _live = None  # live sink (duck-typed: on_counter/on_gauge/... — see live.py)
+_flight = None  # flight sink (same duck type — see flight.py)
 _tls = threading.local()
 
 
@@ -65,6 +71,24 @@ def set_live_sink(sink):
     with _state_lock:
         prev = _live
         _live = sink
+    return prev
+
+
+def flight_sink():
+    """The installed flight recorder sink (None -> no crash ring)."""
+    return _flight
+
+
+def set_flight_sink(sink):
+    """Install ``sink`` as the process's crash-surviving flight sink;
+    returns the previous sink so callers can restore (and close) it.
+    ``None`` uninstalls. Receives the same ``on_counter``/``on_gauge``/
+    ``on_histogram``/``on_span``/``on_event`` calls as the live sink —
+    the ring sees exactly the stream everything else sees."""
+    global _flight
+    with _state_lock:
+        prev = _flight
+        _flight = sink
     return prev
 
 
@@ -132,7 +156,8 @@ def emit(type_: str, **fields):
     :func:`trace_context` are stamped with the context's trace id."""
     rec = _active
     ls = _live
-    if rec is None and ls is None:
+    fs = _flight
+    if rec is None and ls is None and fs is None:
         return None
     tid = getattr(_tls, "trace", None)
     if tid is not None and "trace" not in fields and "traces" not in fields:
@@ -140,6 +165,8 @@ def emit(type_: str, **fields):
     ev = rec.emit(type_, **fields) if rec is not None else None
     if ls is not None:
         ls.on_event(type_, fields)
+    if fs is not None:
+        fs.on_event(type_, fields)
     return ev
 
 
@@ -150,6 +177,9 @@ def counter(name: str, inc: float = 1) -> None:
     ls = _live
     if ls is not None:
         ls.on_counter(name, inc)
+    fs = _flight
+    if fs is not None:
+        fs.on_counter(name, inc)
 
 
 def gauge(name: str, value: float) -> None:
@@ -159,6 +189,9 @@ def gauge(name: str, value: float) -> None:
     ls = _live
     if ls is not None:
         ls.on_gauge(name, value)
+    fs = _flight
+    if fs is not None:
+        fs.on_gauge(name, value)
 
 
 def histogram(name: str, value: float) -> None:
@@ -168,6 +201,9 @@ def histogram(name: str, value: float) -> None:
     ls = _live
     if ls is not None:
         ls.on_histogram(name, value)
+    fs = _flight
+    if fs is not None:
+        fs.on_histogram(name, value)
 
 
 @contextlib.contextmanager
@@ -176,7 +212,8 @@ def span(name: str, **attrs):
     exit. Zero-cost (two global reads) when no sink is active."""
     rec = _active
     ls = _live
-    if rec is None and ls is None:
+    fs = _flight
+    if rec is None and ls is None and fs is None:
         yield
         return
     stack = _stack()
@@ -197,6 +234,8 @@ def span(name: str, **attrs):
             rec.histogram(f"span.{name}.s", dur)
         if ls is not None:
             ls.on_span(name, dur, parent, len(stack), attrs)
+        if fs is not None:
+            fs.on_span(name, dur, parent, len(stack), attrs)
 
 
 def record_span(name: str, seconds: float, parent: Optional[str] = None,
@@ -208,7 +247,8 @@ def record_span(name: str, seconds: float, parent: Optional[str] = None,
     ``with span(...)`` nesting."""
     rec = _active
     ls = _live
-    if rec is None and ls is None:
+    fs = _flight
+    if rec is None and ls is None and fs is None:
         return
     stack = _stack()
     if parent is None and stack:
@@ -219,3 +259,5 @@ def record_span(name: str, seconds: float, parent: Optional[str] = None,
         rec.histogram(f"span.{name}.s", float(seconds))
     if ls is not None:
         ls.on_span(name, float(seconds), parent, len(stack), attrs)
+    if fs is not None:
+        fs.on_span(name, float(seconds), parent, len(stack), attrs)

@@ -532,6 +532,46 @@ def _structure_lines(st: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def postmortem_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold ``postmortem`` capture events (gauss_tpu.obs.postmortem) and
+    ``flight`` recorder lifecycle events into one report: bundles captured
+    by cause, open-trace / in-flight counts at capture, and the last
+    bundle's path — the pointer ``gauss-debug`` starts from. Empty dict
+    when the run captured nothing — healthy runs carry no crash noise."""
+    caps = [ev for ev in events if ev.get("type") == "postmortem"]
+    fl = [ev for ev in events if ev.get("type") == "flight"]
+    if not caps:
+        return {}
+    by_cause: Dict[str, int] = {}
+    for ev in caps:
+        cause = str(ev.get("cause", "?"))
+        by_cause[cause] = by_cause.get(cause, 0) + 1
+    last = caps[-1]
+    return {
+        "bundles": len(caps),
+        "by_cause": by_cause,
+        "open_traces": sum(int(ev.get("open_traces", 0) or 0)
+                           for ev in caps),
+        "in_flight": sum(int(ev.get("in_flight", 0) or 0) for ev in caps),
+        "last_bundle": last.get("bundle"),
+        "last_cause": last.get("cause"),
+        "recording": bool(fl),
+    }
+
+
+def _postmortem_lines(pm: Dict[str, Any]) -> List[str]:
+    causes = ", ".join(f"{k} x{v}"
+                       for k, v in sorted(pm["by_cause"].items()))
+    lines = [f"  {pm['bundles']} bundle(s) captured"
+             + (f"  ({causes})" if causes else "")
+             + f"; {pm['in_flight']} request(s) in flight, "
+             f"{pm['open_traces']} open trace(s) at capture"]
+    if pm["last_bundle"]:
+        lines.append(f"  last: {pm['last_bundle']} "
+                     f"(cause={pm['last_cause']}; inspect with gauss-debug)")
+    return lines
+
+
 def fleet_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the fleet supervisor's events (``fleet``: launch / worker_dead /
     worker_stalled / restart / shrink / local_finish / done, plus worker-side
@@ -704,6 +744,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "structure": structure_summary(evs),
         "resilience": resilience_summary(evs),
         "sdc": sdc_summary(evs),
+        "postmortems": postmortem_summary(evs),
         "fleet": fleet_summary(evs),
         "tuning": tuning_summary(evs),
         "comms": comms_summary(evs),
@@ -786,6 +827,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("sdc (abft checksum detections):")
         out.extend(_sdc_lines(sdc))
+
+    pm = postmortem_summary(evs)
+    if pm:
+        out.append("")
+        out.append("post-mortems:")
+        out.extend(_postmortem_lines(pm))
 
     fleet = fleet_summary(evs)
     if fleet:

@@ -183,6 +183,23 @@ def render(view: _View, url: str,
                 f"{_fmt(burns.get((name, 'long')), 'x', 2)}, "
                 f"{_fmt(alerts.get(name, 0))} alert(s)")
 
+    # Flight recorder / post-mortem panel: the cause rides as a label on
+    # the age gauge (Prometheus values are numeric-only).
+    pm = view.labeled("gauss_postmortem_last_age_s")
+    if pm:
+        for labels, age in sorted(pm, key=lambda lv: lv[0].get("cause", "")):
+            lines.append(
+                f"  last post-mortem: {labels.get('cause', '?')} "
+                f"{_fmt(age, 's', 1)} ago "
+                f"({_fmt(g('gauss_postmortem_bundles_total', 0))} bundle(s) "
+                f"this process; inspect with gauss-debug)")
+    elif g("gauss_flight_recording"):
+        lines.append(
+            f"  flight recorder: on, ring at "
+            f"{_fmt(g('gauss_flight_ring_wpos'))}/"
+            f"{_fmt(g('gauss_flight_ring_capacity'))} bytes "
+            f"({_fmt(g('gauss_flight_ring_seq'))} records), no post-mortems")
+
     hearts = view.prefixed("gauss_fleet_w")
     if hearts:
         ages = ", ".join(

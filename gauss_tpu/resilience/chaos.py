@@ -291,7 +291,12 @@ def run_fleet_phase(seed: int, gate: float) -> Dict:
     at a seeded panel group. Invariant: the supervised job completes with a
     verified solution — bit-identical to the unfaulted supervised run — or
     a typed FleetError; never a hang (every wait is deadline-bounded)."""
+    import shutil
+    import tempfile
+
     from gauss_tpu import obs
+    from gauss_tpu.obs import debug as _gdebug
+    from gauss_tpu.obs import postmortem as _postmortem
     from gauss_tpu.resilience import fleet
 
     rng = np.random.default_rng(np.random.SeedSequence((seed, 0xF1EE7)))
@@ -305,9 +310,14 @@ def run_fleet_phase(seed: int, gate: float) -> Dict:
         group = 1 + int(rng.integers(0, 2))  # kill/stall at group 1 or 2
         for kind in ("kill", "stall"):
             case = {"kind": kind, "group": group}
+            # Caller-owned jobdir: solve_supervised leaves it in place, so
+            # the supervisor's post-mortem bundle (captured at detection)
+            # can be asserted on after the solve.
+            jobdir = tempfile.mkdtemp(prefix=f"gauss_chaos_fleet_{kind}_")
             try:
                 res = fleet.solve_supervised(
-                    a, b, inject=f"fleet.worker.group={kind}:skip={group}",
+                    a, b, jobdir=jobdir,
+                    inject=f"fleet.worker.group={kind}:skip={group}",
                     inject_worker=1, **kw)
                 case.update(
                     outcome="recovered" if res.recovered else "ok",
@@ -321,10 +331,22 @@ def run_fleet_phase(seed: int, gate: float) -> Dict:
             except Exception as e:  # noqa: BLE001 — an untyped escape IS the bug
                 case.update(outcome="violation",
                             error=f"{type(e).__name__}: {e}"[:200])
+            # Flight-recorder contract: every injected kill/stall must leave
+            # a post-mortem bundle that gauss-debug --check accepts. A fault
+            # the supervisor survived but did not bundle is a violation too.
+            bundle = _postmortem.latest_bundle(
+                _postmortem.default_bundles_dir(
+                    os.path.join(jobdir, "flight")))
+            case["bundle_check_rc"] = (
+                _gdebug.main([bundle, "--check"]) if bundle else None)
+            case["postmortem_ok"] = (bundle is not None
+                                     and case["bundle_check_rc"] == 0)
+            shutil.rmtree(jobdir, ignore_errors=True)
             cases.append(case)
     violations = sum(
         1 for c in cases
         if c["outcome"] == "violation"
+        or not c.get("postmortem_ok")
         or (c["outcome"] in ("ok", "recovered")
             and not c.get("bit_identical")))
     return {"ran": True, "cases": cases, "injected": len(cases),

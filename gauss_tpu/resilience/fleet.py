@@ -199,6 +199,13 @@ def _spawn_worker(jobdir: str, cfg: FleetConfig, wid: int, world: int,
     env[ENV_LEASE] = lease_path(jobdir, wid)
     env["GAUSS_OBS_RUN_ID"] = run_id
     env["GAUSS_WATCHDOG_S"] = str(cfg.barrier_deadline_s)
+    # Crash-surviving telemetry: every worker appends its obs events to an
+    # mmap flight ring under the jobdir, so when the supervisor detects it
+    # dead/stalled the final seconds are still on disk to bundle
+    # (gauss_tpu.obs.flight / obs.postmortem).
+    from gauss_tpu.obs import flight as _flight
+
+    env[_flight.ENV_VAR] = os.path.join(jobdir, "flight")
     if cfg.compile_cache_dir:
         # The warm-restart channel: workers (and their REPLACEMENTS) share
         # one persistent XLA compile cache, so a respawn resumes from
@@ -400,6 +407,24 @@ def _supervise(cfg: FleetConfig, jobdir: str, a64, b64):
         attempts[wid] = attempts.get(wid, 0) + 1
         return w
 
+    flight_dir = os.path.join(jobdir, "flight")
+
+    def capture(cause: str, w: _Worker, **detail) -> None:
+        # Freeze the failed worker's flight ring into a post-mortem bundle
+        # the moment the failure is detected — before a replacement spawns
+        # and telemetry moves on. Best-effort: diagnostics never take the
+        # supervised job down.
+        try:
+            from gauss_tpu.obs import postmortem as _postmortem
+
+            _postmortem.capture_bundle(
+                _postmortem.default_bundles_dir(flight_dir), cause,
+                flight_dir=flight_dir,
+                heartbeat_path=lease_path(jobdir, w.id),
+                extra={"worker": w.id, **detail})
+        except Exception:  # pragma: no cover
+            pass
+
     obs.emit("fleet", event="launch", workers=world, n=int(a64.shape[0]),
              chunk=cfg.chunk, jobdir=os.path.basename(jobdir))
     workers = [spawn(w) for w in range(world)]
@@ -462,6 +487,10 @@ def _supervise(cfg: FleetConfig, jobdir: str, a64, b64):
                                  stale_s=round(time.monotonic()
                                                - _last_activity(jobdir, w),
                                                3))
+                        capture("fleet_worker_stalled", w,
+                                stale_s=round(time.monotonic()
+                                              - _last_activity(jobdir, w),
+                                              3))
                         _kill_worker(w)
                         pending_detect.setdefault(w.id, time.monotonic())
                         replace.append(w)
@@ -473,6 +502,11 @@ def _supervise(cfg: FleetConfig, jobdir: str, a64, b64):
                 cause = {_inject.KILL_EXIT_CODE: "killed",
                          PEER_LOST_EXIT: "peer_lost",
                          CONFIG_EXIT: "config"}.get(rc, "crashed")
+                if cause != "peer_lost":
+                    # A peer_lost exit is a secondary casualty of a death
+                    # already bundled — bundling it too would storm one
+                    # bundle per surviving worker per fault.
+                    capture("fleet_worker_dead", w, rc=rc, exit_cause=cause)
                 if cause == "config":
                     raise FleetError(
                         f"worker {w.id} exited with a configuration/"
@@ -551,6 +585,12 @@ def _worker_main(args) -> int:
     # Join the supervisor's persistent compile cache when the env channel
     # names one (no-op — and no extra jax config — otherwise).
     _cc.enable_from_env()
+    # Flight recorder: when the supervisor handed us a flight dir, every
+    # obs event also lands in an mmap ring that survives kill -9 — the
+    # crash-telemetry the supervisor bundles on worker death/stall.
+    from gauss_tpu.obs import flight as _flight
+
+    _flight.install_from_env()
     jobdir = os.fspath(args.jobdir)
     wid, world = args.worker_id, args.num_workers
     a64 = np.load(os.path.join(jobdir, "a.npy"))

@@ -463,6 +463,17 @@ def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
                                    escalations=escalations,
                                    sdc=_sdc_info())
         escalations.append((rung, trigger))
+        if "SDC" in trigger:
+            # An SDCDetectedError escalating PAST its rung means repair
+            # failed — freeze the flight ring into a post-mortem bundle
+            # (no-op unless the serving process armed the trigger).
+            try:
+                from gauss_tpu.obs import postmortem as _postmortem
+
+                _postmortem.trigger("sdc_detected", rung=rung,
+                                    escalation=trigger)
+            except Exception:  # pragma: no cover — capture is best-effort
+                pass
         obs.counter("resilience.escalations")
         obs.emit("recovery", trigger=trigger, rung=rung, rung_index=i,
                  attempt=i + 1, outcome="escalate",

@@ -138,6 +138,23 @@ class ServeConfig:
     heartbeat_path: Optional[str] = None  # worker-loop liveness file for
     #                                       the supervisor (durable
     #                                       .supervise); None = off
+    # -- flight recorder (gauss_tpu.obs.flight / obs.postmortem) -----------
+    flight_dir: Optional[str] = None  # crash-surviving telemetry: install
+    #                                   the obs flight sink over an mmap
+    #                                   ring in this dir (recent events
+    #                                   survive kill -9, harvested into
+    #                                   post-mortem bundles under
+    #                                   <flight_dir>/bundles) and arm the
+    #                                   in-process capture triggers (SLO
+    #                                   firing, SDC escalation, unclean
+    #                                   resume). None (default) = recorder
+    #                                   off — the serve path is byte-
+    #                                   identical pre-flight behavior (one
+    #                                   is-None read per obs hook)
+    flight_ring_bytes: int = 1 << 20  # flight ring capacity in bytes
+    #                                   (fixed-size; oldest records are
+    #                                   overwritten — the ring holds the
+    #                                   final seconds, not the history)
     # -- mesh serving (gauss_tpu.serve.lanes) ------------------------------
     lanes: int = 0                  # dispatch lanes across the device mesh:
     #                                 0 (default) = the single-queue/

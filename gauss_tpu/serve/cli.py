@@ -65,7 +65,8 @@ def _run_supervised(args, argv) -> int:
     with obs.run(tool="gauss_serve_supervisor", journal=args.journal):
         return durable.supervise(
             child_argv, heartbeat_path=hb, max_restarts=args.max_restarts,
-            stall_after_s=args.stall_after)
+            stall_after_s=args.stall_after,
+            flight_dir=args.flight_dir, journal_dir=args.journal)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -182,6 +183,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-after", type=float, default=30.0, metavar="S",
                    help="supervised mode: heartbeat staleness that calls "
                         "a stall (default 30)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="crash-surviving flight recorder at DIR: every obs "
+                        "event also lands in an mmap ring that outlives "
+                        "kill -9, and dead/stalled/unclean-resume detection "
+                        "freezes it into a post-mortem bundle under "
+                        "DIR/bundles (inspect with gauss-debug; also "
+                        "honored from the GAUSS_FLIGHT_DIR env — how "
+                        "--supervised hands it to the child)")
     # -- live telemetry plane ---------------------------------------------
     p.add_argument("--live-port", type=int, default=None, metavar="PORT",
                    help="embed the live telemetry endpoint on PORT "
@@ -254,7 +263,9 @@ def main(argv=None) -> int:
         continuous_batching=args.continuous_batching,
         cb_window_s=args.cb_window, autoscale=args.autoscale,
         min_lanes=args.min_lanes,
-        heartbeat_path=os.environ.get("GAUSS_SERVE_HEARTBEAT") or None)
+        heartbeat_path=os.environ.get("GAUSS_SERVE_HEARTBEAT") or None,
+        flight_dir=(args.flight_dir
+                    or os.environ.get("GAUSS_FLIGHT_DIR") or None))
     cfg = LoadgenConfig(
         mix=args.mix, requests=args.requests, warmup=args.warmup,
         mode=args.mode, concurrency=args.concurrency, rate=args.rate,

@@ -450,6 +450,8 @@ def supervise(child_argv: List[str], *, heartbeat_path: str,
               max_restarts: int = 3, stall_after_s: float = 30.0,
               poll_s: float = 0.25, term_grace_s: float = 15.0,
               env: Optional[Dict[str, str]] = None,
+              flight_dir: Optional[str] = None,
+              journal_dir: Optional[str] = None,
               log=print) -> int:
     """Run ``child_argv`` under the PR-5 fleet watchdog pattern and restart
     it — against the same journal — when it dies or stalls.
@@ -469,9 +471,34 @@ def supervise(child_argv: List[str], *, heartbeat_path: str,
     persistent compile cache (pass ``--compile-cache``/GAUSS_COMPILE_CACHE
     through) makes it warm. SIGTERM to the supervisor forwards to the
     child for a graceful drain (clean-shutdown marker) before exiting.
+
+    With ``flight_dir`` set, every died/stalled detection ALSO harvests the
+    dead incarnation's flight ring into a post-mortem bundle
+    (``gauss_tpu.obs.postmortem``) BEFORE the restart overwrites the scene
+    — the child inherits the dir through ``GAUSS_FLIGHT_DIR`` so its serve
+    loop installs the ring sink without any extra flags.
     """
     base_env = dict(env if env is not None else os.environ)
     base_env["GAUSS_SERVE_HEARTBEAT"] = heartbeat_path
+    if flight_dir:
+        base_env["GAUSS_FLIGHT_DIR"] = os.fspath(flight_dir)
+
+    def _capture(cause: str, **detail) -> None:
+        """Supervisor-side post-mortem capture (owner of the
+        serve.server.batch / serve.journal.append crash sites when
+        supervised). Never raises — a capture failure must not cost the
+        restart."""
+        if not flight_dir:
+            return
+        try:
+            from gauss_tpu.obs import postmortem
+
+            postmortem.capture_bundle(
+                postmortem.default_bundles_dir(flight_dir), cause,
+                flight_dir=flight_dir, journal_dir=journal_dir,
+                heartbeat_path=heartbeat_path, extra=detail, log=log)
+        except Exception as e:  # pragma: no cover — capture is best-effort
+            log(f"supervise: post-mortem capture failed: {e}")
     restarts = 0
     draining = {"flag": False}
     child: Dict[str, Optional[subprocess.Popen]] = {"proc": None}
@@ -530,6 +557,8 @@ def supervise(child_argv: List[str], *, heartbeat_path: str,
                 obs.emit("serve_supervisor", event="drained", rc=rc)
                 return rc if rc is not None else 0
             cause = "stalled" if stalled else f"died rc={rc}"
+            _capture("supervisor_stall" if stalled else "supervisor_death",
+                     rc=rc, restarts=restarts, pid=proc.pid)
             if restarts >= max_restarts:
                 obs.emit("serve_supervisor", event="gave_up", cause=cause,
                          restarts=restarts)

@@ -310,6 +310,10 @@ def run_subprocess_legs(seed: int, gate: float, tmpdir: str,
              supervised: bool) -> Dict:
         jd = os.path.join(tmpdir, f"leg-{name}")
         ledger = os.path.join(tmpdir, f"leg-{name}.ledger")
+        # Every kill/stall leg records into a flight ring; the death (or
+        # the unclean resume after it) must leave a post-mortem bundle the
+        # leg asserts on — the flight recorder's own chaos coverage.
+        fdir = os.path.join(tmpdir, f"leg-{name}.flight")
         leg: Dict = {"leg": name}
         t0 = time.perf_counter()
         with obs.span(f"durable_leg_{name}"):
@@ -323,7 +327,8 @@ def run_subprocess_legs(seed: int, gate: float, tmpdir: str,
                 rc = durable.supervise(
                     _drive_argv(jd, ledger, requests, seed),
                     heartbeat_path=os.path.join(jd, "heartbeat.json"),
-                    max_restarts=2, stall_after_s=60.0, env=env, log=log)
+                    max_restarts=2, stall_after_s=60.0, env=env, log=log,
+                    flight_dir=fdir, journal_dir=jd)
                 leg["supervise_rc"] = rc
                 leg["restarts"] = ((rec.counters.get(
                     "serve.supervisor_restarts", 0) if rec else 0) - before)
@@ -332,8 +337,11 @@ def run_subprocess_legs(seed: int, gate: float, tmpdir: str,
                 killed = rc == 0 and leg["restarts"] >= 1
             else:
                 env = dict(env_base)
+                env["GAUSS_FLIGHT_DIR"] = fdir
                 if faults:
                     env["GAUSS_FAULTS"] = faults
+                env_resume = dict(env_base)
+                env_resume["GAUSS_FLIGHT_DIR"] = fdir
                 p1 = subprocess.run(_drive_argv(jd, ledger, requests, seed),
                                     env=env, cwd=_REPO, timeout=300,
                                     capture_output=True, text=True)
@@ -341,9 +349,11 @@ def run_subprocess_legs(seed: int, gate: float, tmpdir: str,
                 leg["first_rc"] = p1.returncode
                 if p1.returncode not in (0, KILL_EXIT_CODE):
                     leg["stderr"] = p1.stderr[-1500:]
-                # recovery run: no faults, no new requests — replay + drain
+                # recovery run: no faults, no new requests — replay + drain.
+                # Its start() finds the dead child's unterminated admits and
+                # captures the 'unclean_resume' bundle this leg asserts on.
                 p2 = subprocess.run(_drive_argv(jd, ledger, 0, seed),
-                                    env=env_base, cwd=_REPO, timeout=300,
+                                    env=env_resume, cwd=_REPO, timeout=300,
                                     capture_output=True, text=True)
                 leg["resume_rc"] = p2.returncode
                 if p2.returncode != 0:
@@ -359,12 +369,26 @@ def run_subprocess_legs(seed: int, gate: float, tmpdir: str,
                         leg["rerun"] = json.loads(line[6:])
         leg["killed"] = killed
         leg["audit"] = audit(jd, _read_ledger(ledger), gate)
+        # Post-mortem assertion: a bundle was captured for this leg's death
+        # and gauss-debug --check passes on it (integrity + exactly-one-
+        # cause). Judged by the CLI itself — the artifact an operator gets.
+        from gauss_tpu.obs import debug as _gdebug
+        from gauss_tpu.obs import postmortem as _postmortem
+
+        bundle = _postmortem.latest_bundle(
+            _postmortem.default_bundles_dir(fdir))
+        leg["bundle"] = bundle
+        leg["bundle_check_rc"] = (_gdebug.main([bundle, "--check"])
+                                  if bundle else None)
+        leg["postmortem_ok"] = bundle is not None \
+            and leg["bundle_check_rc"] == 0
         leg["wall_s"] = round(time.perf_counter() - t0, 3)
         a_ = leg["audit"]
         rerun = leg.get("rerun") or {}
         leg["outcome"] = (
             "violation" if (a_["missing"] or a_["duplicates"]
                             or a_["incorrect"] or not killed
+                            or not leg["postmortem_ok"]
                             or rerun.get("solved_fresh", 0) > 0)
             else "ok")
         return leg
@@ -465,7 +489,8 @@ def drive_main(args) -> int:
         (args.seed, 0xD21FE)))
     cfg = _case_config(args.journal, args.gate,
                        heartbeat_path=os.environ.get(
-                           "GAUSS_SERVE_HEARTBEAT") or None)
+                           "GAUSS_SERVE_HEARTBEAT") or None,
+                       flight_dir=os.environ.get("GAUSS_FLIGHT_DIR") or None)
     with obs.run(metrics_out=args.metrics_out, tool="durable_drive",
                  requests=args.requests, seed=args.seed):
         srv = SolverServer(cfg)
@@ -629,6 +654,7 @@ def main(argv=None) -> int:
               f"killed={leg['killed']} admitted={a_['admitted']} "
               f"missing={len(a_['missing'])} "
               f"duplicates={len(a_['duplicates'])} "
+              f"bundle={'ok' if leg.get('postmortem_ok') else 'MISSING'} "
               f"rerun={leg.get('rerun')}")
     if overhead:
         print(f"  overhead: journal-off {overhead['off']['s_per_request']}"

@@ -136,6 +136,9 @@ class SolverServer:
         self.live = None                  # obs.live.LiveAggregator
         self._live_server = None          # obs.export.LiveServer
         self._live_prev = None            # sink displaced by install()
+        #: the crash-surviving flight recorder (None until start() with a
+        #: flight_dir) — obs.flight.FlightSink
+        self._flight = None
         #: durable admission (None = journal off; the serve path is then
         #: byte-identical to the pre-journal behavior)
         self.journal = None               # serve.durable.RequestJournal
@@ -166,6 +169,8 @@ class SolverServer:
             return self
         if self.config.live_port is not None and self._live_server is None:
             self._start_live()
+        if self.config.flight_dir and self._flight is None:
+            self._start_flight()
         self._stop.clear()
         with self._depth_lock:
             self._closed = False
@@ -226,10 +231,46 @@ class SolverServer:
             self.live = None
             self._live_prev = None
 
+    def _start_flight(self) -> None:
+        """Bring up the crash-surviving flight recorder: the obs flight
+        sink writing every event into ``flight_dir``'s mmap ring, plus the
+        in-process post-mortem trigger (SLO firing / SDC escalation). Lazy
+        imports — a ``flight_dir=None`` server never loads (or pays for)
+        any of this, and its obs hot path is byte-identical pre-flight."""
+        from gauss_tpu.obs import flight as _flight_mod
+        from gauss_tpu.obs import postmortem as _postmortem
+
+        cfg = self.config
+        self._flight = _flight_mod.install(
+            cfg.flight_dir, ring_bytes=cfg.flight_ring_bytes)
+        _postmortem.install_trigger(
+            _postmortem.default_bundles_dir(cfg.flight_dir),
+            flight_dir=cfg.flight_dir, journal_dir=cfg.journal_dir,
+            heartbeat_path=cfg.heartbeat_path,
+            metrics_url=(self._live_server.url + "/metrics"
+                         if self._live_server else None))
+        obs.emit("flight", event="recording", dir=cfg.flight_dir,
+                 ring_bytes=cfg.flight_ring_bytes)
+
+    def _stop_flight(self) -> None:
+        if self._flight is not None:
+            from gauss_tpu.obs import flight as _flight_mod
+            from gauss_tpu.obs import postmortem as _postmortem
+
+            _postmortem.uninstall_trigger()
+            _flight_mod.uninstall()
+            self._flight = None
+
     @property
     def live_url(self) -> Optional[str]:
         """The live endpoint base URL (None when the plane is off)."""
         return self._live_server.url if self._live_server else None
+
+    @property
+    def flight_sink(self):
+        """The installed flight recorder sink (None when the plane is
+        off) — the /snapshot exposition reads its ring position here."""
+        return self._flight
 
     def lane_stats(self) -> Optional[dict]:
         """The mesh lane-set report (lanes/active/steals/cb_admits +
@@ -277,6 +318,23 @@ class SolverServer:
                                 "torn_dropped": st.torn_dropped}
             obs.emit("serve_resume", **self.last_resume)
             return
+        if self.config.flight_dir and st.live_admits():
+            # Crash detection at resume time: an unclean journal with
+            # unterminated admits means the previous incarnation died
+            # mid-flight — harvest its flight ring into a post-mortem
+            # bundle BEFORE replay traffic overwrites the scene.
+            try:
+                from gauss_tpu.obs import postmortem as _postmortem
+
+                _postmortem.capture_bundle(
+                    _postmortem.default_bundles_dir(self.config.flight_dir),
+                    "unclean_resume", flight_dir=self.config.flight_dir,
+                    journal_dir=self.config.journal_dir,
+                    heartbeat_path=self.config.heartbeat_path,
+                    extra={"live_admits": len(st.live_admits()),
+                           "torn_dropped": st.torn_dropped})
+            except Exception:  # noqa: BLE001 — capture never blocks recovery
+                obs.counter("postmortem.capture_errors")
         dec = self._durable.decode_array
         replayed = expired = 0
         now = time.time()
@@ -354,6 +412,15 @@ class SolverServer:
         if self.journal is not None:
             self.journal.abandon()
         self._stop_live()
+        if self._flight is not None:
+            from gauss_tpu.obs import postmortem as _postmortem
+            from gauss_tpu.obs import spans as _spans
+
+            # Dropped, not closed: a real kill writes no final sidecar —
+            # the ring is left exactly as the crash left it.
+            _spans.set_flight_sink(None)
+            _postmortem.uninstall_trigger()
+            self._flight = None
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop the worker; with ``drain`` (default) requests accepted
@@ -428,6 +495,7 @@ class SolverServer:
                 self.journal.append_shutdown()
             self.journal.close()
         self._stop_live()
+        self._stop_flight()
 
     def __enter__(self) -> "SolverServer":
         return self.start()

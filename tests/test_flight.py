@@ -1,0 +1,422 @@
+"""Flight-recorder tests: the mmap ring codec (roundtrip, wrap, torn
+tail, resync past damage, oversize drop), the third-sink install/restore
+contract and sidecar tracking, post-mortem bundle capture / integrity
+checking / throttled triggers, the unclean-resume capture a journaled
+``start()`` performs BEFORE replaying, crash-spanning trace folding
+(``fold_ring_events``), the /snapshot + Prometheus flight surfaces, and
+the ``gauss-debug`` CLI.
+
+All CPU (conftest pins the platform); the serving tests share one
+module-scoped executable cache so the batch executables compile once.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import debug as gdebug
+from gauss_tpu.obs import export as gexport
+from gauss_tpu.obs import flight, postmortem, requesttrace
+from gauss_tpu.obs import spans as _spans
+from gauss_tpu.serve import ServeConfig, SolverServer, durable
+from gauss_tpu.serve.cache import ExecutableCache
+
+GATE = 1e-4
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(64)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_flight_state():
+    """Flight sink and trigger are process-global: every test leaves them
+    exactly as it found them (None — the suite never runs flight-armed)."""
+    yield
+    flight.uninstall()
+    postmortem.uninstall_trigger()
+    assert _spans.flight_sink() is None
+
+
+def _payloads(n, tag="ev"):
+    return [json.dumps({"type": tag, "i": i}).encode() for i in range(n)]
+
+
+# -- ring codec -------------------------------------------------------------
+
+def test_ring_roundtrip_in_seq_order(tmp_path):
+    ring = flight.FlightRing(tmp_path / "r.ring",
+                             capacity=flight.MIN_RING_BYTES)
+    for p in _payloads(25):
+        assert ring.append(p)
+    ring.close()
+    events, stats = flight.scan(tmp_path / "r.ring")
+    assert [e["i"] for e in events] == list(range(25))
+    assert stats["records"] == 25
+    assert stats["torn_dropped"] == 0
+    assert stats["pid"] == os.getpid()
+
+
+def test_ring_wrap_keeps_newest_never_fabricates(tmp_path):
+    ring = flight.FlightRing(tmp_path / "r.ring",
+                             capacity=flight.MIN_RING_BYTES)
+    n = 400                                    # several laps of a 4 KiB ring
+    for p in _payloads(n):
+        assert ring.append(p)
+    assert ring.wpos > ring.capacity           # really wrapped
+    ring.close()
+    events, stats = flight.scan(tmp_path / "r.ring")
+    idx = [e["i"] for e in events]
+    assert idx, "a wrapped ring must retain its newest lap"
+    assert idx == sorted(idx)                  # seq order survives the lap
+    assert idx[-1] == n - 1                    # the newest record survives
+    assert set(idx) <= set(range(n))           # nothing fabricated
+    assert len(idx) < n                        # old laps were overwritten
+
+
+def test_ring_torn_tail_dropped_not_raised(tmp_path):
+    path = tmp_path / "r.ring"
+    ring = flight.FlightRing(path, capacity=flight.MIN_RING_BYTES)
+    for p in _payloads(10):
+        ring.append(p)
+    last_total = flight.RECORD_HEADER.size + len(_payloads(10)[-1])
+    ring.close()
+    blob = bytearray(path.read_bytes())
+    # Cut the kill into the LAST record's body: zero its final bytes.
+    start = flight.HEADER_SIZE + (ring.wpos % ring.capacity) - 3
+    blob[start:start + 3] = b"\0\0\0"
+    assert last_total > 3
+    path.write_bytes(bytes(blob))
+    events, stats = flight.scan(path)
+    assert [e["i"] for e in events] == list(range(9))
+    assert stats["torn_dropped"] >= 1
+
+
+def test_ring_scan_resyncs_past_mid_damage(tmp_path):
+    path = tmp_path / "r.ring"
+    ring = flight.FlightRing(path, capacity=flight.MIN_RING_BYTES)
+    sizes = []
+    for p in _payloads(12):
+        ring.append(p)
+        sizes.append(flight.RECORD_HEADER.size + len(p))
+    ring.close()
+    blob = bytearray(path.read_bytes())
+    # Garbage over record #5's body (marker left intact -> CRC fails and
+    # the scanner must resync to #6, not abort the lap).
+    off = flight.HEADER_SIZE + sum(sizes[:5]) + flight.RECORD_HEADER.size
+    blob[off:off + 4] = b"\x7f\x7f\x7f\x7f"
+    path.write_bytes(bytes(blob))
+    events, stats = flight.scan(path)
+    got = [e["i"] for e in events]
+    assert 5 not in got
+    assert set(range(12)) - set(got) == {5}
+    assert stats["torn_dropped"] >= 1
+
+
+def test_ring_oversize_payload_dropped_not_written(tmp_path):
+    ring = flight.FlightRing(tmp_path / "r.ring",
+                             capacity=flight.MIN_RING_BYTES)
+    big = json.dumps({"type": "big",
+                      "blob": "x" * (ring.capacity //
+                                     flight.OVERSIZE_DIVISOR)}).encode()
+    assert not ring.append(big)
+    assert ring.append(_payloads(1)[0])
+    assert ring.position()["dropped_oversize"] == 1
+    ring.close()
+    events, _ = flight.scan(tmp_path / "r.ring")
+    assert [e["type"] for e in events] == ["ev"]
+
+
+def test_ring_scan_tolerates_missing_and_garbage_files(tmp_path):
+    events, stats = flight.scan(tmp_path / "absent.ring")
+    assert events == [] and stats["records"] == 0
+    bad = tmp_path / "bad.ring"
+    bad.write_bytes(b"not a flight ring at all")
+    events, stats = flight.scan(bad)
+    assert events == [] and stats["records"] == 0
+
+
+def test_ring_min_capacity_enforced(tmp_path):
+    with pytest.raises(ValueError):
+        flight.FlightRing(tmp_path / "r.ring",
+                          capacity=flight.MIN_RING_BYTES - 1)
+
+
+# -- the third sink ---------------------------------------------------------
+
+def test_install_routes_obs_emits_uninstall_restores(tmp_path):
+    fdir = str(tmp_path / "f")
+    assert _spans.flight_sink() is None
+    sink = flight.install(fdir, ring_bytes=flight.MIN_RING_BYTES)
+    try:
+        assert _spans.flight_sink() is sink
+        # No recorder active: the ring still sees the emit (the whole
+        # point — the flight sink outlives/undercuts the recorder).
+        obs.emit("flight_test_marker", k=1)
+        obs.counter("flight.test_counter")
+    finally:
+        flight.uninstall()
+    assert _spans.flight_sink() is None
+    rings = flight.scan_dir(fdir)
+    assert len(rings) == 1
+    types = [e["type"] for e in rings[0]["events"]]
+    assert "flight_test_marker" in types
+    assert "counter" in types
+    sc = rings[0]["sidecar"]
+    assert sc is not None and sc["pid"] == os.getpid()
+    assert "env" in sc and "ring" in sc
+
+
+def test_install_from_env_channel(tmp_path):
+    assert flight.install_from_env({}) is None
+    fdir = str(tmp_path / "envf")
+    sink = flight.install_from_env({flight.ENV_VAR: fdir})
+    try:
+        assert sink is not None
+        assert os.path.exists(flight.ring_path(fdir))
+    finally:
+        flight.uninstall()
+
+
+def test_sidecar_tracks_active_traces_and_heartbeat(tmp_path):
+    fdir = str(tmp_path / "f")
+    sink = flight.FlightSink(fdir, ring_bytes=flight.MIN_RING_BYTES,
+                             sidecar_every_s=0.0)
+    sink.on_event("serve_admit", {"trace": "aa", "id": 1})
+    sink.on_event("serve_admit", {"trace": "bb", "id": 2})
+    sink.on_event("serve_batch", {"requests": 2, "traces": ["aa", "bb"]})
+    sink.on_event("serve_request", {"trace": "aa", "status": "ok"})
+    sink.close()
+    sc = flight.read_sidecar(flight.sidecar_path(fdir))
+    assert sc["active_traces"] == ["bb"]       # aa closed by its terminal
+    assert sc["last_heartbeat_unix"] is not None
+    assert sc["ring"]["seq"] == 4
+
+
+def test_flight_off_is_off(tmp_path):
+    """flight_dir=None: no sink installed, no ring files, /snapshot says
+    not recording — the byte-identical-off contract's observable half."""
+    assert ServeConfig().flight_dir is None
+    assert _spans.flight_sink() is None
+    assert gexport.flight_status() == {"recording": False}
+    assert flight.scan_dir(str(tmp_path)) == []
+
+
+# -- post-mortem bundles ----------------------------------------------------
+
+def _armed_ring(tmp_path, n_events=6):
+    fdir = str(tmp_path / "f")
+    sink = flight.FlightSink(fdir, ring_bytes=flight.MIN_RING_BYTES,
+                             sidecar_every_s=0.0)
+    sink.on_event("serve_admit", {"trace": "t1", "id": 1})
+    for i in range(n_events - 2):
+        sink.on_event("serve_batch", {"requests": 1, "traces": ["t1"],
+                                      "i": i})
+    sink.on_event("serve_admit", {"trace": "t2", "id": 2})
+    sink.close()
+    return fdir
+
+
+def test_capture_check_info_roundtrip(tmp_path):
+    fdir = _armed_ring(tmp_path)
+    bdir = postmortem.default_bundles_dir(fdir)
+    path = postmortem.capture_bundle(bdir, "manual", flight_dir=fdir,
+                                     extra={"why": "test"})
+    assert path is not None
+    assert postmortem.latest_bundle(bdir) == path
+    assert postmortem.list_bundles(bdir) == [path]
+    doc = postmortem.read_bundle(path)
+    assert postmortem.check_bundle(doc) == []
+    assert doc["cause"] == "manual"
+    assert doc["detail"] == {"why": "test"}
+    assert len(doc["flight"]["rings"]) == 1
+    open_ids = {t["trace"] for t in doc["open_traces"]}
+    assert {"t1", "t2"} <= open_ids
+    info = postmortem.bundle_info(path)
+    assert info["cause"] == "manual"
+    assert info["pid"] == os.getpid()
+    assert abs(info["time_unix"] - doc["time_unix"]) < 0.01
+
+
+def test_check_bundle_rejects_tampered_attribution(tmp_path):
+    fdir = _armed_ring(tmp_path)
+    path = postmortem.capture_bundle(
+        postmortem.default_bundles_dir(fdir), "manual", flight_dir=fdir)
+    doc = postmortem.read_bundle(path)
+    bad = dict(doc, cause="dog_ate_it")
+    assert any("unknown cause" in p for p in postmortem.check_bundle(bad))
+    plural = dict(doc)
+    plural["causes"] = ["manual", "slo_alert"]
+    assert any("exactly one cause" in p
+               for p in postmortem.check_bundle(plural))
+    noid = dict(doc, captured_by={})
+    assert any("captured_by.pid" in p for p in postmortem.check_bundle(noid))
+
+
+def test_trigger_throttles_per_cause_and_disarms(tmp_path):
+    fdir = _armed_ring(tmp_path)
+    bdir = postmortem.default_bundles_dir(fdir)
+    assert postmortem.trigger("manual") is None     # not armed yet
+    postmortem.install_trigger(bdir, flight_dir=fdir)
+    first = postmortem.trigger("manual", note="one")
+    assert first is not None
+    assert postmortem.trigger("manual", note="two") is None   # throttled
+    other = postmortem.trigger("slo_alert")         # per-CAUSE throttle
+    assert other is not None and other != first
+    postmortem.uninstall_trigger()
+    assert postmortem.trigger("manual") is None     # disarmed
+
+
+# -- unclean resume capture -------------------------------------------------
+
+def _stranded_journal(jd, n_live=3):
+    """A journal whose process died mid-work: admits with no terminals."""
+    jr = durable.RequestJournal(jd, fsync_batch=1, rotate_records=10_000)
+    rng = np.random.default_rng(258458)
+    for i in range(n_live):
+        a = rng.standard_normal((8, 8))
+        a[np.arange(8), np.arange(8)] += 8.0
+        jr.append_admit(id=i, request_id=f"r{i}", trace=f"t{i}", a=a,
+                        b=rng.standard_normal(8), was_vector=True,
+                        deadline_unix=None, dtype=None, structure=None)
+    jr.close()
+    return jr
+
+
+def test_unclean_resume_captures_bundle_before_replay(shared_cache,
+                                                      tmp_path):
+    jd = str(tmp_path / "j")
+    fdir = str(tmp_path / "f")
+    _stranded_journal(jd, n_live=3)
+    cfg = ServeConfig(ladder=(16,), max_batch=4, panel=16, refine_steps=1,
+                      verify_gate=GATE, journal_dir=jd,
+                      flight_dir=fdir,
+                      flight_ring_bytes=flight.MIN_RING_BYTES)
+    srv = SolverServer(cfg, cache=shared_cache).start()
+    try:
+        assert srv.last_resume["replayed"] == 3
+    finally:
+        srv.stop(drain=True, timeout=120.0)
+    assert _spans.flight_sink() is None        # stop() tore the sink down
+    bundle = postmortem.latest_bundle(postmortem.default_bundles_dir(fdir))
+    assert bundle is not None
+    doc = postmortem.read_bundle(bundle)
+    assert doc["cause"] == "unclean_resume"
+    assert postmortem.check_bundle(doc) == []
+    # Captured BEFORE replay: the bundle's journal tail still shows every
+    # stranded admit as live — the death, not the recovery.
+    live_ids = sorted(a["id"] for a in doc["journal"]["live_admits"])
+    assert live_ids == [0, 1, 2]
+    # ...and the admits carry NO operands (debugging artifact, not replay
+    # source).
+    assert all("a" not in a and "b" not in a
+               for a in doc["journal"]["live_admits"])
+    # The resume itself completed: every stranded admit reached a terminal.
+    st = durable.scan(jd)
+    assert sorted(st.terminals) == [0, 1, 2]
+    assert gdebug.main([bundle, "--check"]) == 0
+
+
+# -- crash-spanning trace folding -------------------------------------------
+
+def test_fold_ring_events_completes_crash_spanning_trace():
+    ring_events = [
+        {"type": "serve_admit", "trace": "tt", "id": 7, "n": 16,
+         "tu": 100.0},
+        {"type": "serve_batch", "traces": ["tt"], "requests": 1,
+         "tu": 100.5},
+        {"type": "gauge", "name": "serve.queue_depth", "value": 1.0,
+         "tu": 100.6},                         # non-stage ring noise
+    ]
+    stream = [
+        {"type": "serve_request", "trace": "tt", "id": 7, "status": "ok",
+         "latency_s": 0.2, "t": 101.0},
+    ]
+    folded = requesttrace.fold_ring_events(stream, ring_events)
+    assert [e["type"] for e in folded] == ["serve_admit", "serve_batch",
+                                          "serve_request"]
+    trees = requesttrace.request_traces(folded)
+    assert set(trees) == {"tt"}
+    assert requesttrace.check_traces(trees) == []
+    # Duplicates fold to one stage: both sinks saw the admit.
+    folded2 = requesttrace.fold_ring_events(
+        [dict(ring_events[0], t=100.0)] + stream, ring_events)
+    admits = [e for e in folded2 if e["type"] == "serve_admit"]
+    assert len(admits) == 1
+
+
+# -- /snapshot + Prometheus surfaces ----------------------------------------
+
+def test_flight_status_and_prometheus_surfaces(tmp_path):
+    fdir = str(tmp_path / "f")
+    flight.install(fdir, ring_bytes=flight.MIN_RING_BYTES)
+    try:
+        obs.emit("serve_batch", requests=1, traces=["t1"])
+        path = postmortem.capture_bundle(
+            postmortem.default_bundles_dir(fdir), "manual",
+            flight_dir=fdir)
+        assert path is not None
+        fl = gexport.flight_status()
+        assert fl["recording"] and fl["flight_dir"] == fdir
+        assert fl["ring"]["seq"] >= 1
+        assert fl["last_bundle"]["cause"] == "manual"
+        text = gexport.render_prometheus(
+            {"uptime_s": 1.0, "counters": {}, "gauges": {}, "windows": {}},
+            flight=fl)
+        assert "gauss_flight_recording 1" in text
+        assert 'gauss_postmortem_last_age_s{cause="manual"}' in text
+    finally:
+        flight.uninstall()
+
+
+# -- gauss-debug CLI --------------------------------------------------------
+
+def test_gauss_debug_reconstruct_and_cli(tmp_path, capsys):
+    fdir = _armed_ring(tmp_path, n_events=9)
+    bdir = postmortem.default_bundles_dir(fdir)
+    path = postmortem.capture_bundle(bdir, "manual", flight_dir=fdir)
+    doc = postmortem.read_bundle(path)
+    rec = gdebug.reconstruct(doc, batches=5)
+    assert rec["cause"] == "manual"
+    assert len(rec["last_batches"]) == 5       # last 5 of the 7 batches
+    assert all("t1" in (ev.get("traces") or ()) for ev in
+               rec["last_batches"])
+    # TARGET resolution: bundle file, bundles dir, flight dir all work.
+    for target in (path, bdir, fdir):
+        assert gdebug.resolve_bundle(target) == path
+    assert gdebug.main([path, "--check"]) == 0
+    capsys.readouterr()
+    assert gdebug.main([fdir, "--json", "--batches", "3"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["cause"] == "manual"
+    assert len(out["last_batches"]) == 3
+    # A tampered bundle fails --check with a named problem.
+    bad = dict(doc, cause="gremlins")
+    badpath = os.path.join(bdir, "bundle-0000000000001-gremlins-1.json")
+    with open(badpath, "w") as f:
+        json.dump(bad, f)
+    assert gdebug.main([badpath, "--check"]) == 1
+    assert "problem(s)" in capsys.readouterr().out
+    # Missing target exits 2.
+    assert gdebug.main([str(tmp_path / "nope.json")]) == 2
+
+
+def test_gauss_debug_manual_capture_flag(tmp_path, capsys):
+    fdir = _armed_ring(tmp_path)
+    assert gdebug.main([fdir, "--capture"]) == 0
+    capsys.readouterr()
+    bundle = postmortem.latest_bundle(postmortem.default_bundles_dir(fdir))
+    assert postmortem.bundle_info(bundle)["cause"] == "manual"
+    assert gdebug.main([bundle, "--check"]) == 0
+
+
+def test_debug_entry_point_registered():
+    with open(os.path.join(os.path.dirname(__file__), os.pardir,
+                           "pyproject.toml")) as f:
+        text = f.read()
+    assert 'gauss-debug = "gauss_tpu.obs.debug:main"' in text

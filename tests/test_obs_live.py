@@ -86,6 +86,47 @@ def test_rolling_window_horizon_eviction():
         obs_live.RollingWindow(capacity=0)
 
 
+def test_rolling_window_horizon_under_sparse_writes():
+    # Sparse traffic: the ring never fills, so stale samples are not
+    # overwritten — they must still age out at READ time, per-call, and a
+    # fresh burst must not resurrect them.
+    win = obs_live.RollingWindow(capacity=256, horizon_s=60.0)
+    win.add(1.0, t=0.0)
+    win.add(2.0, t=10.0)          # a quiet first minute
+    assert sorted(win.values(now=30.0)) == [1.0, 2.0]
+    win.add(3.0, t=500.0)         # then nothing for ~8 minutes
+    assert win.values(now=505.0) == [3.0]     # old pair aged out unwritten
+    assert win.values(now=600.0) == []        # everything stale
+    # all-time accounting is horizon-independent
+    assert win.count == 3 and win.total == 6.0
+    # a later burst only exposes in-horizon samples; the ring still holds
+    # the stale ones physically (len(_buf) == 7) but readers never see them
+    for i in range(4):
+        win.add(10.0 + i, t=1000.0 + i)
+    assert sorted(win.values(now=1003.0)) == [10.0, 11.0, 12.0, 13.0]
+    assert len(win._buf) == 7
+    # per-call horizon override widens the view without mutating state
+    assert len(win.values(now=1003.0, horizon_s=1500.0)) == 7
+    assert sorted(win.values(now=1003.0)) == [10.0, 11.0, 12.0, 13.0]
+
+
+def test_rolling_window_quantile_exact_at_capacity_boundary(rng):
+    cap = 64
+    for total in (cap - 1, cap, cap + 1, 3 * cap + 5):
+        win = obs_live.RollingWindow(capacity=cap, horizon_s=None)
+        vals = rng.standard_normal(total).tolist()
+        for v in vals:
+            win.add(v)
+        survivors = vals[-cap:]
+        assert len(win.values()) == min(total, cap)
+        got = win.quantiles((0.0, 0.5, 0.95, 0.99, 1.0))
+        for q, key in ((0.0, "p0"), (0.5, "p50"), (0.95, "p95"),
+                       (0.99, "p99"), (1.0, "p100")):
+            np.testing.assert_allclose(
+                got[key], np.quantile(survivors, q), rtol=1e-12,
+                err_msg=f"total={total} q={q}")
+
+
 def test_aggregator_counters_gauges_windows_and_rates():
     agg = obs_live.LiveAggregator()
     agg.on_counter("serve.served", 3)
