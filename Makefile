@@ -15,10 +15,12 @@ OUTOFCORE_SMOKE ?= /tmp/gauss_outofcore_check
 MESH_SMOKE ?= /tmp/gauss_mesh_serve_check
 LINT_SMOKE ?= /tmp/gauss_lint_check
 FLIGHT_SMOKE ?= /tmp/gauss_flight_check
+PROF_SMOKE ?= /tmp/gauss_prof_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
 	structure-check tune-check live-check abft-check durable-check \
-	outofcore-check mesh-serve-check lint-check flight-check clean
+	outofcore-check mesh-serve-check lint-check flight-check prof-check \
+	clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -355,6 +357,36 @@ flight-check:
 	k=s['kill']; assert k['cause'] == 'unclean_resume' and k['bundle_check_rc'] == 0, k; \
 	print('flight-check: bundle %s reconstructed %d batch(es), %d in flight' \
 	  % (k['bundle'].rsplit('/', 1)[-1], k['batches_reconstructed'], k['in_flight_at_death']))"
+
+# The profiling gate (CI-callable): the attribution plane's three
+# contracts on the CPU proxy. The reconcile leg serves a seeded mix with
+# ServeConfig.attr on and asserts the cost ledger closes: summed
+# per-request device-seconds plus warmup device-seconds must equal the
+# attribution matrix's serve-phase capacity within max(1 ms, 1%), every
+# result verified at the 1e-4 gate, and the roofline series must carry an
+# achieved-flops point for every engine the matrix observed. The
+# attribution leg forces a synthetic ratchet breach and requires the
+# span-tree diff against the best committed prior epoch to NAME the
+# guilty phase (headline_slope) — the auto-attribution path bench
+# --regress and regress check take on a real failure. The folds leg
+# round-trips the recorded stream through folded-stack serialization
+# (fold_lines(parse_folded(lines)) == lines) and asserts attr cells
+# landed on the stream. The run's s-per-request metrics append to
+# reports/history.jsonl (kind: prof, 3 committed epochs) and are
+# regress-gated. Timing-gated: honor the serial-ordering note above.
+prof-check:
+	rm -rf $(PROF_SMOKE) && mkdir -p $(PROF_SMOKE)
+	timeout -k 10 420 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.obs.profcheck --seed 258458 --tmpdir $(PROF_SMOKE) \
+	  --metrics-out $(PROF_SMOKE)/prof.jsonl \
+	  --summary-json $(PROF_SMOKE)/summary.json --regress-check
+	$(PYTHON) -c "import json; s=json.load(open('$(PROF_SMOKE)/summary.json')); \
+	assert s['invariant_ok'] and s['kind'] == 'prof_check', s; \
+	r=s['reconcile']; a=s['attribution']; \
+	assert a['named_phase'] == 'headline_slope', a; \
+	print('prof-check: reconcile %.6f s vs matrix %.6f s (tol %.6f s); named phase: %s' \
+	  % (r['request_device_s'], r['matrix_device_s'], r['tolerance_s'], \
+	     a['named_phase']))"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

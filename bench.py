@@ -57,6 +57,40 @@ def best_prior_headline() -> float | None:
     return best
 
 
+def best_prior_record() -> dict | None:
+    """The full best-headline committed BENCH_r*.json record (the round
+    behind :func:`best_prior_headline`'s value), preferring one that
+    carries a ``phases_s`` breakdown — the prior side of the auto-
+    attribution diff a failed ``--regress`` gate prints. None when no
+    record parses."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = best_phased = None
+
+    def _value(doc):
+        v = (doc.get("parsed") or doc).get("value")
+        return v if isinstance(v, (int, float)) and v > 0 else None
+
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        v = _value(doc) if isinstance(doc, dict) else None
+        if v is None:
+            continue
+        doc = dict(doc.get("parsed") or doc, _path=os.path.basename(path))
+        if best is None or v < _value(best):
+            best = doc
+        if isinstance(doc.get("phases_s"), dict) and (
+                best_phased is None or v < _value(best_phased)):
+            best_phased = doc
+    return best_phased or best
+
+
 def main(metrics_out: str | None = None, tuned: bool = False,
          tune_compare: bool = False) -> dict:
     from gauss_tpu import obs
@@ -302,4 +336,27 @@ if __name__ == "__main__":
                 verdicts.append(refined_ratchet)
         print(regress.format_verdicts(verdicts), file=sys.stderr)
         if any(v["status"] == "out-of-band" for v in verdicts):
+            # Auto-attribution (obs.doctor): before failing, diff this
+            # run's phase breakdown against the best committed prior
+            # epoch's and NAME the guilty phase — the triage the r3->r4
+            # swing needed a manual bisection for. Prior records without
+            # phases_s (pre-attribution rounds) degrade to printing the
+            # fresh breakdown alone.
+            prior = best_prior_record() or {}
+            attribution = regress.attribute_phases(
+                record.get("phases_s") or {}, prior.get("phases_s") or {},
+                fresh_label="this run",
+                prior_label=prior.get("_path", "best-prior"))
+            if attribution:
+                print("bench: gate FAILED — phase attribution vs "
+                      f"{prior.get('_path', 'best prior')}:",
+                      file=sys.stderr)
+                print(attribution, file=sys.stderr)
+            elif record.get("phases_s"):
+                phases = sorted(record["phases_s"].items(),
+                                key=lambda kv: -kv[1])
+                print("bench: gate FAILED — best prior record has no "
+                      "phases_s to diff against; this run's phases: "
+                      + ", ".join(f"{k}={v:.6f}s" for k, v in phases),
+                      file=sys.stderr)
             sys.exit(1)

@@ -53,11 +53,14 @@ from gauss_tpu.obs.spans import (  # noqa: F401
     trace_context,
 )
 
-# NOTE: gauss_tpu.obs.summarize, .doctor, .requesttrace, and .top are
-# deliberately NOT imported here — they are `python -m` entry points, and
-# importing them from the package __init__ would trip runpy's double-import
-# warning. The live plane (obs.live / obs.slo / obs.export) is imported
-# lazily by its users (SolverServer --live-port, gauss-fleet --live-port)
-# so unobserved processes never pay for it; likewise the flight recorder
-# (obs.flight / obs.postmortem) — installed only when a flight_dir is
-# configured, so the crash ring costs nothing where it isn't wanted.
+# NOTE: gauss_tpu.obs.summarize, .doctor, .requesttrace, .top, .prof, and
+# .profcheck are deliberately NOT imported here — they are `python -m`
+# entry points, and importing them from the package __init__ would trip
+# runpy's double-import warning. The live plane (obs.live / obs.slo /
+# obs.export) is imported lazily by its users (SolverServer --live-port,
+# gauss-fleet --live-port) so unobserved processes never pay for it;
+# likewise the flight recorder (obs.flight / obs.postmortem) — installed
+# only when a flight_dir is configured — and the attribution plane
+# (obs.attr) — installed only by ServeConfig(attr=True), its call sites
+# one `is None` read when off — so the crash ring and the cost matrix
+# cost nothing where they aren't wanted.

@@ -1,0 +1,394 @@
+"""Device-time attribution: the per-(phase, executable, lane) cost matrix.
+
+The bench harness has always timed device work honestly (block-until-ready
+spans); serving and streaming paid the same discipline but nothing ever
+AGGREGATED those measurements by *what executable* ran *where*, or joined
+them against the compile-time FLOP/byte budgets ``obs.compile`` computes.
+This module is that join: an :class:`AttributionMatrix` installed as a
+process-global (the same one-``is None``-read contract the recorder / live
+/ flight sinks follow — see ``obs.spans``), fed by the dispatch call sites
+(``serve.server``, ``outofcore.stream``), maintaining
+
+- **cells** keyed ``(phase, executable, lane)`` — device-seconds, calls,
+  requests, and the executable's FLOP/byte budget;
+- **roofline rows** per engine — achieved FLOP/s and bytes/s against the
+  calibrated :class:`Peaks`, plus the stall fraction (measured where the
+  engine has a ledger — out-of-core — and derived as idle fraction where
+  it does not);
+- a **capacity model** per compat-sig (``serve.lanes.compat_sig``'s
+  bucket/dtype/structure identity): device-seconds per request and the
+  estimated sustainable requests/s, which is what the serving tier needs
+  to route and autoscale on something better than drain-rate EWMAs.
+
+Every ``observe`` also emits an ``attr`` obs event plus ``util.*`` gauges
+and windows through the normal hooks, so the live aggregator / Prometheus
+exposition (``gauss_util_*``), the flight ring, and recorded streams all
+carry the same series with no second instrumentation path.
+
+**Honest-measurement caveats** (docs/OBSERVABILITY.md "Attribution &
+roofline"): spans measure host wall-clock around blocked device work, so
+attribution includes dispatch overhead; :func:`calibrate_peaks` measures a
+CPU-proxy ceiling (a small matmul / memcopy) unless GAUSS_PEAK_FLOPS /
+GAUSS_PEAK_BYTES override it with datasheet numbers — utilization
+fractions are honest relative to the *measured* ceiling of this host, not
+a TPU roofline, until run on real hardware.
+
+Everything no-ops (one module-global ``is None`` read) when no matrix is
+installed, and never raises: attribution must not take down a solve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from gauss_tpu.obs import spans as _spans
+
+#: process-global matrix (same handover discipline as the live/flight
+#: sinks: swap under a lock, call sites do one unlocked read).
+_state_lock = threading.Lock()
+_active: Optional["AttributionMatrix"] = None
+
+
+def active() -> Optional["AttributionMatrix"]:
+    """The installed attribution matrix (None -> attribution no-ops)."""
+    return _active
+
+
+def install(matrix: Optional["AttributionMatrix"]):
+    """Install ``matrix`` as the process attribution matrix; returns the
+    previous one so callers can restore it (the server's start/stop
+    pair). ``None`` uninstalls."""
+    global _active
+    with _state_lock:
+        prev = _active
+        _active = matrix
+    return prev
+
+
+def uninstall(previous: Optional["AttributionMatrix"] = None) -> None:
+    """Restore ``previous`` (default: uninstall entirely)."""
+    install(previous)
+
+
+# -- hardware ceiling -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Peaks:
+    """The roofline ceiling utilization is measured against.
+
+    ``source`` records where the numbers came from: ``"env"`` (the
+    GAUSS_PEAK_FLOPS / GAUSS_PEAK_BYTES overrides — use these to pin
+    datasheet numbers on real hardware) or ``"measured"`` (the CPU-proxy
+    microbenchmark below)."""
+
+    flops_per_s: float
+    bytes_per_s: float
+    source: str = "measured"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"flops_per_s": round(self.flops_per_s, 3),
+                "bytes_per_s": round(self.bytes_per_s, 3),
+                "source": self.source}
+
+
+_peaks_cache: Optional[Peaks] = None
+_peaks_lock = threading.Lock()
+
+
+def calibrate_peaks(n: int = 192, repeats: int = 3,
+                    refresh: bool = False) -> Peaks:
+    """Measure (once per process) the ceiling the roofline divides by.
+
+    Env overrides win: GAUSS_PEAK_FLOPS / GAUSS_PEAK_BYTES (floats,
+    units FLOP/s and bytes/s). Otherwise a small f32 matmul (BLAS — the
+    densest compute this host exposes to numpy) and a buffer copy give a
+    measured, honest-for-this-host proxy; on a TPU runtime the overrides
+    are how datasheet peaks are pinned. Never raises — a calibration
+    failure degrades to a 1.0 ceiling (utilization then reads as raw
+    achieved FLOP/s, still monotonic and comparable run-to-run)."""
+    global _peaks_cache
+    env_f = os.environ.get("GAUSS_PEAK_FLOPS")
+    env_b = os.environ.get("GAUSS_PEAK_BYTES")
+    if env_f or env_b:
+        try:
+            return Peaks(flops_per_s=float(env_f or 0) or 1.0,
+                         bytes_per_s=float(env_b or 0) or 1.0,
+                         source="env")
+        except ValueError:
+            pass
+    with _peaks_lock:
+        if _peaks_cache is not None and not refresh:
+            return _peaks_cache
+        try:
+            import numpy as np
+
+            a = np.ones((n, n), dtype=np.float32)
+            b = np.ones((n, n), dtype=np.float32)
+            a @ b  # warm the BLAS path outside the timed window
+            best = float("inf")
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                a @ b
+                best = min(best, time.perf_counter() - t0)
+            flops = 2.0 * n * n * n / max(best, 1e-9)
+            buf = np.ones(4 << 20, dtype=np.uint8)
+            best_b = float("inf")
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                buf.copy()
+                best_b = min(best_b, time.perf_counter() - t0)
+            # A copy reads + writes the buffer once each.
+            bps = 2.0 * buf.nbytes / max(best_b, 1e-9)
+            _peaks_cache = Peaks(flops_per_s=flops, bytes_per_s=bps)
+        except Exception:  # noqa: BLE001 — calibration must not block serving
+            _peaks_cache = Peaks(flops_per_s=1.0, bytes_per_s=1.0,
+                                 source="fallback")
+        return _peaks_cache
+
+
+def lu_flop_budget(n: int, nrhs: int, batch: int = 1,
+                   refine_steps: int = 0) -> float:
+    """Analytic FLOP budget for one batched LU factor+solve dispatch —
+    the fallback when XLA's ``cost_analysis`` is unavailable for an
+    executable (so roofline rows exist for every engine exercised, never
+    silently missing). (2/3)n^3 factor + 2n^2·nrhs triangular solves per
+    refinement round, per batch member."""
+    per = (2.0 / 3.0) * n ** 3 + 2.0 * n * n * nrhs * (1 + refine_steps)
+    return per * max(1, batch)
+
+
+def lu_byte_budget(n: int, nrhs: int, batch: int = 1, itemsize: int = 4,
+                   refine_steps: int = 0) -> float:
+    """Analytic bytes-touched budget (matrix + rhs, once per refinement
+    round plus the factor pass) — same fallback role as
+    :func:`lu_flop_budget`."""
+    per = (n * n + n * nrhs) * itemsize * (2 + refine_steps)
+    return float(per * max(1, batch))
+
+
+# -- the matrix -------------------------------------------------------------
+
+class AttributionMatrix:
+    """Thread-safe per-(phase, executable, lane) device-time accounting.
+
+    One lock around plain dict updates (the live-aggregator discipline);
+    ``observe`` is the single write path and additionally forwards the
+    measurement as an ``attr`` event + ``util.*`` gauges/windows through
+    the obs hooks, so every installed sink sees the same series."""
+
+    def __init__(self, peaks: Optional[Peaks] = None):
+        self.peaks = peaks if peaks is not None else calibrate_peaks()
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._cells: Dict[tuple, Dict[str, Any]] = {}   # guarded by: self._lock
+        self._engines: Dict[str, Dict[str, Any]] = {}   # guarded by: self._lock
+        self._lanes: Dict[int, Dict[str, Any]] = {}     # guarded by: self._lock
+        self._sigs: Dict[str, Dict[str, Any]] = {}      # guarded by: self._lock
+        self.observes = 0                               # guarded by: self._lock
+
+    # -- write path -------------------------------------------------------
+
+    def observe(self, phase: str, exe: str, seconds: float, *,
+                engine: str = "blocked", lane: int = 0, requests: int = 1,
+                flops: Optional[float] = None,
+                bytes_accessed: Optional[float] = None,
+                compile_s: float = 0.0, sig: Optional[str] = None,
+                stall_frac: Optional[float] = None) -> None:
+        """Fold one completed dispatch into the matrix.
+
+        ``seconds`` is the blocked (device-complete) wall of the dispatch;
+        ``flops``/``bytes_accessed`` its compile-time budget
+        (``obs.compile.cost_summary`` numbers, or the analytic fallback);
+        ``compile_s`` any compile/cache-get wall paid to obtain the
+        executable; ``sig`` the serving compat-sig the capacity model
+        aggregates under; ``stall_frac`` a ledger-measured stall fraction
+        (out-of-core) overriding the derived idle fraction."""
+        seconds = float(seconds)
+        now = time.perf_counter()
+        with self._lock:
+            self.observes += 1
+            cell = self._cells.setdefault(
+                (phase, exe, lane),
+                {"phase": phase, "exe": exe, "lane": lane, "engine": engine,
+                 "seconds": 0.0, "calls": 0, "requests": 0, "flops": 0.0,
+                 "bytes": 0.0, "compile_s": 0.0})
+            cell["seconds"] += seconds
+            cell["calls"] += 1
+            cell["requests"] += int(requests)
+            cell["compile_s"] += float(compile_s)
+            if flops:
+                cell["flops"] += float(flops)
+            if bytes_accessed:
+                cell["bytes"] += float(bytes_accessed)
+            eng = self._engines.setdefault(
+                engine, {"seconds": 0.0, "calls": 0, "flops": 0.0,
+                         "bytes": 0.0, "stall_s": 0.0, "stall_w": 0.0})
+            eng["seconds"] += seconds
+            eng["calls"] += 1
+            if flops:
+                eng["flops"] += float(flops)
+            if bytes_accessed:
+                eng["bytes"] += float(bytes_accessed)
+            if stall_frac is not None:
+                # seconds-weighted mean of ledger-measured stalls
+                eng["stall_s"] += float(stall_frac) * seconds
+                eng["stall_w"] += seconds
+            ln = self._lanes.setdefault(
+                lane, {"device_s": 0.0, "calls": 0, "requests": 0,
+                       "flops": 0.0})
+            ln["device_s"] += seconds
+            ln["calls"] += 1
+            ln["requests"] += int(requests)
+            if flops:
+                ln["flops"] += float(flops)
+            if sig:
+                sg = self._sigs.setdefault(
+                    sig, {"requests": 0, "device_s": 0.0, "compile_s": 0.0})
+                sg["requests"] += int(requests)
+                sg["device_s"] += seconds
+                sg["compile_s"] += float(compile_s)
+            elapsed = max(now - self._t0, 1e-9)
+            lane_rate = ln["device_s"] / elapsed
+            lane_flops = (ln["flops"] / max(ln["device_s"], 1e-9)
+                          if ln["flops"] else None)
+            eng_flops = (eng["flops"] / max(eng["seconds"], 1e-9)
+                         if eng["flops"] else None)
+        # Forward OUTSIDE the lock: the obs hooks take the live sink's own
+        # lock; holding ours across theirs would nest two sink locks.
+        _spans.emit("attr", phase=phase, exe=exe, engine=engine, lane=lane,
+                    seconds=round(seconds, 6), requests=int(requests),
+                    **({"flops": round(float(flops), 3)} if flops else {}),
+                    **({"bytes": round(float(bytes_accessed), 3)}
+                       if bytes_accessed else {}),
+                    **({"compile_s": round(float(compile_s), 6)}
+                       if compile_s else {}),
+                    **({"stall_frac": round(float(stall_frac), 4)}
+                       if stall_frac is not None else {}),
+                    **({"sig": sig} if sig else {}))
+        _spans.histogram("util.exec_s", seconds)
+        _spans.gauge(f"util.lane{lane}.device_s_per_s", round(lane_rate, 6))
+        _spans.gauge(f"util.lane{lane}.stall_frac",
+                     round(max(0.0, 1.0 - min(lane_rate, 1.0)), 4))
+        if lane_flops is not None:
+            _spans.gauge(f"util.lane{lane}.achieved_flops_per_s",
+                         round(lane_flops, 3))
+            _spans.gauge(
+                f"util.lane{lane}.flops_frac",
+                round(lane_flops / max(self.peaks.flops_per_s, 1e-9), 6))
+        if eng_flops is not None:
+            _spans.gauge(f"util.{engine}.achieved_flops_per_s",
+                         round(eng_flops, 3))
+            _spans.gauge(
+                f"util.{engine}.flops_frac",
+                round(eng_flops / max(self.peaks.flops_per_s, 1e-9), 6))
+
+    # -- read path --------------------------------------------------------
+
+    def engine_names(self) -> list:
+        """The engines this matrix has attributed time to so far."""
+        with self._lock:
+            return list(self._engines)
+
+    def roofline(self) -> Dict[str, Dict[str, Any]]:
+        """Per-engine achieved-vs-peak rows (the roofline series)."""
+        with self._lock:
+            engines = {k: dict(v) for k, v in self._engines.items()}
+        out: Dict[str, Dict[str, Any]] = {}
+        for engine, e in engines.items():
+            secs = max(e["seconds"], 1e-9)
+            row: Dict[str, Any] = {
+                "device_s": round(e["seconds"], 6),
+                "calls": e["calls"],
+            }
+            if e["flops"]:
+                achieved = e["flops"] / secs
+                row["achieved_flops_per_s"] = round(achieved, 3)
+                row["flops_frac"] = round(
+                    achieved / max(self.peaks.flops_per_s, 1e-9), 6)
+            if e["bytes"]:
+                bps = e["bytes"] / secs
+                row["achieved_bytes_per_s"] = round(bps, 3)
+                row["bytes_frac"] = round(
+                    bps / max(self.peaks.bytes_per_s, 1e-9), 6)
+            if e["stall_w"] > 0:
+                row["stall_frac"] = round(e["stall_s"] / e["stall_w"], 4)
+            out[engine] = row
+        return out
+
+    def capacity(self) -> Dict[str, Any]:
+        """The per-compat-sig / per-lane capacity model: device-seconds per
+        request and the sustainable requests/s each sig implies — what the
+        serving tier routes/bills/autoscales on."""
+        elapsed = max(time.perf_counter() - self._t0, 1e-9)
+        with self._lock:
+            sigs = {k: dict(v) for k, v in self._sigs.items()}
+            lanes = {k: dict(v) for k, v in self._lanes.items()}
+            serve_device_s = sum(
+                c["seconds"] for c in self._cells.values()
+                if c["phase"].startswith("serve"))
+        sig_rows = {}
+        for sig, s in sigs.items():
+            per_req = s["device_s"] / max(s["requests"], 1)
+            sig_rows[sig] = {
+                "requests": s["requests"],
+                "device_s": round(s["device_s"], 6),
+                "compile_s": round(s["compile_s"], 6),
+                "device_s_per_request": round(per_req, 6),
+                "est_requests_per_s": round(1.0 / max(per_req, 1e-9), 3),
+            }
+        lane_rows = {}
+        for lane, ln in lanes.items():
+            lane_rows[str(lane)] = {
+                "device_s": round(ln["device_s"], 6),
+                "requests": ln["requests"],
+                "device_s_per_s": round(ln["device_s"] / elapsed, 6),
+                "stall_frac": round(
+                    max(0.0, 1.0 - min(ln["device_s"] / elapsed, 1.0)), 4),
+            }
+        return {"serve_device_s": round(serve_device_s, 6),
+                "sigs": sig_rows, "lanes": lane_rows}
+
+    def top_cells(self, n: int = 10) -> list:
+        """The top-N cells by device-seconds (the hot-executable table)."""
+        with self._lock:
+            cells = [dict(c) for c in self._cells.values()]
+        cells.sort(key=lambda c: -c["seconds"])
+        for c in cells:
+            for k in ("seconds", "compile_s"):
+                c[k] = round(c[k], 6)
+            for k in ("flops", "bytes"):
+                c[k] = round(c[k], 3)
+        return cells[:n]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /snapshot ``attr`` section: cells, roofline, capacity,
+        peaks. Everything a scrape needs to render the utilization story
+        without touching the matrix internals."""
+        with self._lock:
+            device_s = sum(c["seconds"] for c in self._cells.values())
+            observes = self.observes
+        return {
+            "uptime_s": round(time.perf_counter() - self._t0, 3),
+            "observes": observes,
+            "device_s_total": round(device_s, 6),
+            "peaks": self.peaks.to_dict(),
+            "cells": self.top_cells(32),
+            "roofline": self.roofline(),
+            "capacity": self.capacity(),
+        }
+
+
+def status() -> Dict[str, Any]:
+    """The exposition-facing view (mirrors ``export.flight_status``):
+    ``{"recording": False}`` when no matrix is installed, otherwise the
+    matrix snapshot under ``recording: True``."""
+    mat = _active
+    if mat is None:
+        return {"recording": False}
+    out: Dict[str, Any] = {"recording": True}
+    out.update(mat.snapshot())
+    return out

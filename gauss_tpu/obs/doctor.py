@@ -61,6 +61,24 @@ def load_profile(target: str) -> Dict[str, Any]:
             "profile": prof}
 
 
+def profile_from_phases(phases: Dict[str, float], path: str = "<phases>",
+                        tool: Optional[str] = None) -> Dict[str, Any]:
+    """Adapt a flat ``{phase: seconds}`` map (e.g. a bench record's
+    ``phases_s``) into the :func:`load_profile` shape so it can ride
+    :func:`diff_profiles` / :func:`format_diff` — the auto-attribution
+    path ``bench --regress`` takes when a ratchet fails: diff the fresh
+    record's phases against the best committed prior epoch's and name the
+    guilty phase, no recorded span stream required."""
+    ph = {str(k): {"seconds": float(v), "calls": 1}
+          for k, v in (phases or {}).items()
+          if isinstance(v, (int, float))}
+    return {"path": path, "run": None, "tool": tool,
+            "profile": {"phases": ph,
+                        "span_total_s": sum(e["seconds"]
+                                            for e in ph.values()),
+                        "wall_s": None, "lanes": {}}}
+
+
 def diff_profiles(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     """The span-tree diff document (the ``--json`` payload and the text
     renderer's single source). Phases sorted by delta descending — the

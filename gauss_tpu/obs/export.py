@@ -71,6 +71,19 @@ def flight_status() -> Dict[str, Any]:
     return out
 
 
+def attr_status() -> Dict[str, Any]:
+    """The attribution-plane section of ``/snapshot``: whether a matrix is
+    installed and its live snapshot (peaks, roofline, capacity, top
+    cells). ``{"recording": False}`` when the plane is off — gauss-prof
+    ``--url`` reads this to say so instead of printing empty tables."""
+    from gauss_tpu.obs import attr as _attr
+
+    try:
+        return _attr.status()
+    except Exception:  # pragma: no cover — a scrape never takes serving down
+        return {"recording": False}
+
+
 def metric_name(name: str, prefix: str = "gauss") -> str:
     """Flatten a dotted obs name into a legal Prometheus metric name."""
     flat = _NAME_RE.sub("_", name.strip("."))
@@ -226,6 +239,7 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/snapshot":
             snap = agg.snapshot()
             snap["flight"] = flight_status()
+            snap["attr"] = attr_status()
             self._json(200, snap)
         elif url.path == "/trace":
             self._trace(parse_qs(url.query))

@@ -1,0 +1,349 @@
+"""gauss-prof: flamegraphs, top-executable tables, and roofline reports.
+
+The read side of the attribution plane (``gauss_tpu.obs.attr``): render
+WHERE the device time went from any recorded stream — or a live scrape —
+without re-running anything.
+
+``gauss-prof PATH[:run]`` — top-N table + per-engine roofline from a
+recorded metrics JSONL (the ``attr`` events ``AttributionMatrix.observe``
+emitted, falling back to plain spans when a stream predates the plane).
+
+``gauss-prof --url http://HOST:PORT`` — the same tables from a running
+server's ``/snapshot`` exposition (``obs.export``), no file needed.
+
+``--folded out.folded`` — write folded-stack lines (``a;b;c <usec>``, the
+flamegraph.pl / speedscope interchange format) reconstructed from the span
+events' parent chains, with self-time attribution so a rendered flamegraph
+sums to the measured wall, not a double-counted tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from gauss_tpu.obs import attr as _attr
+from gauss_tpu.obs import registry
+
+
+# -- folded stacks ----------------------------------------------------------
+
+def folded_stacks(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Reconstruct folded call stacks (``root;child;leaf`` -> seconds of
+    SELF time) from span events.
+
+    Span events carry ``name``/``parent``/``dur_s``; the full ancestry is
+    rebuilt by chasing parent names through the last-seen parent map (span
+    names are stable phase labels, so the chain is well-defined for the
+    streams this repo records; a cycle or depth blowup is cut off rather
+    than trusted). Parents then have each child's total subtracted, so
+    every frame carries self time and the folded file sums to the span
+    total — the flamegraph convention."""
+    parents: Dict[str, Optional[str]] = {}
+    spans = []
+    for ev in events:
+        if ev.get("type") != "span":
+            continue
+        name = ev.get("name")
+        if not name:
+            continue
+        par = ev.get("parent")
+        if par:
+            parents[name] = par
+        spans.append(ev)
+    totals: Dict[str, float] = {}
+    for ev in spans:
+        name = ev["name"]
+        path = [name]
+        seen = {name}
+        cur = ev.get("parent")
+        while cur and cur not in seen and len(path) < 64:
+            path.append(cur)
+            seen.add(cur)
+            cur = parents.get(cur)
+        stack = ";".join(reversed(path))
+        totals[stack] = totals.get(stack, 0.0) + float(ev.get("dur_s") or 0.0)
+    # Self-time: subtract each stack's total from its parent stack.
+    folds = dict(totals)
+    for stack, secs in totals.items():
+        if ";" in stack:
+            parent = stack.rsplit(";", 1)[0]
+            if parent in folds:
+                folds[parent] -= secs
+    return {k: max(0.0, v) for k, v in folds.items()}
+
+
+def fold_lines(folds: Dict[str, float]) -> List[str]:
+    """Serialize folded stacks as flamegraph.pl lines (value = integer
+    microseconds), sorted for determinism."""
+    return [f"{stack} {int(round(secs * 1e6))}"
+            for stack, secs in sorted(folds.items())]
+
+
+def parse_folded(lines: List[str]) -> Dict[str, float]:
+    """Inverse of :func:`fold_lines` (microseconds back to seconds);
+    ignores blank/malformed lines. ``parse_folded(fold_lines(f))`` then
+    ``fold_lines`` again is byte-identical — the prof-check round-trip."""
+    out: Dict[str, float] = {}
+    for line in lines:
+        line = line.strip()
+        if not line or " " not in line:
+            continue
+        stack, _, val = line.rpartition(" ")
+        try:
+            usec = int(val)
+        except ValueError:
+            continue
+        out[stack] = out.get(stack, 0.0) + usec / 1e6
+    return out
+
+
+# -- tables -----------------------------------------------------------------
+
+def attr_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [ev for ev in events if ev.get("type") == "attr"]
+
+
+def top_executables(events: List[Dict[str, Any]], n: int = 10
+                    ) -> List[Dict[str, Any]]:
+    """Top-N (phase, executable, lane) rows by device-seconds from the
+    ``attr`` events; falls back to per-span-name totals for streams that
+    predate the attribution plane (so the table is never just empty)."""
+    cells: Dict[tuple, Dict[str, Any]] = {}
+    for ev in attr_events(events):
+        key = (ev.get("phase"), ev.get("exe"), ev.get("lane", 0))
+        c = cells.setdefault(key, {
+            "phase": ev.get("phase"), "exe": ev.get("exe"),
+            "lane": ev.get("lane", 0), "engine": ev.get("engine"),
+            "seconds": 0.0, "calls": 0, "requests": 0, "flops": 0.0,
+            "bytes": 0.0, "compile_s": 0.0})
+        c["seconds"] += float(ev.get("seconds") or 0.0)
+        c["calls"] += 1
+        c["requests"] += int(ev.get("requests") or 0)
+        c["flops"] += float(ev.get("flops") or 0.0)
+        c["bytes"] += float(ev.get("bytes") or 0.0)
+        c["compile_s"] += float(ev.get("compile_s") or 0.0)
+    if not cells:
+        for ev in events:
+            if ev.get("type") != "span":
+                continue
+            key = (ev.get("name"), None, 0)
+            c = cells.setdefault(key, {
+                "phase": ev.get("name"), "exe": None, "lane": 0,
+                "engine": None, "seconds": 0.0, "calls": 0, "requests": 0,
+                "flops": 0.0, "bytes": 0.0, "compile_s": 0.0})
+            c["seconds"] += float(ev.get("dur_s") or 0.0)
+            c["calls"] += 1
+    rows = sorted(cells.values(), key=lambda c: -c["seconds"])[:n]
+    for c in rows:
+        c["seconds"] = round(c["seconds"], 6)
+        c["compile_s"] = round(c["compile_s"], 6)
+        c["flops"] = round(c["flops"], 3)
+        c["bytes"] = round(c["bytes"], 3)
+    return rows
+
+
+def roofline_series(events: List[Dict[str, Any]],
+                    peaks: Optional[_attr.Peaks] = None
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Per-engine achieved-vs-peak rows folded from the recorded ``attr``
+    events (the offline twin of ``AttributionMatrix.roofline``). Peaks
+    come from the stream's ``attr_plane`` start event when present (the
+    ceiling the run actually measured against), else a fresh local
+    calibration."""
+    if peaks is None:
+        plane = next((ev for ev in events
+                      if ev.get("type") == "attr_plane"
+                      and ev.get("flops_per_s")), None)
+        if plane is not None:
+            peaks = _attr.Peaks(
+                flops_per_s=float(plane["flops_per_s"]),
+                bytes_per_s=float(plane.get("bytes_per_s") or 1.0),
+                source=str(plane.get("source") or "stream"))
+        else:
+            peaks = _attr.calibrate_peaks()
+    engines: Dict[str, Dict[str, float]] = {}
+    for ev in attr_events(events):
+        engine = ev.get("engine") or "unknown"
+        e = engines.setdefault(engine, {"seconds": 0.0, "calls": 0,
+                                        "flops": 0.0, "bytes": 0.0,
+                                        "stall_s": 0.0, "stall_w": 0.0})
+        secs = float(ev.get("seconds") or 0.0)
+        e["seconds"] += secs
+        e["calls"] += 1
+        e["flops"] += float(ev.get("flops") or 0.0)
+        e["bytes"] += float(ev.get("bytes") or 0.0)
+        if ev.get("stall_frac") is not None:
+            e["stall_s"] += float(ev["stall_frac"]) * secs
+            e["stall_w"] += secs
+    out: Dict[str, Dict[str, Any]] = {}
+    for engine, e in engines.items():
+        secs = max(e["seconds"], 1e-9)
+        row: Dict[str, Any] = {"device_s": round(e["seconds"], 6),
+                               "calls": int(e["calls"])}
+        if e["flops"]:
+            achieved = e["flops"] / secs
+            row["achieved_flops_per_s"] = round(achieved, 3)
+            row["flops_frac"] = round(
+                achieved / max(peaks.flops_per_s, 1e-9), 6)
+        if e["bytes"]:
+            bps = e["bytes"] / secs
+            row["achieved_bytes_per_s"] = round(bps, 3)
+            row["bytes_frac"] = round(bps / max(peaks.bytes_per_s, 1e-9), 6)
+        if e["stall_w"] > 0:
+            row["stall_frac"] = round(e["stall_s"] / e["stall_w"], 4)
+        out[engine] = row
+    return out
+
+
+# -- rendering --------------------------------------------------------------
+
+def _fmt_rate(v: Optional[float]) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.2f}"
+
+
+def render_report(events: List[Dict[str, Any]], top: int = 10) -> str:
+    rows = top_executables(events, top)
+    roof = roofline_series(events)
+    lines = ["top executables (device-seconds):",
+             "   seconds   calls    reqs  lane  phase / executable"]
+    for c in rows:
+        exe = f"{c['phase']}" + (f" / {c['exe']}" if c.get("exe") else "")
+        lines.append(f"  {c['seconds']:8.4f}  {c['calls']:6d}  "
+                     f"{c['requests']:6d}  {c['lane']:4}  {exe}")
+    if roof:
+        lines.append("")
+        lines.append("roofline (per engine, achieved vs peak):")
+        for engine, r in sorted(roof.items()):
+            frac = r.get("flops_frac")
+            lines.append(
+                f"  {engine:12s} device_s={r['device_s']:.4f} "
+                f"flops/s={_fmt_rate(r.get('achieved_flops_per_s'))} "
+                + (f"({100 * frac:.2f}% of peak) " if frac is not None
+                   else "")
+                + (f"stall={r['stall_frac']:.2f}"
+                   if r.get("stall_frac") is not None else ""))
+    return "\n".join(lines)
+
+
+def load_events(target: str) -> List[Dict[str, Any]]:
+    """Read ``path[:run_id]`` (the doctor targeting convention); the run
+    suffix filters a multi-run file down to one run's events."""
+    from gauss_tpu.obs import doctor as _doctor
+
+    path, rid = _doctor.parse_target(target)
+    events = registry.read_events(path)
+    if rid:
+        events = [ev for ev in events if ev.get("run") == rid]
+    return events
+
+
+def scrape_snapshot(url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """Fetch a live server's ``/snapshot`` JSON (obs.export)."""
+    from urllib.request import urlopen
+
+    if not url.endswith("/snapshot"):
+        url = url.rstrip("/") + "/snapshot"
+    with urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def render_live(snap: Dict[str, Any]) -> str:
+    at = snap.get("attr") or {}
+    if not at.get("recording"):
+        return ("attribution plane is off on this server "
+                "(start it with ServeConfig(attr=True))")
+    lines = [f"attribution: {at.get('observes', 0)} observes, "
+             f"device_s_total={at.get('device_s_total', 0.0)}, "
+             f"peaks={at.get('peaks', {}).get('source', '?')}"]
+    lines.append("top executables (device-seconds):")
+    for c in (at.get("cells") or [])[:10]:
+        lines.append(f"  {c['seconds']:8.4f}  {c['calls']:6d}  "
+                     f"{c['requests']:6d}  {c['lane']:4}  "
+                     f"{c['phase']} / {c['exe']}")
+    roof = at.get("roofline") or {}
+    if roof:
+        lines.append("roofline (per engine):")
+        for engine, r in sorted(roof.items()):
+            frac = r.get("flops_frac")
+            lines.append(
+                f"  {engine:12s} device_s={r['device_s']:.4f} "
+                f"flops/s={_fmt_rate(r.get('achieved_flops_per_s'))}"
+                + (f" ({100 * frac:.2f}% of peak)"
+                   if frac is not None else ""))
+    cap = (at.get("capacity") or {}).get("sigs") or {}
+    if cap:
+        lines.append("capacity (per compat-sig):")
+        for sig, s in sorted(cap.items()):
+            lines.append(f"  {sig:24s} {s['device_s_per_request'] * 1e3:8.3f}"
+                         f" ms/req  ~{s['est_requests_per_s']:.1f} req/s")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gauss-prof",
+        description="Device-time attribution reports: top-executable "
+                    "tables, per-engine rooflines, and folded-stack "
+                    "flamegraphs from a recorded stream or a live scrape.")
+    p.add_argument("stream", nargs="?", default=None,
+                   help="recorded metrics JSONL: path[:run_id]")
+    p.add_argument("--url", default=None, metavar="URL",
+                   help="live server base URL — render from its /snapshot "
+                        "attr section instead of a file")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows in the top-executables table (default 10)")
+    p.add_argument("--folded", default=None, metavar="PATH",
+                   help="write folded-stack lines here ('-' = stdout) — "
+                        "feed to flamegraph.pl / speedscope")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON instead of text")
+    args = p.parse_args(argv)
+    if args.url:
+        try:
+            snap = scrape_snapshot(args.url)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"gauss-prof: scrape failed: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(snap.get("attr") or {}, indent=1,
+                             sort_keys=True))
+        else:
+            print(render_live(snap))
+        return 0
+    if not args.stream:
+        p.error("a stream path or --url is required")
+    try:
+        events = load_events(args.stream)
+    except (OSError, ValueError) as e:
+        print(f"gauss-prof: {e}", file=sys.stderr)
+        return 2
+    if args.folded:
+        lines = fold_lines(folded_stacks(events))
+        if args.folded == "-":
+            print("\n".join(lines))
+        else:
+            with open(args.folded, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            print(f"gauss-prof: wrote {len(lines)} folded stack(s) to "
+                  f"{args.folded}", file=sys.stderr)
+        if args.json or args.folded == "-":
+            return 0
+    if args.json:
+        print(json.dumps({"top": top_executables(events, args.top),
+                          "roofline": roofline_series(events)},
+                         indent=1, sort_keys=True))
+    else:
+        print(render_report(events, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

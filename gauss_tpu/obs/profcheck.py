@@ -1,0 +1,316 @@
+"""``make prof-check`` — the attribution plane's end-to-end CI gate.
+
+``python -m gauss_tpu.obs.profcheck [--summary-json PATH]``
+
+Three legs, all CPU, exit 2 on any invariant failure:
+
+1. **Reconcile**: a warm attr-on loadgen run (``ServeConfig(attr=True)``);
+   the per-request device-seconds the clients saw (``ServeResult.device_s``
+   summed over served + warmup requests) must reconcile with the
+   attribution matrix's own serve total (``capacity()["serve_device_s"]``)
+   within the stated tolerance — ``|Σ request - matrix| <=
+   max(RECONCILE_ABS_S, RECONCILE_REL * matrix)``. The same leg asserts
+   the roofline has a row for every engine the run exercised, each with an
+   achieved-flops rate, and that per-sig capacity accounting is populated.
+2. **Folds round-trip**: the leg-1 recorded stream's folded stacks must
+   survive ``fold_lines -> parse_folded -> fold_lines`` byte-identically,
+   and ``top_executables`` must surface the attr cells.
+3. **Attribution on a forced ratchet failure**: a synthetic headline past
+   the committed ratchet ceiling must come back ``out-of-band`` from
+   :func:`gauss_tpu.obs.regress.evaluate_ratchet`, and
+   :func:`gauss_tpu.obs.regress.attribute_phases` over an inflated phase
+   map must NAME the regressed phase in its "biggest regression
+   contributor" line — the pre-triage contract ``bench.py --regress`` and
+   ``regress check`` print on failure.
+
+The summary is regress-ingestable (``kind: prof_check``). Exit 2 on an
+invariant failure, 1 when ``--regress-check`` finds an out-of-band
+metric, 0 otherwise. ``make prof-check`` runs the CI configuration; like
+the other timing-gated gates it must not run concurrently with them
+(Makefile serial-ordering note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Tuple
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+#: the reconcile tolerance, stated: per-request costs are rounded to
+#: microseconds at the ServeResult boundary, so the identity is exact up
+#: to rounding on a healthy run; the relative term absorbs the rare
+#: cancel/verify-failure divergence (a request the matrix timed but whose
+#: result never reached a client).
+RECONCILE_ABS_S = 0.001
+RECONCILE_REL = 0.01
+
+#: the forced-failure phase map for leg 3 — the slope phase is inflated,
+#: everything else held; attribution must name it.
+_PRIOR_PHASES = {"prepare_inputs": 0.11, "headline_slope": 1.05,
+                 "verify": 0.21}
+_INFLATED_PHASES = {"prepare_inputs": 0.11, "headline_slope": 2.37,
+                    "verify": 0.21}
+
+
+# -- leg 1: request-cost vs matrix reconcile -------------------------------
+
+def run_reconcile_leg(seed: int, gate: float, cache=None, log=print) -> Dict:
+    """One warm attr-on loadgen pass; reconcile the client-visible cost
+    accounting against the matrix's totals and check the roofline rows."""
+    from gauss_tpu import obs
+    from gauss_tpu.serve.admission import ServeConfig
+    from gauss_tpu.serve.loadgen import LoadgenConfig, run_load
+    from gauss_tpu.serve.server import SolverServer
+
+    cfg = ServeConfig(ladder=(32,), max_batch=4, panel=16, refine_steps=1,
+                      verify_gate=gate, max_queue=256, attr=True)
+    warm = LoadgenConfig(mix="random:24*2,random:30", requests=24, warmup=4,
+                         mode="closed", concurrency=4, seed=seed,
+                         verify_gate=gate, serve=cfg)
+    leg: Dict = {"leg": "reconcile"}
+    t0 = time.perf_counter()
+    # Warm pass: compiles land here so the measured pass is steady-state
+    # (and the reconcile is not dominated by one giant compile share).
+    with obs.span("prof_reconcile_warm"):
+        with SolverServer(cfg, cache=cache) as srv:
+            run_load(srv, warm)
+    with obs.span("prof_reconcile_measured"):
+        with SolverServer(cfg, cache=cache) as srv:
+            summary = run_load(srv, warm)
+            roofline = srv.attr.roofline()
+            engines_seen = sorted(srv.attr.engine_names())
+    cost = summary.get("cost") or {}
+    req_s = ((cost.get("request_device_s") or 0.0)
+             + (cost.get("warmup_device_s") or 0.0))
+    matrix_s = cost.get("serve_device_s") or 0.0
+    tol = max(RECONCILE_ABS_S, RECONCILE_REL * matrix_s)
+    leg.update(
+        request_device_s=round(req_s, 6),
+        matrix_device_s=round(matrix_s, 6),
+        tolerance_s=round(tol, 6),
+        reconciled=abs(req_s - matrix_s) <= tol,
+        incorrect=summary.get("incorrect"),
+        throughput_rps=summary.get("throughput_rps"),
+        device_s_per_request=cost.get("device_s_per_request"),
+        engines=engines_seen,
+        roofline=roofline,
+        sigs=sorted((cost.get("sigs") or {})),
+    )
+    problems = []
+    if not leg["reconciled"]:
+        problems.append(
+            f"request cost {req_s:.6f} s vs matrix {matrix_s:.6f} s "
+            f"diverges past the {tol:.6f} s tolerance")
+    if summary.get("incorrect"):
+        problems.append(f"{summary['incorrect']} INCORRECT solution(s)")
+    if matrix_s <= 0:
+        problems.append("matrix attributed no serve device-seconds")
+    for eng in engines_seen:
+        row = roofline.get(eng) or {}
+        if not isinstance(row.get("achieved_flops_per_s"), (int, float)):
+            problems.append(f"roofline row for engine '{eng}' has no "
+                            f"achieved-flops rate")
+    if not leg["sigs"]:
+        problems.append("capacity model has no per-sig accounting")
+    leg["outcome"] = "violation" if problems else "ok"
+    if problems:
+        leg["error"] = "; ".join(problems)
+    leg["wall_s"] = round(time.perf_counter() - t0, 3)
+    log(f"  reconcile leg: {leg['outcome']} (requests {req_s:.6f} s vs "
+        f"matrix {matrix_s:.6f} s, tol {tol:.6f} s; engines "
+        f"{','.join(engines_seen) or '-'})")
+    return leg
+
+
+# -- leg 2: folded stacks round-trip ---------------------------------------
+
+def run_folds_leg(stream: str, log=print) -> Dict:
+    """The leg-1 stream's folded stacks must round-trip through the
+    serialized form byte-identically, and the top table must see cells."""
+    from gauss_tpu.obs import prof, registry
+
+    leg: Dict = {"leg": "folds", "stream": stream}
+    events = registry.read_events(stream)
+    folds = prof.folded_stacks(events)
+    lines = prof.fold_lines(folds)
+    round_trip = prof.fold_lines(prof.parse_folded(lines))
+    top = prof.top_executables(events, 5)
+    leg.update(stacks=len(lines), round_trip_ok=round_trip == lines,
+               top_rows=len(top),
+               attr_cells=sum(1 for ev in events
+                              if ev.get("type") == "attr"))
+    problems = []
+    if not lines:
+        problems.append("no folded stacks recovered from the stream")
+    if not leg["round_trip_ok"]:
+        problems.append("fold_lines(parse_folded(lines)) != lines")
+    if not top:
+        problems.append("top_executables saw no cells")
+    if not leg["attr_cells"]:
+        problems.append("stream has no attr events")
+    leg["outcome"] = "violation" if problems else "ok"
+    if problems:
+        leg["error"] = "; ".join(problems)
+    log(f"  folds leg: {leg['outcome']} ({leg['stacks']} stack(s), "
+        f"{leg['attr_cells']} attr cell(s), round-trip "
+        f"{'ok' if leg['round_trip_ok'] else 'BROKEN'})")
+    return leg
+
+
+# -- leg 3: forced ratchet failure -> named phase --------------------------
+
+def run_attribution_leg(log=print) -> Dict:
+    """A headline past the ratchet ceiling must gate out-of-band, and the
+    phase attribution over an inflated phase map must name the phase."""
+    from gauss_tpu.obs import regress
+
+    leg: Dict = {"leg": "attribution"}
+    metric = "gauss_n2048_wallclock"
+    forced = regress.RATCHET_BASELINES[metric] * (
+        regress.RATCHET_CEILINGS.get(metric, regress.RATCHET_MAX_RATIO)
+        + 0.5)
+    verdict = regress.evaluate_ratchet(metric, forced)
+    leg["forced_value"] = round(forced, 6)
+    leg["ratchet_status"] = verdict["status"] if verdict else None
+    text = regress.attribute_phases(_INFLATED_PHASES, _PRIOR_PHASES,
+                                    fresh_label="forced",
+                                    prior_label="prior")
+    leg["attribution"] = text
+    named = bool(text) and ("biggest regression contributor: "
+                            "headline_slope" in text)
+    leg["named_phase"] = "headline_slope" if named else None
+    problems = []
+    if leg["ratchet_status"] != "out-of-band":
+        problems.append(f"forced {forced:.6f} s gated "
+                        f"'{leg['ratchet_status']}', expected out-of-band")
+    if not named:
+        problems.append("attribution did not name the inflated phase")
+    leg["outcome"] = "violation" if problems else "ok"
+    if problems:
+        leg["error"] = "; ".join(problems)
+    log(f"  attribution leg: {leg['outcome']} (forced headline "
+        f"{forced:.6f} s -> {leg['ratchet_status']}, named phase: "
+        f"{leg['named_phase']})")
+    return leg
+
+
+def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
+    """(metric, value, unit) records a prof-check run contributes to
+    history. The attr-on serving cost gates (the attribution plane getting
+    more expensive is a perf regression); the per-request attributed
+    device cost gates the accounting itself drifting (a sudden jump means
+    the matrix started double-counting or the solve path slowed)."""
+    out: List[Tuple[str, float, str]] = []
+    rec = summary.get("reconcile") or {}
+    tput = rec.get("throughput_rps")
+    if isinstance(tput, (int, float)) and tput > 0:
+        out.append(("prof:attr_s_per_request", round(1.0 / tput, 6), "s"))
+    dev = rec.get("device_s_per_request")
+    if isinstance(dev, (int, float)) and dev > 0:
+        out.append(("prof:device_s_per_request", round(dev, 6), "s"))
+    return out
+
+
+# -- gate main --------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.obs.profcheck",
+        description="Attribution-plane gate: per-request cost vs matrix "
+                    "reconcile, folded-stack round-trip, and the forced-"
+                    "ratchet-failure phase-attribution contract.")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--gate", type=float, default=1e-4)
+    p.add_argument("--tmpdir", default="/tmp/gauss_prof",
+                   help="stream scratch directory")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="record the gate's own stream here (default "
+                        "<tmpdir>/profcheck.jsonl — the folds leg reads "
+                        "it back)")
+    p.add_argument("--summary-json", default=None, metavar="PATH")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append gate records to the regression history "
+                        "(default reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    honor_jax_platforms()
+
+    from gauss_tpu import obs
+    from gauss_tpu.obs import regress
+    from gauss_tpu.serve.cache import ExecutableCache
+
+    os.makedirs(args.tmpdir, exist_ok=True)
+    stream = args.metrics_out or os.path.join(args.tmpdir,
+                                              "profcheck.jsonl")
+    if stream != args.metrics_out and os.path.exists(stream):
+        os.remove(stream)  # default scratch stream: one run per file
+    t0 = time.perf_counter()
+    with obs.run(metrics_out=stream, tool="prof_check", seed=args.seed):
+        with obs.span("prof_check"):
+            reconcile = run_reconcile_leg(args.seed, args.gate,
+                                          cache=ExecutableCache(64))
+            attribution = run_attribution_leg()
+    # The folds leg reads the CLOSED stream back — the round-trip is over
+    # what actually landed on disk, not the in-memory event list.
+    folds = run_folds_leg(stream)
+    wall = round(time.perf_counter() - t0, 3)
+    legs = [reconcile, folds, attribution]
+    violations = sum(1 for leg in legs if leg.get("outcome") == "violation")
+    summary = {"kind": "prof_check", "seed": args.seed, "gate": args.gate,
+               "reconcile": reconcile, "folds": folds,
+               "attribution": attribution, "wall_s": wall,
+               "invariant_ok": violations == 0}
+    print(f"prof-check: {len(legs)} leg(s), "
+          f"{'invariant HOLDS' if violations == 0 else 'VIOLATED'} "
+          f"({wall} s)")
+
+    if args.summary_json:
+        parent = os.path.dirname(args.summary_json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.summary_json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    records = [{"metric": m, "value": v, "unit": u, "source": "profcheck",
+                "kind": "prof"} for m, v, u in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(
+            records, regress.load_history(history_path))
+        for r in records:
+            rv = regress.evaluate_ratchet(r["metric"], r["value"])
+            if rv is not None:
+                verdicts.append(rv)
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = 1
+    if args.history is not None and records and rc == 0 \
+            and violations == 0:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+
+    if violations:
+        for leg in legs:
+            if leg.get("outcome") == "violation":
+                print(f"profcheck: leg[{leg.get('leg')}] VIOLATION: "
+                      f"{leg.get('error')}", file=sys.stderr)
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

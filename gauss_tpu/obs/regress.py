@@ -353,6 +353,22 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "prof_check":
+        # A profiler gate summary (python -m gauss_tpu.obs.profcheck
+        # --summary-json): the attribution plane's per-request device cost
+        # and serving overhead enter history — the always-on attribution
+        # plane getting more expensive gates exactly like a perf
+        # regression (the device-seconds RECONCILE and folded round-trip
+        # are hard exit-2 invariants, not bands). Derivation lives with
+        # the checker (single source); lazy import keeps jax out of this
+        # module.
+        from gauss_tpu.obs.profcheck import history_records as prof_hist
+
+        for metric, value, unit in prof_hist(doc):
+            rec = _record(metric, value, path, "prof", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "lint_report":
         # A gauss-lint --json summary: per-pass finding counts enter
         # history so the static gates ratchet like perf metrics — with
@@ -521,6 +537,62 @@ def evaluate_ratchet(metric: str, value: float) -> Optional[Dict[str, Any]]:
     return verdict
 
 
+def attribute_phases(fresh: Dict[str, float], prior: Dict[str, float],
+                     fresh_label: str = "fresh",
+                     prior_label: str = "best-prior",
+                     top: int = 3) -> Optional[str]:
+    """Auto-attribution for a failed gate: diff a fresh record's flat
+    ``{phase: seconds}`` map against the best committed prior epoch's and
+    render the obs.doctor span-tree diff — the output NAMES the guilty
+    phase ("biggest regression contributor: ..."), so a ratchet failure
+    arrives pre-triaged instead of as a bare ratio. Returns None when
+    either side has no phase accounting (old records predate phases_s)."""
+    if not fresh or not prior:
+        return None
+    from gauss_tpu.obs import doctor
+
+    a = doctor.profile_from_phases(prior, path=prior_label, tool="bench")
+    b = doctor.profile_from_phases(fresh, path=fresh_label, tool="bench")
+    diff = doctor.diff_profiles(a, b)
+    return doctor.format_diff(diff, top or None)
+
+
+def _doc_phases(doc: Any) -> Dict[str, float]:
+    """Pull the flat phase map out of a bench-record-shaped artifact
+    (``phases_s`` at top level or under ``parsed``); {} when absent."""
+    if not isinstance(doc, dict):
+        return {}
+    for side in (doc, doc.get("parsed")):
+        if isinstance(side, dict) and isinstance(side.get("phases_s"), dict):
+            return side["phases_s"]
+    return {}
+
+
+def best_prior_phases() -> tuple:
+    """(phases_s, label) of the best-headline committed BENCH_r*.json
+    record that carries a phase breakdown — the prior side the check-path
+    attribution diffs against. ({}, None) when no committed record has
+    one (pre-attribution rounds)."""
+    import glob
+
+    root = os.path.dirname(os.path.dirname(default_history_path()))
+    best_v, best = None, ({}, None)
+    for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        side = parsed if isinstance(parsed, dict) else doc
+        v = side.get("value") if isinstance(side, dict) else None
+        phases = _doc_phases(doc)
+        if phases and isinstance(v, (int, float)) and v > 0 and (
+                best_v is None or v < best_v):
+            best_v, best = v, (phases, os.path.basename(p))
+    return best
+
+
 def check_records(records: List[Dict[str, Any]],
                   history: List[Dict[str, Any]],
                   band: float = DEFAULT_BAND,
@@ -633,6 +705,27 @@ def main(argv=None) -> int:
                 verdicts.append(rv)
     print(format_verdicts(verdicts))
     bad = any(v["status"] == "out-of-band" for v in verdicts)
+    if bad:
+        # Auto-attribution: when a checked artifact carries a phases_s
+        # breakdown, diff it against the best committed prior epoch's and
+        # name the guilty phase (obs.doctor) — a failed gate arrives
+        # pre-triaged. Silent when neither side has phase accounting.
+        prior, prior_label = best_prior_phases()
+        for f in args.files:
+            try:
+                with open(os.fspath(f)) as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            attribution = attribute_phases(
+                _doc_phases(doc), prior,
+                fresh_label=os.path.basename(os.fspath(f)),
+                prior_label=prior_label or "best-prior")
+            if attribution:
+                print(f"regress: phase attribution for "
+                      f"{os.path.basename(os.fspath(f))} vs {prior_label}:",
+                      file=sys.stderr)
+                print(attribution, file=sys.stderr)
     if args.update and not bad:
         added = append_history(records, history_path)
         print(f"regress: gate green; {added} record(s) appended to history")

@@ -532,6 +532,102 @@ def _structure_lines(st: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def utilization_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the attribution plane's ``attr`` cell observations
+    (gauss_tpu.obs.attr) into one report: device-seconds by phase and by
+    engine, achieved-vs-peak roofline fractions per engine (against the
+    peaks the run's ``attr_plane`` event recorded), seconds-weighted stall
+    fractions, and amortized compile-seconds. Empty dict when the run had
+    no attribution plane — attr-off streams carry no utilization noise."""
+    cells = [ev for ev in events if ev.get("type") == "attr"]
+    if not cells:
+        return {}
+    plane = next((ev for ev in events if ev.get("type") == "attr_plane"), {})
+    peak_f = plane.get("flops_per_s")
+    peak_b = plane.get("bytes_per_s")
+    by_phase: Dict[str, Dict[str, float]] = {}
+    engines: Dict[str, Dict[str, float]] = {}
+    compile_s = 0.0
+    for ev in cells:
+        s = float(ev.get("seconds", 0.0) or 0.0)
+        ph = by_phase.setdefault(str(ev.get("phase", "?")),
+                                 {"seconds": 0.0, "calls": 0, "requests": 0})
+        ph["seconds"] += s
+        ph["calls"] += 1
+        ph["requests"] += int(ev.get("requests", 0) or 0)
+        eng = engines.setdefault(str(ev.get("engine", "?")),
+                                 {"seconds": 0.0, "flops": 0.0,
+                                  "bytes": 0.0, "stall_s": 0.0,
+                                  "stall_w": 0.0})
+        eng["seconds"] += s
+        if isinstance(ev.get("flops"), (int, float)):
+            eng["flops"] += float(ev["flops"])
+        if isinstance(ev.get("bytes"), (int, float)):
+            eng["bytes"] += float(ev["bytes"])
+        if isinstance(ev.get("stall_frac"), (int, float)):
+            eng["stall_s"] += float(ev["stall_frac"]) * s
+            eng["stall_w"] += s
+        if isinstance(ev.get("compile_s"), (int, float)):
+            compile_s += float(ev["compile_s"])
+    roofline: Dict[str, Dict[str, Any]] = {}
+    for name, e in engines.items():
+        row: Dict[str, Any] = {"device_s": round(e["seconds"], 6)}
+        if e["seconds"] > 0 and e["flops"]:
+            row["achieved_flops_per_s"] = round(e["flops"] / e["seconds"], 3)
+            if isinstance(peak_f, (int, float)) and peak_f > 0:
+                row["flops_frac"] = round(
+                    row["achieved_flops_per_s"] / peak_f, 6)
+        if e["seconds"] > 0 and e["bytes"]:
+            row["achieved_bytes_per_s"] = round(e["bytes"] / e["seconds"], 3)
+            if isinstance(peak_b, (int, float)) and peak_b > 0:
+                row["bytes_frac"] = round(
+                    row["achieved_bytes_per_s"] / peak_b, 6)
+        if e["stall_w"] > 0:
+            row["stall_frac"] = round(e["stall_s"] / e["stall_w"], 4)
+        roofline[name] = row
+    return {
+        "observes": len(cells),
+        "device_s_total": round(sum(e["seconds"]
+                                    for e in engines.values()), 6),
+        "compile_s": round(compile_s, 6),
+        "by_phase": {k: {"seconds": round(v["seconds"], 6),
+                         "calls": int(v["calls"]),
+                         "requests": int(v["requests"])}
+                     for k, v in by_phase.items()},
+        "roofline": roofline,
+        "peaks": ({"flops_per_s": peak_f, "bytes_per_s": peak_b,
+                   "source": plane.get("source")} if plane else None),
+    }
+
+
+def _utilization_lines(ut: Dict[str, Any]) -> List[str]:
+    lines = [f"  {ut['observes']} observation(s), "
+             f"{_fmt(ut['device_s_total'])} device-s attributed, "
+             f"{_fmt(ut['compile_s'])} s amortized compile"]
+    for ph, d in sorted(ut["by_phase"].items(),
+                        key=lambda kv: -kv[1]["seconds"]):
+        lines.append(f"  {ph}: {d['seconds']:.6f} s over {d['calls']} "
+                     f"call(s), {d['requests']} request(s)")
+    for eng, row in sorted(ut["roofline"].items()):
+        bits = [f"device_s {_fmt(row['device_s'])}"]
+        if "achieved_flops_per_s" in row:
+            bits.append(f"{_fmt(row['achieved_flops_per_s'])} flop/s")
+        if "flops_frac" in row:
+            bits.append(f"{100 * row['flops_frac']:.2f}% of peak flops")
+        if "bytes_frac" in row:
+            bits.append(f"{100 * row['bytes_frac']:.2f}% of peak bytes")
+        if "stall_frac" in row:
+            bits.append(f"stall {_fmt(row['stall_frac'])}")
+        lines.append(f"  engine {eng}: " + ", ".join(bits))
+    if ut.get("peaks"):
+        p = ut["peaks"]
+        lines.append(f"  peaks ({p.get('source', '?')}): "
+                     f"{_fmt(p.get('flops_per_s'))} flop/s, "
+                     f"{_fmt(p.get('bytes_per_s'))} B/s — CPU-proxy "
+                     f"calibration, not chip datasheet numbers")
+    return lines
+
+
 def postmortem_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold ``postmortem`` capture events (gauss_tpu.obs.postmortem) and
     ``flight`` recorder lifecycle events into one report: bundles captured
@@ -742,6 +838,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "durability": durability_summary(evs),
         "slo": slo_summary(evs),
         "structure": structure_summary(evs),
+        "utilization": utilization_summary(evs),
         "resilience": resilience_summary(evs),
         "sdc": sdc_summary(evs),
         "postmortems": postmortem_summary(evs),
@@ -815,6 +912,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("structure lanes:")
         out.extend(_structure_lines(structure))
+
+    util = utilization_summary(evs)
+    if util:
+        out.append("")
+        out.append("utilization (device-time attribution):")
+        out.extend(_utilization_lines(util))
 
     resilience = resilience_summary(evs)
     if resilience:

@@ -167,6 +167,41 @@ def render(view: _View, url: str,
                 f"{_fmt(s.get('stolen', 0))}, occupancy "
                 f"{_fmt(s.get('occupancy'))}")
 
+    # Utilization panel (obs.attr): per-lane achieved-vs-peak, stall
+    # fraction and device-seconds per wall-second, plus the per-engine
+    # roofline fractions. Gauges are plain-named gauss_util_lane<i>_<stat>
+    # / gauss_util_<engine>_<stat>; absent entirely when the attribution
+    # plane is off (ServeConfig(attr=None)).
+    util_samples = view.prefixed("gauss_util_")
+    if util_samples:
+        ulanes: Dict[int, Dict[str, float]] = {}
+        engines: Dict[str, Dict[str, float]] = {}
+        for name, v in util_samples.items():
+            m = re.match(r"gauss_util_lane(\d+)_(\w+)", name)
+            if m:
+                ulanes.setdefault(int(m.group(1)), {})[m.group(2)] = v
+                continue
+            m = re.match(r"gauss_util_(\w+?)_"
+                         r"(achieved_flops_per_s|flops_frac)$", name)
+            if m:
+                engines.setdefault(m.group(1), {})[m.group(2)] = v
+        lines.append("  utilization (attribution plane):")
+        for idx in sorted(ulanes):
+            s = ulanes[idx]
+            frac = s.get("flops_frac")
+            lines.append(
+                f"    lane {idx}: "
+                f"{_fmt(s.get('achieved_flops_per_s'), digits=3)} flop/s "
+                f"achieved ({_fmt(frac, digits=4)} of peak), stall "
+                f"{_fmt(s.get('stall_frac'), digits=4)}, device-s/s "
+                f"{_fmt(s.get('device_s_per_s'), digits=4)}")
+        for eng in sorted(engines):
+            s = engines[eng]
+            lines.append(
+                f"    engine {eng}: "
+                f"{_fmt(s.get('achieved_flops_per_s'), digits=3)} flop/s "
+                f"achieved ({_fmt(s.get('flops_frac'), digits=4)} of peak)")
+
     firing = view.labeled("gauss_slo_firing")
     if firing:
         burns = {(labels.get("slo"), labels.get("window")): v

@@ -213,6 +213,26 @@ def _stats_scope(**fields):
         stats.wall_s += time.perf_counter() - t0
         _ACTIVE = None
         _LAST = stats
+        # Feed the installed attribution plane (if any): the ledger's
+        # overlap/stall accounting generalizes into the roofline's
+        # ``outofcore`` engine row. No-op (one is-None read) when the
+        # plane is off, and never allowed to break the solve.
+        try:
+            from gauss_tpu.obs import attr as _attr
+
+            matrix = _attr.active()
+            if matrix is not None:
+                matrix.observe(
+                    "outofcore_stream",
+                    f"outofcore/n{stats.n}/p{stats.panel}",
+                    stats.wall_s,
+                    engine="outofcore",
+                    requests=max(1, stats.solves),
+                    bytes_accessed=stats.bytes_h2d + stats.bytes_d2h,
+                    stall_frac=stats.stall_fraction,
+                )
+        except Exception:  # pragma: no cover — observability must not raise
+            pass
 
 
 @contextmanager

@@ -155,6 +155,23 @@ class ServeConfig:
     #                                   (fixed-size; oldest records are
     #                                   overwritten — the ring holds the
     #                                   final seconds, not the history)
+    # -- device-time attribution (gauss_tpu.obs.attr / obs.prof) -----------
+    attr: Optional[bool] = None     # device-time attribution plane: install
+    #                                 a process AttributionMatrix at start()
+    #                                 — every dispatched executable is timed
+    #                                 at device-completion granularity into
+    #                                 the (phase, executable, lane) matrix,
+    #                                 joined with compile-time FLOP/byte
+    #                                 budgets into roofline ``util.*``
+    #                                 gauges, and each request accumulates
+    #                                 device-seconds / amortized compile-
+    #                                 seconds (ServeResult.device_s /
+    #                                 .compile_s; per-compat-sig capacity
+    #                                 model on /snapshot). None (default) =
+    #                                 plane off — the serve path and its
+    #                                 traces are byte-identical to the
+    #                                 pre-attribution behavior (one is-None
+    #                                 read per dispatch)
     # -- mesh serving (gauss_tpu.serve.lanes) ------------------------------
     lanes: int = 0                  # dispatch lanes across the device mesh:
     #                                 0 (default) = the single-queue/
@@ -247,6 +264,13 @@ class ServeResult:
     #: data corruption while serving this request — the per-request SDC
     #: status tag (ServeConfig.abft).
     sdc_detected: bool = False
+    #: per-request cost accounting (ServeConfig.attr): the device-seconds
+    #: this request consumed (its share of every batch solve it rode,
+    #: summed across retries/steals) and the amortized compile/cache-get
+    #: seconds paid on its behalf. None when the attribution plane is off
+    #: — results are then byte-identical to the pre-attribution shape.
+    device_s: Optional[float] = None
+    compile_s: Optional[float] = None
 
     @property
     def ok(self) -> bool:
@@ -318,6 +342,13 @@ class ServeRequest:
         self._done = threading.Event()
         self._resolve_lock = threading.Lock()
         self._result: Optional[ServeResult] = None  # guarded by: self._resolve_lock
+        #: cost accumulators (ServeConfig.attr): device-seconds and
+        #: amortized compile-seconds, summed across every batch/steal this
+        #: request rides. Written only by the worker currently dispatching
+        #: the request (a request is in exactly one batch at a time — lane
+        #: handoff moves the whole object), read at _finish.
+        self.cost_device_s = 0.0  # lockset: ok — owned by the dispatching worker
+        self.cost_compile_s = 0.0  # lockset: ok — owned by the dispatching worker
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -150,6 +150,60 @@ class BatchedExecutable:
             zer = np.zeros((key.batch, key.bucket_n, key.nrhs), dtype=dtype)
             fac = self._factor(np.ascontiguousarray(eye))
             jax.block_until_ready(self._solve(fac, zer))
+        #: compile-time FLOP/byte budget per dispatch — computed lazily by
+        #: cost_budget() (the attribution plane is its only reader; a
+        #: server with attr=None never pays the cost analysis).
+        self._cost = None       # lockset: ok — idempotent lazy cache; racing writers compute equal values
+
+    def cost_budget(self) -> dict:
+        """The per-dispatch FLOP/byte budget the attribution plane joins
+        device time against: XLA's own ``cost_analysis`` numbers for the
+        factor + ``refine_steps + 1`` solves (obs.compile.cost_summary over
+        the already-jitted callables, at the warmup shapes), falling back
+        to the analytic LU budget (obs.attr.lu_flop_budget) where XLA
+        cannot report — so a roofline row exists for every engine
+        exercised. Computed once per executable, cached; never raises."""
+        cost = self._cost
+        if cost is not None:
+            return cost
+        key = self.key
+        flops = bytes_accessed = None
+        try:
+            from gauss_tpu.obs import compile as _compile
+
+            dtype = storage_dtype(key.dtype)
+            eye = np.broadcast_to(np.eye(key.bucket_n, dtype=dtype),
+                                  (key.batch, key.bucket_n, key.bucket_n))
+            eye = np.ascontiguousarray(eye)
+            zer = np.zeros((key.batch, key.bucket_n, key.nrhs), dtype=dtype)
+            fc = _compile.cost_summary(self._factor, eye) or {}
+            fac = self._factor(eye)
+            sc = _compile.cost_summary(self._solve, fac, zer) or {}
+            rounds = 1 + key.refine_steps
+            if fc.get("flops") or sc.get("flops"):
+                flops = (float(fc.get("flops") or 0.0)
+                         + float(sc.get("flops") or 0.0) * rounds)
+            if fc.get("bytes_accessed") or sc.get("bytes_accessed"):
+                bytes_accessed = (
+                    float(fc.get("bytes_accessed") or 0.0)
+                    + float(sc.get("bytes_accessed") or 0.0) * rounds)
+        except Exception:  # noqa: BLE001 — accounting must not break serving
+            pass
+        if not flops or not bytes_accessed:
+            from gauss_tpu.obs import attr as _attr
+
+            if not flops:
+                flops = _attr.lu_flop_budget(
+                    key.bucket_n, key.nrhs, batch=key.batch,
+                    refine_steps=key.refine_steps)
+            if not bytes_accessed:
+                bytes_accessed = _attr.lu_byte_budget(
+                    key.bucket_n, key.nrhs, batch=key.batch,
+                    itemsize=storage_dtype(key.dtype).itemsize,
+                    refine_steps=key.refine_steps)
+        cost = {"flops": flops, "bytes_accessed": bytes_accessed}
+        self._cost = cost
+        return cost
 
     def solve(self, a_pad: np.ndarray, b_pad: np.ndarray,
               placement=None) -> np.ndarray:

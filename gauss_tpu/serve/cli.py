@@ -191,6 +191,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "DIR/bundles (inspect with gauss-debug; also "
                         "honored from the GAUSS_FLIGHT_DIR env — how "
                         "--supervised hands it to the child)")
+    p.add_argument("--attr", action="store_true",
+                   help="install the device-time attribution plane for the "
+                        "run: per-(phase, executable, lane) device-seconds, "
+                        "util.* gauges (gauss_util_* on /metrics, gauss-top "
+                        "utilization panel), per-request cost fields on "
+                        "every result, and the cost section in the report "
+                        "(docs/OBSERVABILITY.md 'Attribution & roofline'); "
+                        "off = byte-identical pre-attribution traces")
     # -- live telemetry plane ---------------------------------------------
     p.add_argument("--live-port", type=int, default=None, metavar="PORT",
                    help="embed the live telemetry endpoint on PORT "
@@ -262,7 +270,7 @@ def main(argv=None) -> int:
         lanes=args.lanes, lane_width=args.lane_width,
         continuous_batching=args.continuous_batching,
         cb_window_s=args.cb_window, autoscale=args.autoscale,
-        min_lanes=args.min_lanes,
+        min_lanes=args.min_lanes, attr=(args.attr or None),
         heartbeat_path=os.environ.get("GAUSS_SERVE_HEARTBEAT") or None,
         flight_dir=(args.flight_dir
                     or os.environ.get("GAUSS_FLIGHT_DIR") or None))

@@ -138,6 +138,10 @@ class Lane:
         self.cb_admits = 0              # requests admitted into a forming slot
         self.occupancy_sum = 0.0
         self.drain_rate = 0.0           # EWMA requests/s (retry-after input)
+        self.device_s = 0.0             # blocked device wall this lane owns
+        #                                 (ServeConfig.attr only; stays 0.0
+        #                                 — and out of stats() — when the
+        #                                 attribution plane is off)
 
     def placement_for(self, batch_bucket: int):
         """The device placement for one dispatch: the slice-sharded
@@ -151,10 +155,12 @@ class Lane:
                 self.mesh, jax.sharding.PartitionSpec(self.mesh.axis_names[0]))
         return self.devices[0] if self.devices else None
 
-    def note_batch(self, served: int, occupancy: float):
+    def note_batch(self, served: int, occupancy: float,
+                   device_s: float = 0.0):
         self.batches += 1
         self.served += served
         self.occupancy_sum += occupancy
+        self.device_s += device_s
         obs.gauge(f"serve.lane{self.idx}.served", self.served)
         obs.gauge(f"serve.lane{self.idx}.occupancy", occupancy)
         obs.gauge(f"serve.lane{self.idx}.queue_depth", len(self.queue))  # lockset: ok — gauge snapshot
@@ -172,6 +178,11 @@ class Lane:
                                if self.batches else None),
             "drain_rate": round(self.drain_rate, 4),
             "queue_depth": len(self.queue),  # lockset: ok — stats snapshot
+            # Only with the attribution plane on — an attr=None server's
+            # lane stats (and the loadgen mesh block folded from them)
+            # stay byte-identical.
+            **({"device_s": round(self.device_s, 6)}
+               if self.device_s else {}),
         }
 
 

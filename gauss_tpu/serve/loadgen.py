@@ -250,8 +250,7 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
         warm_handles = [server.submit(*materialize(spec, rng, cfg.nrhs),
                                       dtype=spec.dtype)
                         for spec in warm_plan]
-        for h in warm_handles:
-            h.result(cfg.timeout_s)
+        warm_results = [h.result(cfg.timeout_s) for h in warm_handles]
     # Warmup wall-clock is the COLD-START number the persistent compile
     # cache (gauss_tpu.tune.compilecache) exists to kill: a second process
     # sharing the cache dir reruns this same warmup mostly from cached
@@ -396,6 +395,30 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
 
         summary["slo"] = _slo.slo_report(server.live.slos, mix=cfg.mix,
                                          mode=cfg.mode)
+    if getattr(server, "attr", None) is not None:
+        # The attribution plane was on: per-request cost accounting rides
+        # in the report. ``request_device_s`` re-sums the ServeResult cost
+        # fields the clients saw; ``capacity`` is the matrix's per-sig /
+        # per-lane view — prof-check reconciles the two. Absent (not null)
+        # when attr is off, so attr=None summaries stay byte-identical.
+        cap = server.attr.capacity()
+        req_device = sum(r.device_s or 0.0 for r in results
+                         if r is not None and r.status == STATUS_OK)
+        req_compile = sum(r.compile_s or 0.0 for r in results
+                          if r is not None and r.status == STATUS_OK)
+        # Warmup device-seconds ride separately: the matrix saw the warmup
+        # traffic too, so the reconcile identity prof-check asserts is
+        # request_device_s + warmup_device_s ≈ serve_device_s.
+        warm_device = sum(r.device_s or 0.0 for r in warm_results
+                          if r.status == STATUS_OK)
+        summary["cost"] = {
+            "request_device_s": round(req_device, 6),
+            "request_compile_s": round(req_compile, 6),
+            "warmup_device_s": round(warm_device, 6),
+            "device_s_per_request": (round(req_device / served, 6)
+                                     if served else None),
+            **cap,
+        }
     obs.emit("serve_loadgen", **{k: v for k, v in summary.items()
                                  if k != "kind"})
     for name, value in history_records(summary):
@@ -496,4 +519,18 @@ def format_summary(summary: Dict) -> str:
             f"violation(s) (rate {slo['violation_rate']:.4f}), worst burn "
             f"{slo['worst_burn_rate']:.2f}x, {slo['alerts']} alert(s) "
             f"fired / {slo['clears']} cleared")
+    cost = summary.get("cost")
+    if cost:
+        lines.append(
+            f"  cost: {_s(cost['request_device_s'])} device-s across "
+            f"requests ({_s(cost['device_s_per_request'])} s/req), "
+            f"{_s(cost['request_compile_s'])} s amortized compile; "
+            f"matrix serve total {_s(cost.get('serve_device_s'))} s")
+        sigs = cost.get("sigs") or {}
+        if sigs:
+            per = ", ".join(
+                f"{sig}: {v['requests']} req @ "
+                f"{_s(v['device_s_per_request'])} s"
+                for sig, v in sorted(sigs.items()))
+            lines.append(f"  per-sig: {per}")
     return "\n".join(lines)

@@ -139,6 +139,12 @@ class SolverServer:
         #: the crash-surviving flight recorder (None until start() with a
         #: flight_dir) — obs.flight.FlightSink
         self._flight = None
+        #: the device-time attribution plane (None until start() with
+        #: config.attr) — obs.attr.AttributionMatrix; /snapshot, the
+        #: loadgen cost report, and per-request ServeResult.device_s /
+        #: .compile_s all read it
+        self.attr = None
+        self._attr_prev = None            # matrix displaced by install()
         #: durable admission (None = journal off; the serve path is then
         #: byte-identical to the pre-journal behavior)
         self.journal = None               # serve.durable.RequestJournal
@@ -171,6 +177,8 @@ class SolverServer:
             self._start_live()
         if self.config.flight_dir and self._flight is None:
             self._start_flight()
+        if self.config.attr and self.attr is None:
+            self._start_attr()
         self._stop.clear()
         with self._depth_lock:
             self._closed = False
@@ -260,6 +268,29 @@ class SolverServer:
             _postmortem.uninstall_trigger()
             _flight_mod.uninstall()
             self._flight = None
+
+    def _start_attr(self) -> None:
+        """Bring up the device-time attribution plane: a process
+        AttributionMatrix (obs.attr) the dispatch paths below fold every
+        blocked executable wall into, joined with compile-time FLOP/byte
+        budgets into roofline ``util.*`` gauges and the per-compat-sig
+        capacity model ``/snapshot`` exposes. Lazy imports — an
+        ``attr=None`` server never loads (or pays for) any of this, and
+        its dispatch path and traces are byte-identical pre-attribution
+        behavior (one ``is None`` read per dispatch)."""
+        from gauss_tpu.obs import attr as _attr
+
+        self.attr = _attr.AttributionMatrix()
+        self._attr_prev = _attr.install(self.attr)
+        obs.emit("attr_plane", event="start", **self.attr.peaks.to_dict())
+
+    def _stop_attr(self) -> None:
+        if self.attr is not None:
+            from gauss_tpu.obs import attr as _attr
+
+            _attr.uninstall(self._attr_prev)
+            self.attr = None
+            self._attr_prev = None
 
     @property
     def live_url(self) -> Optional[str]:
@@ -496,6 +527,7 @@ class SolverServer:
             self.journal.close()
         self._stop_live()
         self._stop_flight()
+        self._stop_attr()
 
     def __enter__(self) -> "SolverServer":
         return self.start()
@@ -861,16 +893,22 @@ class SolverServer:
         placement = lane.placement_for(bb) if lane is not None else None
         t0 = time.perf_counter()
         x = None
+        exe = None
+        get_s = solve_s = 0.0
         err: Optional[BaseException] = None
         for attempt in range(cfg.max_retries + 1):
             try:
+                t_get = time.perf_counter()
                 exe = (lane.cache_view.get(key, panel=cfg.panel)
                        if lane is not None
                        else self.cache.get(key, panel=cfg.panel))
+                t_solve = time.perf_counter()
                 with obs.span("serve_batch_solve", bucket_n=bucket_n,
                               batch=len(reqs), requests=len(reqs),
                               traces=traces):
                     x = exe.solve(a_pad, b_pad, placement=placement)
+                solve_s = time.perf_counter() - t_solve
+                get_s = t_solve - t_get
                 err = None
                 break
             except Exception as e:  # noqa: BLE001 — lane boundary
@@ -917,7 +955,11 @@ class SolverServer:
             self.batches += 1
         occupancy = len(reqs) / bb
         if lane is not None:
-            lane.note_batch(len(reqs), occupancy)
+            lane.note_batch(len(reqs), occupancy,
+                            device_s=(solve_s if self.attr is not None
+                                      else 0.0))
+        if self.attr is not None:
+            self._attr_batch(reqs, key, bb, lane, solve_s, get_s, exe)
         obs.counter("serve.batches")
         obs.histogram("serve.batch_occupancy", occupancy)
         obs.emit("serve_batch", bucket_n=bucket_n, nrhs=nrhs,
@@ -931,6 +973,58 @@ class SolverServer:
             xi = buckets.unpad_solution(x[i], req.n, req.k, req.was_vector)
             self._finish(req, xi, lane="batched", bucket_n=bucket_n)
 
+    # -- device-time attribution (gauss_tpu.obs.attr) ----------------------
+
+    def _attr_batch(self, reqs, key, bb, lane, solve_s: float, get_s: float,
+                    exe) -> None:
+        """Fold one served batch into the attribution matrix and spread its
+        cost over the member requests: each rider owes an equal share of
+        the blocked solve wall (device-seconds) and of the cache-get wall
+        (amortized compile-seconds — ~0 on a hit, the executable build on
+        the miss that created the entry). Called only with the plane on;
+        never raises — attribution must not take down serving."""
+        try:
+            share = solve_s / len(reqs)
+            cshare = get_s / len(reqs)
+            for req in reqs:
+                req.cost_device_s += share
+                req.cost_compile_s += cshare
+            cost = exe.cost_budget() if exe is not None else {}
+            engine = "cholesky" if key.structure == "spd" else key.engine
+            exe_label = (f"{engine}/b{key.bucket_n}x{bb}/r{key.nrhs}"
+                         f"/{key.dtype}")
+            sig = f"b{key.bucket_n}/{key.dtype}" + (
+                f"/{key.structure}" if key.structure else "")
+            self.attr.observe(
+                "serve_batch_solve", exe_label, solve_s, engine=engine,
+                lane=lane.idx if lane is not None else 0,
+                requests=len(reqs), flops=cost.get("flops"),
+                bytes_accessed=cost.get("bytes_accessed"),
+                compile_s=get_s, sig=sig)
+        except Exception:  # noqa: BLE001 — attribution must not break serving
+            obs.counter("attr.errors")
+
+    def _attr_single(self, req: ServeRequest, phase: str, engine: str,
+                     seconds: float) -> None:
+        """Attribute one single-request lane dispatch (handoff / fleet /
+        outofcore / abft / numpy): the request owes the whole blocked
+        wall; the matrix gets a roofline row for the engine with the
+        analytic LU budget (the single-request lanes have no cached
+        executable to ask XLA about). Never raises."""
+        try:
+            from gauss_tpu.obs import attr as _attr
+
+            req.cost_device_s += seconds
+            self.attr.observe(
+                phase, f"{engine}/n{req.n}", seconds, engine=engine,
+                requests=1,
+                flops=_attr.lu_flop_budget(req.n, req.k),
+                bytes_accessed=_attr.lu_byte_budget(req.n, req.k,
+                                                    itemsize=8),
+                sig=f"{phase}/{engine}")
+        except Exception:  # noqa: BLE001 — attribution must not break serving
+            obs.counter("attr.errors")
+
     def _serve_handoff(self, req: ServeRequest) -> None:
         """Oversized lane: one solve_handoff call per request (the routing
         decision itself is emitted by solve_handoff as a ``route`` event).
@@ -943,6 +1037,7 @@ class SolverServer:
         cfg = self.config
         lane = "handoff"
         sdc_detected = False
+        t0 = time.perf_counter()
         try:
             # The trace context stamps every event emitted below us —
             # solve_handoff's route decision, fleet supervision events —
@@ -1008,6 +1103,9 @@ class SolverServer:
                          trace=req.trace_id, status=STATUS_FAILED, lane=lane,
                          error=f"{type(e).__name__}: {e}"[:200])
             return
+        if self.attr is not None:
+            self._attr_single(req, "serve_handoff", lane,
+                              time.perf_counter() - t0)
         self._finish(req, np.asarray(x), lane=lane, bucket_n=None,
                      sdc_detected=sdc_detected)
 
@@ -1022,6 +1120,7 @@ class SolverServer:
         from gauss_tpu.resilience import recover
 
         gate = self.config.verify_gate or recover.DEFAULT_GATE
+        t0 = time.perf_counter()
         try:
             # recover.solve_resilient emits per-rung ``recovery`` events;
             # the trace context stamps them with this request's identity so
@@ -1041,6 +1140,9 @@ class SolverServer:
                          lane="numpy",
                          error=f"{type(e).__name__}: {e}"[:200])
             return
+        if self.attr is not None:
+            self._attr_single(req, "serve_numpy", "numpy",
+                              time.perf_counter() - t0)
         self._finish(req, x, lane="numpy", bucket_n=None)
 
     def _finish(self, req: ServeRequest, x: np.ndarray, lane: str,
@@ -1063,10 +1165,17 @@ class SolverServer:
                              error="verify gate")
                 return
         queue_s = time.perf_counter() - req.t_submit
+        # Per-request cost accounting (ServeConfig.attr): attach the
+        # accumulated device/compile seconds to the terminal result and
+        # event. With the plane off, cost is {} — the result and trace
+        # are byte-identical to the pre-attribution shape.
+        cost = ({"device_s": round(req.cost_device_s, 6),
+                 "compile_s": round(req.cost_compile_s, 6)}
+                if self.attr is not None else {})
         if not req.resolve(ServeResult(status=STATUS_OK, x=x, lane=lane,
                                        bucket_n=bucket_n, queue_s=queue_s,
                                        rel_residual=rel,
-                                       sdc_detected=sdc_detected)):
+                                       sdc_detected=sdc_detected, **cost)):
             return  # cancelled mid-compute: the client owns the terminal
         with self._stats_lock:
             self.requests_served += 1
@@ -1078,4 +1187,5 @@ class SolverServer:
                  trace=req.trace_id, status=STATUS_OK, lane=lane,
                  bucket_n=bucket_n, latency_s=round(queue_s, 6),
                  rel_residual=rel,
-                 **({"sdc_detected": True} if sdc_detected else {}))
+                 **({"sdc_detected": True} if sdc_detected else {}),
+                 **cost)

@@ -110,6 +110,38 @@ def test_rolling_window_horizon_under_sparse_writes():
     assert sorted(win.values(now=1003.0)) == [10.0, 11.0, 12.0, 13.0]
 
 
+def test_rolling_window_sparse_writes_property(rng):
+    # Property test for the sparse-write horizon semantics: random
+    # interleavings of writes and clock advances, checked against a
+    # brute-force (timestamp, value) list after EVERY operation. Catches
+    # ring-index bugs the directed sparse-writes test above only samples
+    # (stale slots resurrected after wrap, horizon applied at write
+    # instead of read, count/total drifting from the all-time ledger).
+    for case in range(20):
+        case_rng = np.random.default_rng(900 + case)
+        cap = int(case_rng.integers(2, 17))
+        horizon = float(case_rng.uniform(5.0, 50.0))
+        win = obs_live.RollingWindow(capacity=cap, horizon_s=horizon)
+        ref = []          # brute-force: every (t, v) ever written
+        now = 0.0
+        for _ in range(120):
+            if case_rng.random() < 0.6:
+                v = float(case_rng.standard_normal())
+                win.add(v, t=now)
+                ref.append((now, v))
+            else:
+                # advances are mostly small, occasionally a long quiet
+                # stretch that ages out the whole window unwritten
+                now += float(case_rng.uniform(0.1, 4.0)
+                             if case_rng.random() < 0.8
+                             else case_rng.uniform(horizon, 3 * horizon))
+            survivors = [v for t, v in ref[-cap:] if t >= now - horizon]
+            assert sorted(win.values(now=now)) == sorted(survivors), \
+                f"case={case} now={now}"
+        assert win.count == len(ref)
+        np.testing.assert_allclose(win.total, sum(v for _, v in ref))
+
+
 def test_rolling_window_quantile_exact_at_capacity_boundary(rng):
     cap = 64
     for total in (cap - 1, cap, cap + 1, 3 * cap + 5):
@@ -179,6 +211,12 @@ def test_prometheus_exposition_golden():
     agg.on_gauge("serve.queue_depth", 3)
     agg.on_histogram("serve.latency_s", 0.25)
     agg.on_histogram("serve.latency_s", 0.75)
+    # the attribution plane's utilization gauges (ISSUE 17): the exported
+    # names are part of the committed scrape format gauss-top reads
+    agg.on_gauge("util.lane0.device_s_per_s", 0.25)
+    agg.on_gauge("util.lane0.stall_frac", 0.125)
+    agg.on_gauge("util.lane0.flops_frac", 0.0625)
+    agg.on_gauge("util.blocked.achieved_flops_per_s", 2000000)
     snap = agg.snapshot()
     snap["uptime_s"] = 1.5  # pin the only nondeterministic value
     text = obs_export.render_prometheus(snap)
@@ -188,6 +226,11 @@ def test_prometheus_exposition_golden():
     assert "# TYPE gauss_serve_served_total counter" in lines
     assert "gauss_serve_served_total 12" in lines
     assert "gauss_serve_queue_depth 3" in lines
+    assert "# TYPE gauss_util_lane0_device_s_per_s gauge" in lines
+    assert "gauss_util_lane0_device_s_per_s 0.25" in lines
+    assert "gauss_util_lane0_stall_frac 0.125" in lines
+    assert "gauss_util_lane0_flops_frac 0.0625" in lines
+    assert "gauss_util_blocked_achieved_flops_per_s 2000000" in lines
     assert "# TYPE gauss_serve_latency_s summary" in lines
     assert 'gauss_serve_latency_s{quantile="0.5"} 0.5' in lines
     assert "gauss_serve_latency_s_count 2" in lines
@@ -205,6 +248,34 @@ def test_prometheus_exposition_golden():
     q = {labels["quantile"]: v for n, labels, v in samples
          if n == "gauss_serve_latency_s" and labels}
     assert q["0.5"] == 0.5
+
+
+def test_gauss_top_utilization_panel_golden():
+    # The attribution plane's gauges render as the utilization panel; the
+    # panel is absent entirely when no gauss_util_* gauge is exported
+    # (ServeConfig(attr=None) — byte-identical scrape to pre-attr builds).
+    agg = obs_live.LiveAggregator()
+    agg.on_gauge("util.lane0.device_s_per_s", 0.5)
+    agg.on_gauge("util.lane0.stall_frac", 0.25)
+    agg.on_gauge("util.lane0.achieved_flops_per_s", 1.5e6)
+    agg.on_gauge("util.lane0.flops_frac", 0.125)
+    agg.on_gauge("util.blocked.achieved_flops_per_s", 3e6)
+    agg.on_gauge("util.blocked.flops_frac", 0.25)
+    text = obs_export.render_prometheus(agg.snapshot())
+    frame = obs_top.render(obs_top._View(obs_top.parse_metrics(text)),
+                           "test://")
+    assert "  utilization (attribution plane):" in frame
+    lane = next(ln for ln in frame.splitlines() if "lane 0:" in ln)
+    assert "1500000 flop/s achieved" in lane
+    assert "(0.1250 of peak)" in lane and "stall 0.2500" in lane
+    assert "device-s/s 0.5000" in lane
+    eng = next(ln for ln in frame.splitlines() if "engine blocked:" in ln)
+    assert "3000000 flop/s achieved (0.2500 of peak)" in eng
+    # attr off: no gauss_util_* gauges -> no panel
+    plain = obs_top.render(obs_top._View(obs_top.parse_metrics(
+        obs_export.render_prometheus(obs_live.LiveAggregator().snapshot()))),
+        "test://")
+    assert "utilization" not in plain
 
 
 def test_metric_name_mangling():

@@ -1,0 +1,371 @@
+"""Attribution-plane tests (ISSUE 17): the AttributionMatrix cell/roofline/
+capacity math, the util.* gauge fan-out through the live hooks, gauss-prof's
+folded stacks / top tables / roofline series, per-request cost accounting
+through the serving plane (and the attr=None byte-identity contract), the
+summarizer's utilization section, and the ratchet-failure phase-attribution
+path (regress.attribute_phases / doctor.profile_from_phases).
+
+All CPU (conftest pins the platform); serving tests use the smallest ladder
+so the jitted-executable set stays tiny.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import attr, doctor, prof, regress, summarize
+from gauss_tpu.obs import export as obs_export
+from gauss_tpu.obs import live as obs_live
+from gauss_tpu.serve import STATUS_OK, ServeConfig, SolverServer
+from gauss_tpu.serve import loadgen
+
+LADDER = (16, 32)
+
+PEAKS = attr.Peaks(flops_per_s=1e9, bytes_per_s=1e10, source="env")
+
+
+def _system(rng, n, k=None):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    b = rng.standard_normal(n) if k is None else rng.standard_normal((n, k))
+    return a, b
+
+
+def _config(**over):
+    kw = dict(ladder=LADDER, max_batch=4, panel=16, refine_steps=1,
+              verify_gate=1e-4)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+# -- peaks + budgets --------------------------------------------------------
+
+def test_peaks_env_override(monkeypatch):
+    monkeypatch.setenv("GAUSS_PEAK_FLOPS", "2.5e12")
+    monkeypatch.setenv("GAUSS_PEAK_BYTES", "8e11")
+    p = attr.calibrate_peaks()
+    assert p.source == "env"
+    assert p.flops_per_s == 2.5e12 and p.bytes_per_s == 8e11
+    assert p.to_dict()["source"] == "env"
+
+
+def test_peaks_measured_is_cached_and_positive(monkeypatch):
+    monkeypatch.delenv("GAUSS_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("GAUSS_PEAK_BYTES", raising=False)
+    p = attr.calibrate_peaks(n=64, repeats=1, refresh=True)
+    assert p.source == "measured"
+    assert p.flops_per_s > 0 and p.bytes_per_s > 0
+    # cached: second call returns the same object, no re-measurement
+    assert attr.calibrate_peaks() is p
+
+
+def test_lu_budgets_analytic_math():
+    n, k = 32, 2
+    # factor (2/3)n^3 + one solve pass of 2 n^2 k
+    assert attr.lu_flop_budget(n, k) == pytest.approx(
+        (2 / 3) * n ** 3 + 2 * n * n * k)
+    # refinement rounds add solve passes; batch scales linearly
+    base = attr.lu_flop_budget(n, k, refine_steps=0)
+    assert attr.lu_flop_budget(n, k, refine_steps=2) == pytest.approx(
+        base + 2 * (2 * n * n * k))
+    assert attr.lu_flop_budget(n, k, batch=3) == pytest.approx(3 * base)
+    assert attr.lu_byte_budget(n, k, itemsize=4) == pytest.approx(
+        (n * n + n * k) * 4 * 2)
+    assert attr.lu_byte_budget(n, k, batch=2, refine_steps=1) == \
+        pytest.approx((n * n + n * k) * 4 * 3 * 2)
+
+
+# -- the matrix -------------------------------------------------------------
+
+def test_matrix_cells_roofline_capacity():
+    m = attr.AttributionMatrix(peaks=PEAKS)
+    m.observe("serve_batch", "exe_a", 0.5, engine="blocked", lane=0,
+              requests=4, flops=1e8, bytes_accessed=1e6, compile_s=0.25,
+              sig="f32/b16")
+    m.observe("serve_batch", "exe_a", 0.5, engine="blocked", lane=0,
+              requests=4, flops=1e8, sig="f32/b16")
+    m.observe("warmup", "exe_b", 0.125, engine="blocked", lane=1)
+    m.observe("stream", "oc_exe", 2.0, engine="outofcore", stall_frac=0.25)
+
+    cells = m.top_cells()
+    assert cells[0]["exe"] == "oc_exe"  # sorted by device-seconds
+    cell = next(c for c in cells if c["exe"] == "exe_a")
+    assert cell["seconds"] == 1.0 and cell["calls"] == 2
+    assert cell["requests"] == 8 and cell["compile_s"] == 0.25
+    assert cell["flops"] == 2e8
+
+    roof = m.roofline()
+    assert sorted(m.engine_names()) == ["blocked", "outofcore"]
+    blk = roof["blocked"]
+    # 2e8 flops over 1.125 engine-seconds; frac against the 1e9 peak
+    assert blk["achieved_flops_per_s"] == pytest.approx(2e8 / 1.125)
+    assert blk["flops_frac"] == pytest.approx(2e8 / 1.125 / 1e9, rel=1e-4)
+    assert blk["achieved_bytes_per_s"] == pytest.approx(1e6 / 1.125)
+    assert "stall_frac" not in blk  # no ledger-measured stalls
+    assert roof["outofcore"]["stall_frac"] == 0.25
+
+    cap = m.capacity()
+    # only serve* phases count toward the serving capacity total
+    assert cap["serve_device_s"] == pytest.approx(1.0)
+    sig = cap["sigs"]["f32/b16"]
+    assert sig["requests"] == 8 and sig["device_s"] == pytest.approx(1.0)
+    assert sig["device_s_per_request"] == pytest.approx(0.125)
+    assert sig["est_requests_per_s"] == pytest.approx(8.0)
+    assert set(cap["lanes"]) == {"0", "1"}
+
+    snap = m.snapshot()
+    assert snap["observes"] == 4
+    assert snap["device_s_total"] == pytest.approx(3.125)
+    assert snap["peaks"]["source"] == "env"
+
+
+def test_matrix_forwards_attr_events_and_util_gauges():
+    agg = obs_live.LiveAggregator()
+    prev = obs_live.install(agg)
+    try:
+        m = attr.AttributionMatrix(peaks=PEAKS)
+        m.observe("serve_batch", "exe", 0.25, lane=2, flops=1e6)
+    finally:
+        obs_live.uninstall(prev)
+    snap = agg.snapshot()
+    g = snap["gauges"]
+    assert g["util.lane2.device_s_per_s"] > 0
+    assert 0.0 <= g["util.lane2.stall_frac"] <= 1.0
+    assert g["util.lane2.achieved_flops_per_s"] == pytest.approx(4e6)
+    assert g["util.lane2.flops_frac"] == pytest.approx(4e6 / 1e9, rel=1e-4)
+    assert g["util.blocked.achieved_flops_per_s"] == pytest.approx(4e6)
+    assert "util.exec_s" in snap["windows"]
+
+
+def test_install_uninstall_and_status():
+    assert attr.active() is None
+    assert attr.status() == {"recording": False}
+    assert obs_export.attr_status() == {"recording": False}
+    m = attr.AttributionMatrix(peaks=PEAKS)
+    prev = attr.install(m)
+    try:
+        assert attr.active() is m
+        st = obs_export.attr_status()
+        assert st["recording"] is True and st["observes"] == 0
+    finally:
+        attr.uninstall(prev)
+    assert attr.active() is None
+
+
+# -- gauss-prof: folds + tables + roofline ----------------------------------
+
+def _span(name, dur, parent=None):
+    ev = {"type": "span", "name": name, "dur_s": dur}
+    if parent:
+        ev["parent"] = parent
+    return ev
+
+
+def test_folded_stacks_self_time_and_round_trip():
+    events = [
+        _span("root", 1.0),
+        _span("child", 0.4, parent="root"),
+        _span("leaf", 0.1, parent="child"),
+        _span("child", 0.2, parent="root"),
+    ]
+    folds = prof.folded_stacks(events)
+    # parents carry SELF time: root 1.0 - 0.6, child 0.6 - 0.1
+    assert folds["root"] == pytest.approx(0.4)
+    assert folds["root;child"] == pytest.approx(0.5)
+    assert folds["root;child;leaf"] == pytest.approx(0.1)
+    lines = prof.fold_lines(folds)
+    assert lines == sorted(lines)  # deterministic order
+    assert prof.fold_lines(prof.parse_folded(lines)) == lines
+    # malformed lines are ignored, not fatal
+    assert prof.parse_folded(["", "noval", "a;b 100"]) == {"a;b": 1e-4}
+
+
+def test_top_executables_and_span_fallback():
+    events = [
+        {"type": "attr", "phase": "serve_batch", "exe": "exe_a", "lane": 0,
+         "engine": "blocked", "seconds": 0.3, "requests": 2, "flops": 5.0},
+        {"type": "attr", "phase": "serve_batch", "exe": "exe_a", "lane": 0,
+         "engine": "blocked", "seconds": 0.2, "requests": 1},
+        {"type": "attr", "phase": "warmup", "exe": "exe_b", "lane": 1,
+         "engine": "blocked", "seconds": 0.1, "requests": 1},
+    ]
+    rows = prof.top_executables(events, 10)
+    assert [r["exe"] for r in rows] == ["exe_a", "exe_b"]
+    assert rows[0]["seconds"] == pytest.approx(0.5)
+    assert rows[0]["requests"] == 3 and rows[0]["calls"] == 2
+    # streams that predate the plane fall back to span-name totals
+    rows = prof.top_executables([_span("factor", 0.2), _span("factor", 0.1)])
+    assert rows[0]["phase"] == "factor"
+    assert rows[0]["seconds"] == pytest.approx(0.3)
+
+
+def test_roofline_series_reads_peaks_from_stream():
+    events = [
+        {"type": "attr_plane", "event": "start", "flops_per_s": 1e9,
+         "bytes_per_s": 1e10, "source": "env"},
+        {"type": "attr", "phase": "serve_batch", "exe": "e", "lane": 0,
+         "engine": "blocked", "seconds": 0.5, "requests": 1, "flops": 1e8,
+         "bytes": 1e6},
+        {"type": "attr", "phase": "stream", "exe": "oc", "lane": 0,
+         "engine": "outofcore", "seconds": 1.0, "requests": 1,
+         "stall_frac": 0.5},
+    ]
+    roof = prof.roofline_series(events)
+    assert roof["blocked"]["achieved_flops_per_s"] == pytest.approx(2e8)
+    # fractions divide by the peaks the STREAM recorded, not a fresh local
+    # calibration — the run's own ceiling is the honest denominator
+    assert roof["blocked"]["flops_frac"] == pytest.approx(0.2)
+    assert roof["blocked"]["bytes_frac"] == pytest.approx(2e6 / 1e10)
+    assert roof["outofcore"]["stall_frac"] == pytest.approx(0.5)
+
+
+# -- cost accounting through the serve plane --------------------------------
+
+def test_serve_result_cost_fields_with_attr_on(rng):
+    with SolverServer(_config(attr=True)) as srv:
+        assert srv.attr is not None
+        handles = [srv.submit(*_system(rng, 24)) for _ in range(3)]
+        results = [h.result(60.0) for h in handles]
+        assert all(r.status == STATUS_OK for r in results)
+        # every served request carries its device-seconds share; compile
+        # seconds amortize over the batch that paid them
+        assert all(isinstance(r.device_s, float) and r.device_s > 0
+                   for r in results)
+        assert all(isinstance(r.compile_s, float) and r.compile_s >= 0
+                   for r in results)
+        cap = srv.attr.capacity()
+        assert cap["serve_device_s"] > 0
+        assert cap["sigs"]  # per-compat-sig capacity model populated
+        for row in cap["sigs"].values():
+            assert row["device_s_per_request"] > 0
+            assert row["est_requests_per_s"] > 0
+    # server stop uninstalls the plane
+    assert attr.active() is None
+
+
+def test_serve_attr_off_is_byte_identical(rng, tmp_path):
+    stream = tmp_path / "plain.jsonl"
+    with obs.run(metrics_out=str(stream), tool="t"):
+        with SolverServer(_config()) as srv:
+            assert srv.attr is None
+            r = srv.submit(*_system(rng, 20)).result(60.0)
+            assert r.status == STATUS_OK
+            # the byte-identity contract: no cost fields, no lane
+            # device_s key, no attr/attr_plane events on the stream
+            assert r.device_s is None and r.compile_s is None
+            if srv._lanes is not None:
+                for ln in srv._lanes.stats():
+                    assert "device_s" not in ln
+    text = stream.read_text()
+    assert '"attr"' not in text and '"attr_plane"' not in text
+    assert '"device_s"' not in text and '"cost"' not in text
+
+
+@pytest.mark.slow
+def test_loadgen_cost_section_reconciles(rng):
+    cfg = _config(attr=True, max_queue=64)
+    lg = loadgen.LoadgenConfig(mix="random:20*2,random:24", requests=12,
+                               warmup=2, mode="closed", concurrency=2,
+                               seed=7, verify_gate=1e-4, serve=cfg)
+    with SolverServer(cfg) as srv:
+        summary = loadgen.run_load(srv, lg)
+    cost = summary["cost"]
+    assert cost["request_device_s"] > 0
+    assert cost["device_s_per_request"] > 0
+    # the reconcile identity prof-check gates: client-visible device cost
+    # (served + warmup) equals the matrix's serve-phase total
+    req = cost["request_device_s"] + cost["warmup_device_s"]
+    tol = max(1e-3, 0.01 * cost["serve_device_s"])
+    assert abs(req - cost["serve_device_s"]) <= tol
+    assert cost["sigs"]
+    text = loadgen.format_summary(summary)
+    assert "cost:" in text and "matrix serve total" in text
+
+
+def test_loadgen_summary_has_no_cost_key_with_attr_off(rng):
+    cfg = _config(max_queue=64)
+    lg = loadgen.LoadgenConfig(mix="random:20", requests=3, warmup=1,
+                               mode="closed", concurrency=1, seed=7,
+                               verify_gate=1e-4, serve=cfg)
+    with SolverServer(cfg) as srv:
+        summary = loadgen.run_load(srv, lg)
+    assert "cost" not in summary
+    assert "cost:" not in loadgen.format_summary(summary)
+
+
+# -- summarize utilization section ------------------------------------------
+
+def test_summarize_utilization_section(tmp_path, capsys):
+    path = tmp_path / "m.jsonl"
+    run = {"type": "run_start", "run": "r1", "tool": "t"}
+    events = [
+        run,
+        {"type": "attr_plane", "run": "r1", "event": "start",
+         "flops_per_s": 1e9, "bytes_per_s": 1e10, "source": "env"},
+        {"type": "attr", "run": "r1", "phase": "serve_batch", "exe": "e",
+         "lane": 0, "engine": "blocked", "seconds": 0.5, "requests": 4,
+         "flops": 1e8, "compile_s": 0.125},
+    ]
+    ut = summarize.utilization_summary(events)
+    assert ut["observes"] == 1
+    assert ut["device_s_total"] == pytest.approx(0.5)
+    assert ut["compile_s"] == pytest.approx(0.125)
+    assert ut["by_phase"]["serve_batch"]["requests"] == 4
+    assert ut["roofline"]["blocked"]["flops_frac"] == pytest.approx(0.2)
+    assert ut["peaks"]["source"] == "env"
+    # attr-off streams carry no utilization noise
+    assert summarize.utilization_summary([run]) == {}
+    # the section renders in text and rides the --json document
+    with path.open("w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    assert summarize.main([str(path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["r1"]["utilization"]["observes"] == 1
+    assert summarize.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "utilization (device-time attribution):" in out
+    assert "CPU-proxy" in out
+
+
+# -- ratchet-failure auto-attribution ---------------------------------------
+
+def test_attribute_phases_names_the_guilty_phase():
+    prior = {"prepare": 0.1, "slope": 1.0, "verify": 0.2}
+    fresh = {"prepare": 0.1, "slope": 2.2, "verify": 0.2}
+    text = regress.attribute_phases(fresh, prior, fresh_label="this run",
+                                    prior_label="r03")
+    assert "biggest regression contributor: slope" in text
+    assert "this run" in text and "r03" in text
+    # either side missing phases -> None (records predating phases_s)
+    assert regress.attribute_phases({}, prior) is None
+    assert regress.attribute_phases(fresh, {}) is None
+
+
+def test_profile_from_phases_adapter_rides_doctor_diff():
+    a = doctor.profile_from_phases({"x": 0.5, "y": 0.25}, path="a")
+    assert a["profile"]["span_total_s"] == pytest.approx(0.75)
+    assert a["profile"]["phases"]["x"] == {"seconds": 0.5, "calls": 1}
+    b = doctor.profile_from_phases({"x": 0.9, "y": 0.25}, path="b")
+    diff = doctor.diff_profiles(a, b)
+    assert diff["phases"][0]["phase"] == "x"  # sorted by delta desc
+    assert diff["phases"][0]["delta_s"] == pytest.approx(0.4)
+    assert "biggest regression contributor: x" in doctor.format_diff(diff)
+
+
+# -- profcheck history records ----------------------------------------------
+
+def test_profcheck_history_records_shape():
+    from gauss_tpu.obs import profcheck
+
+    summary = {"reconcile": {"throughput_rps": 200.0,
+                             "device_s_per_request": 0.002}}
+    recs = profcheck.history_records(summary)
+    assert ("prof:attr_s_per_request", 0.005, "s") in recs
+    assert ("prof:device_s_per_request", 0.002, "s") in recs
+    # non-positive / missing values never poison the history
+    assert profcheck.history_records({"reconcile": {}}) == []
+    assert profcheck.history_records(
+        {"reconcile": {"throughput_rps": 0.0}}) == []
