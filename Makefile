@@ -16,11 +16,12 @@ MESH_SMOKE ?= /tmp/gauss_mesh_serve_check
 LINT_SMOKE ?= /tmp/gauss_lint_check
 FLIGHT_SMOKE ?= /tmp/gauss_flight_check
 PROF_SMOKE ?= /tmp/gauss_prof_check
+SPARSE_SMOKE ?= /tmp/gauss_sparse_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
-	structure-check tune-check live-check abft-check durable-check \
-	outofcore-check mesh-serve-check lint-check flight-check prof-check \
-	clean
+	structure-check sparse-check tune-check live-check abft-check \
+	durable-check outofcore-check mesh-serve-check lint-check flight-check \
+	prof-check clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -149,6 +150,30 @@ structure-check:
 	st=[r['structure'] for r in runs.values() if r.get('structure')]; \
 	assert st and st[0]['solves'] >= 4 and st[0]['demotions'] == 0, st; \
 	print('structure-check: structure summary ok:', st[0]['engines'])"
+
+# The sparse-plane gate (CI-callable): coordinate classification ->
+# sparse routing (no demotion) -> CG/GMRES/BiCGStab each verified at the
+# 1e-4 gate, then the n=100k no-densify leg — assembled and CG-solved
+# with the process peak RSS asserted under a budget the dense operand
+# alone (80 GB) exceeds tenfold (exit 2 on any leg), gated against the
+# regression history (kind=sparse_solve; exit 1 when per-method seconds/
+# iterations or the giant leg's peak bytes leave the band), then the
+# recorded stream is asserted to carry a sparse summary with every
+# attempt converged.
+sparse-check:
+	rm -rf $(SPARSE_SMOKE) && mkdir -p $(SPARSE_SMOKE)
+	JAX_PLATFORMS=cpu $(PYTHON) -m gauss_tpu.sparse.check \
+	  --smoke-n 640 --nnz-per-row 6 \
+	  --giant-n 100000 --giant-nnz-per-row 20 --seed 258458 \
+	  --metrics-out $(SPARSE_SMOKE)/sparse.jsonl \
+	  --summary-json $(SPARSE_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(SPARSE_SMOKE)/sparse.jsonl \
+	  --json | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	sp=[r['sparse'] for r in runs.values() if r.get('sparse')]; \
+	assert sp and sp[0]['attempts'] >= 5 and all( \
+	m['converged'] == m['attempts'] for m in sp[0]['methods'].values()), sp; \
+	print('sparse-check: sparse summary ok:', \
+	sorted(sp[0]['methods']))"
 
 # The autotuner gate (CI-callable): micro-sweep (2 points per axis)
 # through the real gauss-tune runner -> store written -> the tuned solve

@@ -56,12 +56,16 @@ TUNE_SOURCED = (
      "ROWELIM_TILE_SEED"),
     ("gauss_tpu/outofcore/stream.py", "OUTOFCORE_DEVICE_FRAC",
      "OUTOFCORE_DEVICE_FRAC_SEED"),
+    ("gauss_tpu/structure/detect.py", "SPARSE_MAX_DENSITY",
+     "SPARSE_DENSITY_SEED"),
 )
 
 #: files that must REFERENCE a tune.space seed (no module-level constant
 #: of their own — the seed is consumed inline).
 TUNE_REFERENCED = (
     ("gauss_tpu/kernels/matmul_pallas.py", "MM_TILE_SEED"),
+    ("gauss_tpu/sparse/krylov.py", "SPARSE_RESTART_SEED"),
+    ("gauss_tpu/sparse/precond.py", "SPARSE_BLOCK_SEED"),
 )
 
 #: CLIs whose long flags must have docs/API.md coverage.

@@ -6,10 +6,14 @@ falls back to the Python writer.
 
 ``--structure`` extends the reference surface with the structure classes the
 router (:mod:`gauss_tpu.structure`) recognizes — ``spd``, ``banded:<b>``,
-``blockdiag:<k>``, ``dense`` — in the SAME reference-compatible ``.dat``
-coordinate format (sparse classes drop exact zeros, which is exactly what a
-coordinate format is for), so datasets, serving loadgen mixes, and the
-chaos campaign can exercise the structured engines end to end.
+``blockdiag:<k>``, ``dense``, ``sparse:<nnz_per_row>`` — in the SAME
+reference-compatible ``.dat`` coordinate format (sparse classes drop exact
+zeros, which is exactly what a coordinate format is for), so datasets,
+serving loadgen mixes, and the chaos campaign can exercise the structured
+engines end to end. The ``sparse`` mode emits its coordinates DIRECTLY
+(io.synthetic.sparse_coords -> write_dat): no n x n buffer exists at any
+point, so ``gauss-matrix-gen 1000000 --structure sparse:8`` is an O(nnz)
+operation end to end.
 """
 
 from __future__ import annotations
@@ -22,9 +26,12 @@ from gauss_tpu.io import datfile, synthetic
 
 
 def structured_matrix(n: int, structure: str):
-    """Build the matrix for a ``--structure`` spec; returns
-    ``(matrix, drop_zeros)``. Specs: ``spd``, ``banded:<b>`` (default b=1),
-    ``blockdiag:<k>`` (block size, default max(1, n // 8)), ``dense``."""
+    """Build the operand for a ``--structure`` spec; returns
+    ``(matrix, drop_zeros)`` where ``matrix`` is a dense ndarray for the
+    dense-backed classes and a ``(rows, cols, vals)`` coordinate triple for
+    ``sparse`` (which is never densified). Specs: ``spd``, ``banded:<b>``
+    (default b=1), ``blockdiag:<k>`` (block size, default max(1, n // 8)),
+    ``dense``, ``sparse:<nnz_per_row>`` (default 8)."""
     kind, _, arg = structure.partition(":")
     if kind == "spd":
         return synthetic.spd_matrix(n), False
@@ -35,9 +42,15 @@ def structured_matrix(n: int, structure: str):
         return synthetic.blockdiag_matrix(n, block), True
     if kind == "dense":
         return synthetic.dense_matrix(n), False
+    if kind == "sparse":
+        nnz_per_row = int(arg) if arg else 8
+        if nnz_per_row < 1:
+            raise ValueError(
+                f"sparse:<nnz_per_row> must be >= 1, got {nnz_per_row}")
+        return synthetic.sparse_coords(n, nnz_per_row=nnz_per_row), True
     raise ValueError(
         f"unknown --structure {structure!r}; options: spd, banded:<b>, "
-        f"blockdiag:<k>, dense")
+        f"blockdiag:<k>, dense, sparse:<nnz_per_row>")
 
 
 def main(argv=None) -> int:
@@ -85,14 +98,21 @@ def main(argv=None) -> int:
             except Exception:
                 rc = None  # fall back to Python below
         if rc is None:
-            # Values are small integers or exact powers of rho; .17g
-            # prints them with an exact float64 round trip either way.
+            # Values are small integers, exact powers of rho, or float64
+            # draws; .17g prints them with an exact round trip either way.
             with obs.span("generate_python"):
-                datfile.write_dat(
-                    sys.stdout,
-                    matrix if matrix is not None
-                    else synthetic.generator_matrix(args.n),
-                    drop_zeros=drop_zeros)
+                if isinstance(matrix, tuple):
+                    # The sparse class: coordinates straight to the
+                    # writer — no n x n buffer at any n.
+                    rows, cols, vals = matrix
+                    datfile.write_dat(sys.stdout, n=args.n, rows=rows,
+                                      cols=cols, vals=vals)
+                else:
+                    datfile.write_dat(
+                        sys.stdout,
+                        matrix if matrix is not None
+                        else synthetic.generator_matrix(args.n),
+                        drop_zeros=drop_zeros)
             rc = 0
     if args.metrics_out:
         print(f"Metrics: run {rec.run_id} appended to {args.metrics_out}",

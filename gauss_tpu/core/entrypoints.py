@@ -228,6 +228,78 @@ def _band_blocklu_entry():
     return build
 
 
+def _sparse_system():
+    """A small certified-SPD sparse operand in ELL staging plus an RHS —
+    shared by the sparse SpMV/Krylov trace builders."""
+    import numpy as np
+
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.sparse.csr import CsrMatrix
+
+    rows, cols, vals = synthetic.sparse_coords(AUDIT_N, nnz_per_row=5,
+                                               seed=3)
+    a = CsrMatrix.from_coords(AUDIT_N, rows, cols, vals)
+    rng = np.random.default_rng(4)
+    b = rng.standard_normal(AUDIT_N).astype(np.float32)
+    ecols, evals = a.ell()
+    return ecols, evals.astype(np.float32), b
+
+
+def _spmv_entry(pallas: bool = False):
+    def build():
+        from gauss_tpu.sparse import spmv
+
+        cols, vals, x = _sparse_system()
+        if pallas:
+            return (lambda c, v, u: spmv.spmv_ell_pallas(c, v, u, bm=32)), \
+                (cols, vals, x), {}
+        return spmv.spmv_ell, (cols, vals, x), {}
+    return build
+
+
+def _spmv_coo_entry():
+    def build():
+        from gauss_tpu.sparse.csr import CsrMatrix
+        from gauss_tpu.io import synthetic
+        from gauss_tpu.sparse import spmv
+        import numpy as np
+
+        rows, cols, vals = synthetic.sparse_coords(AUDIT_N, nnz_per_row=5,
+                                                   seed=3)
+        a = CsrMatrix.from_coords(AUDIT_N, rows, cols, vals)
+        r, c, v = a.coo()
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal(AUDIT_N).astype(np.float32)
+        return (lambda rr, cc, vv, u: spmv.spmv_coo(rr, cc, vv, u,
+                                                    n=AUDIT_N)), \
+            (r, c, v.astype(np.float32), x), {}
+    return build
+
+
+def _krylov_entry(method: str):
+    """Trace one Krylov while_loop core (unpreconditioned form — the
+    preconditioner pytree only adds the registered tridiag/scan programs).
+    Traced at f32; the host wrappers run the same program under
+    enable_x64, hence the refinement flag on these entries."""
+    def build():
+        from gauss_tpu.sparse import krylov
+
+        cols, vals, b = _sparse_system()
+        x0 = b * 0.0
+        tol = 1e-4
+        if method == "cg":
+            fn = lambda c, v, rhs, x: krylov.cg_run(  # noqa: E731
+                c, v, rhs, x, None, tol, maxiter=8)
+        elif method == "gmres":
+            fn = lambda c, v, rhs, x: krylov.gmres_run(  # noqa: E731
+                c, v, rhs, x, None, tol, restart=4, maxcycles=2)
+        else:
+            fn = lambda c, v, rhs, x: krylov.bicgstab_run(  # noqa: E731
+                c, v, rhs, x, None, tol, maxiter=8)
+        return fn, (cols, vals, b, x0), {}
+    return build
+
+
 def _serve_exe(dtype: str):
     from gauss_tpu.serve.cache import BatchedExecutable, CacheKey
 
@@ -306,6 +378,17 @@ def entry_points() -> List[EntryPoint]:
         EntryPoint("chol/solve", _chol_entry(solve=True)),
         EntryPoint("banded/thomas", _tridiag_entry()),
         EntryPoint("banded/blocklu", _band_blocklu_entry()),
+        # the sparse plane: SpMV staging forms + the Krylov while_loop
+        # cores (refinement: the host wrappers run these f64 under
+        # enable_x64 — iterating TO the gate is the design, not a
+        # precision accident).
+        EntryPoint("sparse/spmv", _spmv_entry()),
+        EntryPoint("sparse/spmv/pallas", _spmv_entry(pallas=True)),
+        EntryPoint("sparse/spmv/coo", _spmv_coo_entry()),
+        EntryPoint("sparse/cg", _krylov_entry("cg"), refinement=True),
+        EntryPoint("sparse/gmres", _krylov_entry("gmres"), refinement=True),
+        EntryPoint("sparse/bicgstab", _krylov_entry("bicgstab"),
+                   refinement=True),
         # the serve plane's compiled lanes (vmap-batched factor+solve).
         EntryPoint("serve/factor", _serve_entry("float32", solve=False)),
         EntryPoint("serve/solve", _serve_entry("float32", solve=True),
@@ -345,6 +428,12 @@ REGISTERED_FUNCS = {
     "gauss_tpu.structure.cholesky:resolve_chol_factor",
     "gauss_tpu.structure.banded:solve_tridiag",
     "gauss_tpu.structure.banded:solve_band_blocklu",
+    "gauss_tpu.sparse.spmv:spmv_ell",
+    "gauss_tpu.sparse.spmv:spmv_ell_pallas",
+    "gauss_tpu.sparse.spmv:spmv_coo",
+    "gauss_tpu.sparse.krylov:cg_run",
+    "gauss_tpu.sparse.krylov:gmres_run",
+    "gauss_tpu.sparse.krylov:bicgstab_run",
     "gauss_tpu.outofcore.stream:lu_factor_outofcore",
     "gauss_tpu.outofcore.stream:lu_solve_outofcore",
     "gauss_tpu.outofcore.stream:solve_outofcore",
@@ -398,6 +487,16 @@ EXEMPT_FUNCS: Dict[str, str] = {
         "host detect->route->recovery-ladder driver",
     "gauss_tpu.resilience.recover:solve_resilient":
         "host recovery ladder over registered/exempt rungs",
+    "gauss_tpu.sparse.krylov:solve_cg":
+        "host wrapper: Gershgorin certification + f64 staging + the "
+        "1e-4 true-residual verify around the registered sparse/cg core",
+    "gauss_tpu.sparse.krylov:solve_gmres":
+        "host wrapper around the registered sparse/gmres core",
+    "gauss_tpu.sparse.krylov:solve_bicgstab":
+        "host wrapper around the registered sparse/bicgstab core",
+    "gauss_tpu.sparse.solve:solve_sparse":
+        "host method router (certify -> cg | gmres -> bicgstab) over the "
+        "registered Krylov cores; emits sparse_solve events",
 }
 
 #: modules the completeness rule scans for public solve entry points.
@@ -414,6 +513,9 @@ AUDIT_MODULES = (
     "gauss_tpu.resilience.recover",
     "gauss_tpu.resilience.abft",
     "gauss_tpu.resilience.checkpoint",
+    "gauss_tpu.sparse.spmv",
+    "gauss_tpu.sparse.krylov",
+    "gauss_tpu.sparse.solve",
 )
 
 #: a public callable with one of these prefixes is a solve entry point.

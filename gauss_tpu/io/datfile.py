@@ -22,6 +22,33 @@ A faster C++ parser for large files is provided by :mod:`gauss_tpu.native`
 (``read_dat_dense(..., engine="native")`` uses it when built). The native
 parser does not run the strict per-line checks; ``read_dat_dense`` applies
 a whole-matrix finite check to its output instead.
+
+**Duplicate-coordinate semantics.** A ``.dat`` file may name the same
+``(row, col)`` twice; the three consumers resolve that differently, on
+purpose, and the differences are pinned by tests (tests/test_sparse.py):
+
+- ``strict=True`` (every reader's default): duplicates are a CORRUPT
+  file — two generators disagreeing about one entry — and parsing fails
+  with a typed :class:`DatFormatError` naming both lines. No consumer
+  downstream ever sees an ambiguous matrix.
+- ``strict=False``, dense path (:func:`read_dat` + :func:`densify`): the
+  reference's fscanf loop scatters entries in file order, so the LAST
+  occurrence wins — bug-parity with gauss_external_input.c's initMatrix.
+- ``strict=False``, sparse assembly
+  (:meth:`gauss_tpu.sparse.csr.CsrMatrix.from_dat`): coordinates are
+  SUMMED — the additive convention of finite-element/graph assembly,
+  where duplicate ``(i, j)`` contributions are partial sums by design.
+
+So a tolerant read of a duplicate-bearing file gives ``last-wins`` when
+densified and ``summed`` when assembled sparse. That divergence is
+inherent to the two traditions, which is exactly why ``strict=True``
+refuses to guess.
+
+:func:`iter_coords` is the streaming face of the same parser: the header
+is read eagerly (``.n`` / ``.declared_nnz``), the body is yielded as
+0-indexed ``(rows, cols, vals)`` numpy chunks, and every per-line strict
+check of :func:`read_dat` runs as the stream advances — O(chunk) resident
+text for an O(nnz) file, never an n x n buffer.
 """
 
 from __future__ import annotations
@@ -155,6 +182,169 @@ def read_dat(path_or_file: PathOrFile, strict: bool = True,
     finally:
         if close:
             f.close()
+
+
+class CoordStream:
+    """Streaming ``.dat`` reader: the header eagerly (``.n``,
+    ``.declared_nnz``), the body lazily as 0-indexed ``(rows, cols,
+    vals)`` numpy chunks of at most ``chunk`` entries. Iterate it once;
+    :meth:`gauss_tpu.sparse.csr.CsrMatrix.from_coord_chunks` accepts it
+    directly. All of :func:`read_dat`'s per-line validation (bounds,
+    malformed lines, header/body count mismatch) runs as the stream
+    advances; ``strict`` additionally rejects non-finite values,
+    duplicate coordinates (detected by the same vectorized scan, at end
+    of stream), and a missing ``0 0 0`` terminator."""
+
+    def __init__(self, path_or_file: PathOrFile, strict: bool = True,
+                 chunk: int = 65536):
+        if chunk <= 0:
+            raise ValueError(f"chunk must be positive, got {chunk}")
+        self._f, self._close = _open_maybe(path_or_file, "r")
+        self.strict = bool(strict)
+        self.chunk = int(chunk)
+        self._consumed = False
+        header = self._f.readline().split()
+        try:
+            if len(header) < 3:
+                raise DatFormatError(
+                    "malformed .dat header; expected 'n n nnz'", line=1)
+            try:
+                n, n2, nnz = (int(header[0]), int(header[1]),
+                              int(header[2]))
+            except ValueError as e:
+                raise DatFormatError(
+                    f"malformed .dat header: {' '.join(header[:3])!r}",
+                    line=1) from e
+            if n != n2:
+                raise DatFormatError(
+                    f"non-square matrix in .dat header: {n} x {n2}", line=1)
+            if n < 0 or nnz < 0:
+                raise DatFormatError(
+                    f"negative dimension in .dat header: n={n} nnz={nnz}",
+                    line=1)
+        except Exception:
+            self._finish()
+            raise
+        #: matrix order from the header (available before any body I/O)
+        self.n = n
+        #: entry count the header promises (validated against the body)
+        self.declared_nnz = nnz
+
+    def _finish(self):
+        if self._close and self._f is not None:
+            self._f.close()
+        self._f = None
+
+    def __iter__(self):
+        if self._consumed:
+            raise RuntimeError(
+                "CoordStream is single-pass; construct a new one to re-read")
+        self._consumed = True
+        return self._iterate()
+
+    def _iterate(self):
+        n, nnz, strict = self.n, self.declared_nnz, self.strict
+        rs, cs, vs, ls = [], [], [], []
+        codes_seen, lines_seen = [], []  # strict duplicate scan, per chunk
+        count = 0
+        terminated = False
+        lineno = 1
+        try:
+            for line in self._f:
+                lineno += 1
+                parts = line.split()
+                if not parts:
+                    continue
+                if len(parts) < 2 or (len(parts) < 3 and not (
+                        parts[0] == "0" and parts[1] == "0")):
+                    raise DatFormatError(
+                        f"malformed .dat body line: {line.rstrip()!r}",
+                        line=lineno)
+                try:
+                    r, c = int(parts[0]), int(parts[1])
+                except ValueError as e:
+                    raise DatFormatError(
+                        f"malformed .dat body line: {line.rstrip()!r}",
+                        line=lineno) from e
+                if r == 0 and c == 0:
+                    terminated = True
+                    break
+                if count >= nnz:
+                    raise DatFormatError(
+                        ".dat body has more entries than header nnz",
+                        line=lineno)
+                if not (1 <= r <= n and 1 <= c <= n):
+                    raise DatFormatError(
+                        f".dat entry ({r}, {c}) out of bounds for 1-indexed "
+                        f"{n} x {n} matrix", line=lineno)
+                try:
+                    v = float(parts[2])
+                except ValueError as e:
+                    raise DatFormatError(
+                        f"malformed .dat body line: {line.rstrip()!r}",
+                        line=lineno) from e
+                if strict and not np.isfinite(v):
+                    raise DatFormatError(
+                        f"non-finite value {parts[2]!r} at entry ({r}, {c});"
+                        f" a NaN/Inf entry poisons every downstream solve",
+                        line=lineno)
+                rs.append(r - 1)
+                cs.append(c - 1)
+                vs.append(v)
+                ls.append(lineno)
+                count += 1
+                if len(rs) >= self.chunk:
+                    rows = np.asarray(rs, dtype=np.int64)
+                    cols = np.asarray(cs, dtype=np.int64)
+                    if strict:
+                        codes_seen.append(rows * np.int64(n) + cols)
+                        lines_seen.append(np.asarray(ls, dtype=np.int64))
+                    yield rows, cols, np.asarray(vs, dtype=np.float64)
+                    rs, cs, vs, ls = [], [], [], []
+            if count != nnz:
+                raise DatFormatError(
+                    f".dat body has {count} entries, header promised {nnz}",
+                    line=lineno)
+            if strict and not terminated:
+                raise DatFormatError(
+                    "missing '0 0 0' terminator (truncated file?); pass "
+                    "strict=False to accept EOF-terminated files",
+                    line=lineno)
+            if rs:
+                rows = np.asarray(rs, dtype=np.int64)
+                cols = np.asarray(cs, dtype=np.int64)
+                if strict:
+                    codes_seen.append(rows * np.int64(n) + cols)
+                    lines_seen.append(np.asarray(ls, dtype=np.int64))
+                yield rows, cols, np.asarray(vs, dtype=np.float64)
+            if strict and codes_seen:
+                # Same vectorized duplicate scan as read_dat, over the
+                # accumulated codes (O(nnz) ints — the coordinates a
+                # consumer holds anyway; never the file text or an n^2
+                # buffer).
+                codes = np.concatenate(codes_seen)
+                srclines = np.concatenate(lines_seen)
+                order = np.argsort(codes, kind="stable")
+                dup = np.nonzero(np.diff(codes[order]) == 0)[0]
+                if dup.size:
+                    i1, i2 = order[dup[0]], order[dup[0] + 1]
+                    code = int(codes[i2])
+                    raise DatFormatError(
+                        f"duplicate .dat entry ({code // n + 1}, "
+                        f"{code % n + 1}) (first at line {srclines[i1]}); "
+                        f"the reference's last-wins overwrite is available "
+                        f"via strict=False", line=int(srclines[i2]))
+        finally:
+            self._finish()
+
+
+def iter_coords(path_or_file: PathOrFile, strict: bool = True,
+                chunk: int = 65536) -> CoordStream:
+    """Open a ``.dat`` file for streaming: returns a :class:`CoordStream`
+    whose ``.n`` / ``.declared_nnz`` come from the header immediately and
+    whose iteration yields 0-indexed ``(rows, cols, vals)`` chunks with
+    :func:`read_dat`'s validation applied line by line."""
+    return CoordStream(path_or_file, strict=strict, chunk=chunk)
 
 
 def densify(n: int, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,

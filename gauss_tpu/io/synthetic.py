@@ -115,3 +115,69 @@ def dense_matrix(n: int, rho: float = 0.25, dtype=np.float64) -> np.ndarray:
     a = spd_matrix(n, rho=rho, dtype=np.float64)
     a += np.triu(0.5 * a, 1)
     return a.astype(dtype)
+
+
+def sparse_coords(n: int, nnz_per_row: int = 8, seed: int = 0,
+                  symmetric: bool = True):
+    """Deterministic sparse coordinate system for the Krylov plane:
+    0-indexed ``(rows, cols, vals)`` with on average at most
+    ``nnz_per_row`` stored entries per row, STRICTLY diagonally dominant
+    (``a_ii = 1 + sum_j |a_ij|``), never densified — O(nnz) memory at any
+    n. Symmetric (the default) also carries the Gershgorin SPD
+    certificate, so CG is licensed; ``symmetric=False`` keeps dominance
+    (invertible) but routes the general-system solvers. All values are
+    float64 and round-trip exactly through the ``.dat`` writer's %.17g.
+    """
+    if n <= 0:
+        z = np.zeros(0)
+        return z.astype(np.int64), z.astype(np.int64), z
+    rng = np.random.default_rng(
+        np.random.SeedSequence((seed, n, nnz_per_row, int(symmetric))))
+    # k off-diagonal draws per row; the symmetric mirror doubles them, so
+    # halve the budget there (diagonal always present).
+    k = max(0, (nnz_per_row - 1) // (2 if symmetric else 1))
+    if k and n > 1:
+        rows = np.repeat(np.arange(n, dtype=np.int64), k)
+        cols = rng.integers(0, n - 1, n * k)
+        cols += cols >= rows  # skew past the diagonal
+        vals = rng.uniform(-1.0, 1.0, n * k)
+        if symmetric:
+            # Canonicalize to the upper triangle, drop duplicate slots,
+            # then mirror — exact value symmetry by construction.
+            r = np.minimum(rows, cols)
+            c = np.maximum(rows, cols)
+            codes = r * n + c
+            _, first = np.unique(codes, return_index=True)
+            r, c, vals = r[first], c[first], vals[first]
+            rows = np.concatenate([r, c])
+            cols = np.concatenate([c, r])
+            vals = np.concatenate([vals, vals])
+        else:
+            codes = rows * n + cols
+            _, first = np.unique(codes, return_index=True)
+            rows, cols, vals = rows[first], cols[first], vals[first]
+    else:
+        rows = np.zeros(0, dtype=np.int64)
+        cols = np.zeros(0, dtype=np.int64)
+        vals = np.zeros(0)
+    offsum = np.zeros(n)
+    np.add.at(offsum, rows, np.abs(vals))
+    diag_rows = np.arange(n, dtype=np.int64)
+    return (np.concatenate([rows, diag_rows]),
+            np.concatenate([cols, diag_rows]),
+            np.concatenate([vals, 1.0 + offsum]))
+
+
+def sparse_matrix(n: int, nnz_per_row: int = 8, seed: int = 0,
+                  symmetric: bool = True, dtype=np.float64) -> np.ndarray:
+    """Dense materialization of :func:`sparse_coords` for the SMALL-n
+    consumers that need an ndarray operand (loadgen mixes, tests); the
+    coordinate form is the scalable interface."""
+    if n > 4096:
+        raise ValueError(
+            f"sparse_matrix densifies (n={n} > 4096); use sparse_coords")
+    rows, cols, vals = sparse_coords(n, nnz_per_row, seed=seed,
+                                     symmetric=symmetric)
+    a = np.zeros((n, n), dtype=np.float64)
+    a[rows, cols] = vals
+    return a.astype(dtype)

@@ -230,6 +230,21 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "sparse_solve":
+        # A sparse-check summary (python -m gauss_tpu.sparse.check
+        # --summary-json): per-method seconds-per-solve / iteration counts
+        # and the no-densify giant leg's peak bytes enter history, so a
+        # Krylov regression — slower convergence, a preconditioner losing
+        # its bite, the O(nnz) path quietly densifying — gates in CI like
+        # any perf regression. Derivation lives with the checker (single
+        # source); lazy import keeps jax out of this module.
+        from gauss_tpu.sparse.check import history_records as sparse_hist
+
+        for metric, value, unit in sparse_hist(doc):
+            rec = _record(metric, value, path, "sparse", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "mesh_serve":
         # A mesh-serve-check summary (python -m gauss_tpu.serve.meshcheck
         # --summary-json): the multi-lane serving plane's throughput /

@@ -532,6 +532,60 @@ def _structure_lines(st: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def sparse_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the Krylov plane's ``sparse_solve`` attempts
+    (gauss_tpu.sparse.solve) into per-method lanes: attempts, converged
+    count, iteration totals, worst verified residual, and how many
+    attempts ran on a Gershgorin-certified operand. Empty dict when the
+    run never touched the sparse plane."""
+    solves = [ev for ev in events if ev.get("type") == "sparse_solve"]
+    if not solves:
+        return {}
+    methods: Dict[str, Dict[str, Any]] = {}
+    certified = 0
+    rels: List[float] = []
+    for ev in solves:
+        m = methods.setdefault(str(ev.get("method", "?")), {
+            "attempts": 0, "converged": 0, "iterations": 0,
+            "wall_s": 0.0, "preconds": {}})
+        m["attempts"] += 1
+        if ev.get("converged"):
+            m["converged"] += 1
+        m["iterations"] += int(ev.get("iterations", 0) or 0)
+        m["wall_s"] += float(ev.get("wall_s", 0.0) or 0.0)
+        pk = str(ev.get("precond", "none"))
+        m["preconds"][pk] = m["preconds"].get(pk, 0) + 1
+        if ev.get("certified_spd"):
+            certified += 1
+        if ev.get("converged") and isinstance(ev.get("rel_residual"),
+                                              (int, float)):
+            rels.append(float(ev["rel_residual"]))
+    return {
+        "methods": methods, "attempts": len(solves),
+        "certified_spd": certified,
+        "max_n": max(int(ev.get("n", 0) or 0) for ev in solves),
+        "max_nnz": max(int(ev.get("nnz", 0) or 0) for ev in solves),
+        "worst_rel_residual": max(rels) if rels else None,
+    }
+
+
+def _sparse_lines(sp: Dict[str, Any]) -> List[str]:
+    lines = []
+    for name, m in sorted(sp["methods"].items()):
+        pre = ", ".join(f"{k} x{v}"
+                        for k, v in sorted(m["preconds"].items()))
+        lines.append(f"  {name}: {m['converged']}/{m['attempts']} converged, "
+                     f"{m['iterations']} iter(s), "
+                     f"{_fmt(m['wall_s'])} s  [{pre}]")
+    lines.append(f"  certified SPD: {sp['certified_spd']}/{sp['attempts']} "
+                 f"attempt(s); largest n {sp['max_n']} "
+                 f"({sp['max_nnz']} nnz)")
+    if sp["worst_rel_residual"] is not None:
+        lines.append(f"  worst converged rel residual: "
+                     f"{_fmt(sp['worst_rel_residual'])}")
+    return lines
+
+
 def utilization_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the attribution plane's ``attr`` cell observations
     (gauss_tpu.obs.attr) into one report: device-seconds by phase and by
@@ -838,6 +892,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "durability": durability_summary(evs),
         "slo": slo_summary(evs),
         "structure": structure_summary(evs),
+        "sparse": sparse_summary(evs),
         "utilization": utilization_summary(evs),
         "resilience": resilience_summary(evs),
         "sdc": sdc_summary(evs),
@@ -912,6 +967,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("structure lanes:")
         out.extend(_structure_lines(structure))
+
+    sparse = sparse_summary(evs)
+    if sparse:
+        out.append("")
+        out.append("sparse (Krylov) solves:")
+        out.extend(_sparse_lines(sparse))
 
     util = utilization_summary(evs)
     if util:

@@ -281,6 +281,32 @@ def _rung_blockdiag(a64, b64, panel, iters):
     return blockdiag.solve_blockdiag(a64, b64, refine_steps=iters), None
 
 
+def _rung_cg(a64, b64, panel, iters):
+    """Sparse head rung: conjugate gradients on the CSR form of the
+    operand (gauss_tpu.sparse). An uncertified operand raises the typed
+    NotSPDError before any iteration, stagnation raises the typed
+    IterativeStagnationError — both demote to the general-system Krylov
+    rungs below, then the dense chain."""
+    from gauss_tpu.sparse import solve as _sparse
+
+    return _sparse.solve_sparse(a64, b64, method="cg").x, None
+
+
+def _rung_gmres(a64, b64, panel, iters):
+    """General-system Krylov rung: GMRES(restart); stagnation raises
+    typed and the ladder keeps demoting (bicgstab, then dense)."""
+    from gauss_tpu.sparse import solve as _sparse
+
+    return _sparse.solve_sparse(a64, b64, method="gmres").x, None
+
+
+def _rung_bicgstab(a64, b64, panel, iters):
+    """Last iterative rung before the dense chain: BiCGStab."""
+    from gauss_tpu.sparse import solve as _sparse
+
+    return _sparse.solve_sparse(a64, b64, method="bicgstab").x, None
+
+
 _RUNG_FNS: Dict[str, Callable] = {
     "blocked": _rung_blocked,
     "lowered": _rung_lowered,
@@ -294,6 +320,9 @@ _RUNG_FNS: Dict[str, Callable] = {
     "abft": _rung_abft,
     "abft_chol": _rung_abft_chol,
     "outofcore": _rung_outofcore,
+    "cg": _rung_cg,
+    "gmres": _rung_gmres,
+    "bicgstab": _rung_bicgstab,
 }
 
 #: rungs backed by the checksum-carrying factorizations — the ladder
@@ -310,6 +339,13 @@ _STRUCTURE_HEADS: Dict[str, Tuple[str, ...]] = {
     "banded": ("banded",),
     "blockdiag": ("blockdiag",),
     "dense": (),
+    # The sparse ladder is three Krylov rungs deep before densifying:
+    # CG (certified-SPD only — typed NotSPDError demotes instantly on
+    # general systems), then GMRES(restart), then BiCGStab; stagnation
+    # at each raises the typed IterativeStagnationError. Only past all
+    # three does the operand densify into the dense chain — the route's
+    # whole point is that rung 0-2 never allocate n^2.
+    "sparse": ("cg", "gmres", "bicgstab"),
 }
 
 

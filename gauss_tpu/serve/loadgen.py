@@ -25,6 +25,13 @@ Workload mixes are comma-separated weighted tokens::
   blockdiag_matrix), so a mix can drive the structure-aware serving
   lanes (``ServeConfig(structure_aware=True)``) and the chaos campaign
   end to end; ``<b>``/``<k>`` default to 1 / n // 8.
+- ``sparse:<n>/<nnz_per_row>`` — the sparse-plane generator
+  (io.synthetic.sparse_matrix, ``<nnz_per_row>`` default 8): a
+  Gershgorin-certified low-density system that a structure-aware server
+  routes to the Krylov lane (``gauss_tpu.sparse``). Loadgen operands are
+  in-memory ndarrays, so ``<n>`` is capped at the generator's 4096
+  densify limit — the scalable no-densify path is exercised by
+  ``gauss_tpu.sparse.check``, not by serving traffic.
 - ``dtype:<dt>/<n>`` — a diagonally-dominant random system (like
   ``random:<n>``) submitted with a per-request storage dtype
   (``bfloat16`` / ``bf16x3`` / ``float32`` — core.lowered's ladder
@@ -120,7 +127,7 @@ def parse_mix(mix: str) -> List[Tuple[WorkloadSpec, float]]:
             raise ValueError(f"workload token {token!r} needs kind:arg")
         kind, arg = token.split(":", 1)
         if kind not in ("random", "internal", "dat", "dataset",
-                        "spd", "banded", "blockdiag", "dtype"):
+                        "spd", "banded", "blockdiag", "sparse", "dtype"):
             raise ValueError(f"unknown workload kind {kind!r} in {token!r}")
         dtype = None
         if kind == "dtype":
@@ -138,10 +145,18 @@ def parse_mix(mix: str) -> List[Tuple[WorkloadSpec, float]]:
             kind, arg, dtype = "random", n_part, dt_part
         if kind in ("random", "internal", "spd") and int(arg) < 1:
             raise ValueError(f"bad size in workload token {token!r}")
-        if kind in ("banded", "blockdiag"):
-            n_part = arg.split("/", 1)[0]
+        if kind in ("banded", "blockdiag", "sparse"):
+            n_part, _, x_part = arg.partition("/")
             if int(n_part) < 1:
                 raise ValueError(f"bad size in workload token {token!r}")
+            if kind == "sparse":
+                if int(n_part) > 4096:
+                    raise ValueError(
+                        f"sparse workload n={n_part} exceeds the loadgen "
+                        f"densify cap 4096 (token {token!r})")
+                if x_part and int(x_part) < 1:
+                    raise ValueError(
+                        f"bad nnz_per_row in workload token {token!r}")
         out.append((WorkloadSpec(kind=kind, arg=arg, dtype=dtype), weight))
     if not out:
         raise ValueError(f"empty workload mix {mix!r}")
@@ -179,7 +194,7 @@ def materialize(spec: WorkloadSpec, rng: np.random.Generator, nrhs: int = 1,
             a = np.asarray(read_dat_dense(spec.arg), dtype=np.float64)
             with _dat_lock:
                 _dat_cache[spec.arg] = a
-    elif spec.kind in ("spd", "banded", "blockdiag"):
+    elif spec.kind in ("spd", "banded", "blockdiag", "sparse"):
         from gauss_tpu.io import synthetic
 
         if spec.kind == "spd":
@@ -188,6 +203,10 @@ def materialize(spec: WorkloadSpec, rng: np.random.Generator, nrhs: int = 1,
             n_s, _, b_s = spec.arg.partition("/")
             a = synthetic.banded_matrix(int(n_s),
                                         int(b_s) if b_s else 1)
+        elif spec.kind == "sparse":
+            n_s, _, z_s = spec.arg.partition("/")
+            a = synthetic.sparse_matrix(int(n_s),
+                                        int(z_s) if z_s else 8)
         else:
             n_s, _, k_s = spec.arg.partition("/")
             n_i = int(n_s)

@@ -842,6 +842,13 @@ class SolverServer:
 
     def _serve_batched(self, reqs, lane=None) -> None:
         cfg = self.config
+        if reqs[0].structure == "sparse":
+            # The sparse compat sig keeps these batches homogeneous (drain
+            # compatibility); the iterative lane has no padded dense
+            # executable to share, so members run the per-request Krylov
+            # ladder instead of the bucketed dispatch.
+            self._serve_sparse(reqs, lane=lane)
+            return
         bucket_n = buckets.bucket_for(reqs[0].n, self.ladder)
         nrhs = buckets.pow2_bucket(max(r.k for r in reqs))
         # Mesh lanes serve a FIXED batch slot (always max_batch, identity-
@@ -1108,6 +1115,42 @@ class SolverServer:
                               time.perf_counter() - t0)
         self._finish(req, np.asarray(x), lane=lane, bucket_n=None,
                      sdc_detected=sdc_detected)
+
+    def _serve_sparse(self, reqs, lane=None) -> None:
+        """The sparse serving lane (``structure="sparse"`` compat sig):
+        every member runs the Krylov recovery ladder — CG for certified
+        operands, GMRES/BiCGStab for general, the dense chain only past
+        all three — under its own trace context, then the SAME
+        ``_finish`` verify-gate/terminal path as the batched lanes.
+        Iteration telemetry rides the ``sparse_solve`` events the rungs
+        emit inside each request's span tree."""
+        from gauss_tpu.resilience import recover
+
+        gate = self.config.verify_gate or recover.DEFAULT_GATE
+        obs.emit("route", tool="serve", lane="sparse", requests=len(reqs))
+        for req in reqs:
+            t0 = time.perf_counter()
+            try:
+                with obs.trace_context(req.trace_id), \
+                        obs.span("serve_sparse", n=req.n):
+                    rr = recover.solve_resilient(
+                        req.a.astype(np.float64), req.b.astype(np.float64),
+                        gate=gate, rungs=recover.structured_rungs("sparse"))
+                x = rr.x
+            except Exception as e:  # noqa: BLE001 — lane boundary
+                if req.resolve(ServeResult(
+                        status=STATUS_FAILED, lane="sparse",
+                        error=f"{type(e).__name__}: {e}")):
+                    obs.counter("serve.failed")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             trace=req.trace_id, status=STATUS_FAILED,
+                             lane="sparse",
+                             error=f"{type(e).__name__}: {e}"[:200])
+                continue
+            if self.attr is not None:
+                self._attr_single(req, "serve_sparse", "sparse",
+                                  time.perf_counter() - t0)
+            self._finish(req, x, lane="sparse", bucket_n=None)
 
     def _serve_numpy(self, req: ServeRequest) -> None:
         """Degraded host lane, through the SAME recovery ladder the solver

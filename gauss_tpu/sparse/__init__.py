@@ -1,0 +1,51 @@
+"""Sparse engines: CSR assembly straight from the coordinate stream,
+matrix-free Krylov solvers, and factor-based preconditioning.
+
+The reference's native input format is already sparse — ``row col value``
+coordinate ``.dat`` files — and ``detect_structure_coords`` classifies
+structure on that stream without densifying.  This package closes the
+remaining gap: the operand itself stays in CSR form (O(nnz + n) bytes),
+SpMV runs as a padded-row (ELL) kernel with a Pallas TPU path behind the
+usual size routing, and the solvers are matrix-free ``lax.while_loop``
+Krylov programs (CG for Gershgorin-certified SPD systems, GMRES(restart)
+and BiCGStab for general systems) gated by the same 1e-4 verify as every
+dense engine.  Preconditioners reuse existing machinery: block-Jacobi
+from block-diagonal partitions (factorability probed by the
+``core/blocked.py`` panel step), tridiagonal factors from
+``structure/banded.py``, and a zero-fill incomplete Cholesky/ILU whose
+fill is confined to the block-tridiagonal pattern.
+
+Routing: ``structure/detect.py`` tags a system ``"sparse"`` when its
+density sits at or below ``SPARSE_MAX_DENSITY`` (sourced from
+``tune.space.SPARSE_DENSITY_SEED``) at ``n >= SPARSE_MIN_N``; the
+recovery ladder for that tag is cg -> gmres -> bicgstab -> dense chain,
+with stagnation surfacing as the typed ``IterativeStagnationError``
+(docs/STRUCTURE.md).
+"""
+
+from gauss_tpu.sparse.csr import CsrMatrix
+from gauss_tpu.sparse.krylov import (
+    IterativeStagnationError,
+    SparseSolveResult,
+    solve_bicgstab,
+    solve_cg,
+    solve_gmres,
+)
+from gauss_tpu.sparse.precond import Preconditioner, build_preconditioner
+from gauss_tpu.sparse.solve import solve_sparse
+from gauss_tpu.sparse.spmv import spmv_coo, spmv_ell, spmv_ell_pallas
+
+__all__ = [
+    "CsrMatrix",
+    "IterativeStagnationError",
+    "Preconditioner",
+    "SparseSolveResult",
+    "build_preconditioner",
+    "solve_bicgstab",
+    "solve_cg",
+    "solve_gmres",
+    "solve_sparse",
+    "spmv_coo",
+    "spmv_ell",
+    "spmv_ell_pallas",
+]

@@ -1,0 +1,297 @@
+"""Sparse-plane smoke gate: ``python -m gauss_tpu.sparse.check``.
+
+Two legs, both on the deterministic generator the matrix_gen CLI ships
+(``io.synthetic.sparse_coords``):
+
+- **smoke** (n ~ 640): the coordinate stream classifies ``sparse``
+  (detect_structure_coords), ``solve_auto`` routes it to the CG rung
+  without demotion, and each Krylov method — CG, GMRES, BiCGStab — solves
+  the same system to the 1e-4 relative-residual gate (verified here with
+  a TRUE residual, independently of the solvers' own convergence tests).
+
+- **giant** (n = 100,000, ~20 nnz/row): the headline of the sparse plane
+  — the system is assembled, preconditioned, and CG-solved to 1e-4
+  WITHOUT ever allocating an n x n buffer. Enforced, not asserted by
+  inspection: the process peak RSS (``resource.getrusage``) must stay
+  under a budget that the dense matrix alone (8 n^2 bytes = 80 GB)
+  exceeds tenfold. A future change that quietly densifies anywhere on
+  the path cannot pass this leg.
+
+The summary (``--summary-json``) is regress-ingestable
+(``kind: sparse_solve``): per-method seconds-per-solve and iteration
+counts plus the giant leg's wall time and peak bytes, slow-side-gated so
+a convergence regression — a preconditioner losing its bite, iteration
+counts creeping — gates in CI exactly like a perf regression.
+``make sparse-check`` runs the CPU configuration CI gates on.
+
+Exit status: 2 when any leg fails verification/routing/memory, 1 when
+``--regress-check`` finds an out-of-band metric, 0 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from gauss_tpu.utils.env import honor_jax_platforms
+
+#: giant-leg peak-RSS budget (bytes). The point is the ORDER: the dense
+#: operand alone costs 8 n^2 = 80 GB at n = 100,000 — at least 10x this
+#: budget (asserted) — so fitting under it proves no densification.
+PEAK_BUDGET_BYTES = 4 << 30
+
+
+def _peak_rss_bytes() -> int:
+    """Lifetime peak RSS of this process. ru_maxrss is KiB on Linux,
+    bytes on macOS."""
+    import resource
+
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(peak) if sys.platform == "darwin" else int(peak) * 1024
+
+
+def run_smoke(n: int, nnz_per_row: int, seed: int, gate: float,
+              repeats: int) -> Tuple[Dict, Dict[str, Dict]]:
+    """The small-n leg: coordinate classification + routing + all three
+    Krylov methods at the gate. Returns (routed_row, per_method_rows)."""
+    from gauss_tpu.sparse import solve_sparse
+    from gauss_tpu.sparse.csr import CsrMatrix
+    from gauss_tpu.io import synthetic
+    from gauss_tpu.structure import solve_auto
+    from gauss_tpu.structure.detect import detect_structure_coords
+    from gauss_tpu.verify import checks
+
+    rows, cols, vals = synthetic.sparse_coords(n, nnz_per_row, seed=seed)
+    a = CsrMatrix.from_coords(n, rows, cols, vals)
+    rng = np.random.default_rng(np.random.SeedSequence((seed, n)))
+    b = rng.standard_normal(n)
+
+    info = detect_structure_coords(n, rows, cols, vals)
+    dense = a.to_dense()
+    res = solve_auto(dense, b, info=info, gate=gate)
+    rel = checks.residual_norm(dense, res.x, b, relative=True)
+    routed = {
+        "n": n, "nnz": a.nnz, "detected": info.kind, "engine": res.rung,
+        "demoted": bool(res.rung_index > 0),
+        "rel_residual": float(rel),
+        "verified": bool(np.isfinite(rel) and rel <= gate),
+        "routed_ok": info.kind == "sparse" and res.rung == "cg",
+    }
+
+    methods: Dict[str, Dict] = {}
+    for method in ("cg", "gmres", "bicgstab"):
+        best = None
+        out = None
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            out = solve_sparse(a, b, method=method, gate=gate)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        true_rel = float(np.linalg.norm(a.matvec(out.x) - b)
+                         / np.linalg.norm(b))
+        methods[method] = {
+            "n": n, "nnz": a.nnz, "precond": out.precond,
+            "iterations": int(out.iterations),
+            "s_per_solve": round(best, 6),
+            "rel_residual": true_rel,
+            "verified": bool(np.isfinite(true_rel) and true_rel <= gate),
+        }
+    return routed, methods
+
+
+def run_giant(n: int, nnz_per_row: int, seed: int, gate: float) -> Dict:
+    """The no-densify leg: assemble + CG-solve an n = 100k system to the
+    gate with the process peak RSS held under PEAK_BUDGET_BYTES."""
+    from gauss_tpu.sparse import solve_sparse
+    from gauss_tpu.sparse.csr import CsrMatrix
+    from gauss_tpu.io import synthetic
+
+    t0 = time.perf_counter()
+    rows, cols, vals = synthetic.sparse_coords(n, nnz_per_row, seed=seed)
+    a = CsrMatrix.from_coords(n, rows, cols, vals)
+    rng = np.random.default_rng(np.random.SeedSequence((seed, n)))
+    b = rng.standard_normal(n)
+    out = solve_sparse(a, b, method="cg", precond="jacobi", gate=gate)
+    wall = time.perf_counter() - t0
+    true_rel = float(np.linalg.norm(a.matvec(out.x) - b)
+                     / np.linalg.norm(b))
+    peak = _peak_rss_bytes()
+    dense_bytes = 8 * n * n
+    return {
+        "n": n, "nnz": a.nnz, "density": a.density,
+        "method": out.method, "precond": out.precond,
+        "iterations": int(out.iterations),
+        "s_per_solve": round(wall, 6),
+        "rel_residual": true_rel,
+        "verified": bool(np.isfinite(true_rel) and true_rel <= gate),
+        "peak_rss_bytes": peak,
+        "peak_budget_bytes": PEAK_BUDGET_BYTES,
+        "dense_bytes": dense_bytes,
+        # The leg's whole point, as data: the budget held AND the budget
+        # is small against the densified operand (>= 10x margin).
+        "no_densify_ok": bool(peak <= PEAK_BUDGET_BYTES
+                              and dense_bytes >= 10 * PEAK_BUDGET_BYTES),
+    }
+
+
+def history_records(summary: Dict) -> List[Tuple[str, float, str]]:
+    """(metric, value, unit) records for the regression history —
+    per-method seconds-per-solve and iteration counts, plus the giant
+    leg's wall time and peak bytes. All slow-side-gated: convergence
+    regressions raise iterations and seconds; densification raises peak
+    bytes by an order of magnitude."""
+    out: List[Tuple[str, float, str]] = []
+    for method, row in (summary.get("methods") or {}).items():
+        if isinstance(row.get("s_per_solve"), (int, float)):
+            out.append((f"sparse:{method}/s_per_solve",
+                        row["s_per_solve"], "s"))
+        if isinstance(row.get("iterations"), (int, float)):
+            out.append((f"sparse:{method}/iterations",
+                        float(row["iterations"]), "count"))
+    giant = summary.get("giant") or {}
+    if isinstance(giant.get("s_per_solve"), (int, float)):
+        out.append(("sparse:giant/s_per_solve",
+                    giant["s_per_solve"], "s"))
+    if isinstance(giant.get("peak_rss_bytes"), (int, float)):
+        out.append(("sparse:giant/peak_rss_bytes",
+                    float(giant["peak_rss_bytes"]), "bytes"))
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gauss_tpu.sparse.check",
+        description="Sparse-plane smoke gate: coordinate classification, "
+                    "Krylov routing, CG/GMRES/BiCGStab at the 1e-4 gate, "
+                    "and the n=100k no-densify leg (the make sparse-check "
+                    "CI configuration).")
+    p.add_argument("--smoke-n", type=int, default=640)
+    p.add_argument("--giant-n", type=int, default=100_000)
+    p.add_argument("--nnz-per-row", type=int, default=6,
+                   help="stored entries per row for the smoke leg")
+    p.add_argument("--giant-nnz-per-row", type=int, default=20,
+                   help="stored entries per row for the giant leg")
+    p.add_argument("--skip-giant", action="store_true",
+                   help="smoke legs only (developer loop; CI runs both)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed solves per method (best-of; the first rep "
+                        "pays the jit compile, so >= 2 is meaningful)")
+    p.add_argument("--seed", type=int, default=258458)
+    p.add_argument("--gate", type=float, default=1e-4)
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="append the run's obs JSONL stream here")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the regress-ingestable summary "
+                        "(kind=sparse_solve)")
+    p.add_argument("--history", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="append this run's records to the regression "
+                        "history (default reports/history.jsonl)")
+    p.add_argument("--regress-check", action="store_true",
+                   help="gate against the history baselines (exit 1 when "
+                        "out of band)")
+    p.add_argument("--band", type=float, default=1.5,
+                   help="slow-side noise band for --regress-check "
+                        "(default 1.5: millisecond-scale CPU timings are "
+                        "jittery, while the regressions this gate exists "
+                        "for — densification, a preconditioner losing its "
+                        "bite — move the metrics by orders of magnitude)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    honor_jax_platforms()
+
+    from gauss_tpu import obs
+    from gauss_tpu.obs import regress
+
+    t0 = time.perf_counter()
+    with obs.run(metrics_out=args.metrics_out, tool="sparse_check",
+                 seed=args.seed) as rec:
+        with obs.span("sparse_check_smoke", n=args.smoke_n):
+            routed, methods = run_smoke(args.smoke_n, args.nnz_per_row,
+                                        args.seed, args.gate, args.repeats)
+        giant = None
+        if not args.skip_giant:
+            with obs.span("sparse_check_giant", n=args.giant_n):
+                giant = run_giant(args.giant_n, args.giant_nnz_per_row,
+                                  args.seed, args.gate)
+    wall = round(time.perf_counter() - t0, 3)
+
+    bad: List[str] = []
+    if not (routed["verified"] and routed["routed_ok"]):
+        bad.append("routed")
+    bad.extend(m for m, row in methods.items() if not row["verified"])
+    if giant is not None and not (giant["verified"]
+                                  and giant["no_densify_ok"]):
+        bad.append("giant")
+    summary = {"kind": "sparse_solve", "seed": args.seed,
+               "gate": args.gate, "routed": routed, "methods": methods,
+               "giant": giant, "wall_s": wall, "ok": not bad}
+
+    print(f"sparse-check [routed   ] n={routed['n']:6d} detected="
+          f"{routed['detected']:7s} engine={routed['engine']:9s} "
+          f"rel_residual={routed['rel_residual']:.2e} "
+          f"{'OK' if routed['verified'] and routed['routed_ok'] else 'FAIL'}")
+    for method, row in methods.items():
+        print(f"sparse-check [{method:9s}] n={row['n']:6d} "
+              f"precond={row['precond']:7s} iters={row['iterations']:4d} "
+              f"s_per_solve={row['s_per_solve']:.4f} "
+              f"rel_residual={row['rel_residual']:.2e} "
+              f"{'OK' if row['verified'] else 'FAIL'}")
+    if giant is not None:
+        print(f"sparse-check [giant    ] n={giant['n']:6d} "
+              f"nnz={giant['nnz']} iters={giant['iterations']:4d} "
+              f"s_per_solve={giant['s_per_solve']:.4f} "
+              f"rel_residual={giant['rel_residual']:.2e} "
+              f"peak_rss={giant['peak_rss_bytes'] / 2**30:.2f} GiB "
+              f"(budget {giant['peak_budget_bytes'] / 2**30:.0f} GiB, "
+              f"dense would be {giant['dense_bytes'] / 2**30:.0f} GiB) "
+              f"{'OK' if giant['verified'] and giant['no_densify_ok'] else 'FAIL'}")
+    print(f"sparse-check: {len(methods) + 1 + (giant is not None)} leg(s) "
+          f"in {wall} s"
+          + (f"; FAILED: {bad}" if bad else "; all verified at the "
+             f"{args.gate:.0e} gate"))
+
+    if args.summary_json:
+        parent = os.path.dirname(args.summary_json)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.summary_json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"summary: {args.summary_json}")
+
+    rc = 0
+    # Run-id-tagged sources (cf. structure-check): identical values from
+    # DISTINCT epochs — iteration counts are deterministic — must
+    # accumulate as separate baseline samples, not dedup into one.
+    records = [{"metric": m, "value": v, "unit": u,
+                "source": f"sparse-{rec.run_id}",
+                "kind": "sparse"} for m, v, u in history_records(summary)]
+    if args.regress_check and records:
+        history_path = args.history or regress.default_history_path()
+        verdicts = regress.check_records(
+            records, regress.load_history(history_path), band=args.band)
+        print(regress.format_verdicts(verdicts))
+        if any(v["status"] == "out-of-band" for v in verdicts):
+            rc = 1
+    if args.history is not None and records and rc == 0 and not bad:
+        history_path = args.history or regress.default_history_path()
+        added = regress.append_history(records, history_path)
+        print(f"history: {added} record(s) appended to {history_path}")
+
+    if bad:
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

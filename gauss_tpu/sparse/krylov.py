@@ -1,0 +1,446 @@
+"""Matrix-free Krylov solvers as ``lax.while_loop`` programs.
+
+Three cores, each a single jit-clean traced program (registered in
+``core/entrypoints.py`` as ``sparse/cg``, ``sparse/gmres``,
+``sparse/bicgstab`` — refinement sites, since the host wrappers run them
+in f64 under ``jax.experimental.enable_x64()``):
+
+- :func:`cg_run` — preconditioned conjugate gradients.  The host wrapper
+  :func:`solve_cg` demands the Gershgorin SPD certificate
+  (``CsrMatrix.gershgorin_spd``, the same proof the structure tagger
+  issues) before running it: CG's convergence theory needs SPD, and an
+  uncertified operand raises typed ``NotSPDError`` so the recovery
+  ladder demotes to the general-system rungs instead of iterating
+  blindly.
+- :func:`gmres_run` — GMRES(restart) with a CGS2 (classical
+  Gram-Schmidt, one reorthogonalization pass) Arnoldi inner loop: fully
+  vectorized over the basis, numerically on par with MGS for the
+  restart lengths this plane sweeps.  Peak memory is the acceptance
+  bound: O(nnz + n * restart) for the resident basis.
+- :func:`bicgstab_run` — BiCGStab with breakdown-guarded denominators;
+  a breakdown stalls the residual and surfaces as stagnation.
+
+Every wrapper verifies the TRUE residual ``||b - A x|| / ||b||`` on the
+host via the CSR matvec — the same 1e-4 gate as every dense engine — and
+raises the typed :class:`IterativeStagnationError` when the budget runs
+out above it, which the recovery ladder catches
+(``exception:IterativeStagnationError``) to demote toward the dense
+chain.  Each result carries the iteration count and the residual curve
+for the ``sparse_solve`` observability event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from gauss_tpu.sparse.csr import CsrMatrix
+from gauss_tpu.sparse.precond import apply_precond, build_preconditioner
+from gauss_tpu.sparse.spmv import spmv_ell
+
+# Seed restart length for GMRES (tune.space "sparse" op sweeps it).
+from gauss_tpu.tune.space import SPARSE_RESTART_SEED
+
+__all__ = [
+    "IterativeStagnationError",
+    "SparseSolveResult",
+    "bicgstab_run",
+    "cg_run",
+    "gmres_run",
+    "solve_bicgstab",
+    "solve_cg",
+    "solve_gmres",
+]
+
+#: Same residual gate as the dense engines (resilience.recover.DEFAULT_GATE);
+#: duplicated here only as a keyword default — callers route the live gate.
+DEFAULT_TOL = 1e-4
+
+#: Default total matvec budget for the host wrappers.
+DEFAULT_MAXITER = 400
+
+_TINY = 1e-300
+
+
+class IterativeStagnationError(RuntimeError):
+    """A Krylov solver exhausted its iteration budget (or broke down)
+    above the residual gate.  Typed so the recovery ladder can demote to
+    the dense chain (``exception:IterativeStagnationError`` trigger)
+    instead of shipping an unverified answer.  ``result`` carries the
+    partial :class:`SparseSolveResult` for diagnostics."""
+
+    def __init__(self, message, *, method=None, iterations=None,
+                 rel_residual=None, result=None):
+        super().__init__(message)
+        self.method = method
+        self.iterations = iterations
+        self.rel_residual = rel_residual
+        self.result = result
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseSolveResult:
+    """Solver outcome: ``x`` (float64, shape of ``b``), the method and
+    preconditioner that produced it, the matvec/iteration count, the
+    residual curve (relative, one entry per recorded step), and the TRUE
+    host-verified relative residual."""
+
+    x: np.ndarray
+    method: str
+    precond: str
+    iterations: int
+    residuals: np.ndarray
+    converged: bool
+    rel_residual: float
+
+
+def _safe_div(num, den):
+    import jax.numpy as jnp
+
+    return num / jnp.where(jnp.abs(den) > _TINY, den, jnp.where(den < 0, -_TINY, _TINY))
+
+
+def cg_run(cols, vals, b, x0, prec, tol, *, maxiter):
+    """Preconditioned CG core — see module docstring.  Returns
+    ``(x, iterations, curve, rel)``; ``curve`` is (maxiter+1,) with
+    unreached entries zero."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    mv = lambda u: spmv_ell(cols, vals, u)  # noqa: E731
+    bnorm = jnp.maximum(jnp.linalg.norm(b), _TINY)
+    r0 = b - mv(x0)
+    z0 = apply_precond(prec, r0)
+    rz0 = r0 @ z0
+    rel0 = jnp.linalg.norm(r0) / bnorm
+    curve0 = jnp.zeros(maxiter + 1, b.dtype).at[0].set(rel0)
+
+    def cond(state):
+        k, _, _, _, _, _, _, rel = state
+        return (k < maxiter) & (rel > tol)
+
+    def body(state):
+        k, x, r, z, p, rz, curve, _ = state
+        q = mv(p)
+        alpha = _safe_div(rz, p @ q)
+        x = x + alpha * p
+        r = r - alpha * q
+        z = apply_precond(prec, r)
+        rz_new = r @ z
+        p = z + _safe_div(rz_new, rz) * p
+        rel = jnp.linalg.norm(r) / bnorm
+        curve = curve.at[k + 1].set(rel)
+        return k + 1, x, r, z, p, rz_new, curve, rel
+
+    k, x, _, _, _, _, curve, rel = lax.while_loop(
+        cond, body, (0, x0, r0, z0, z0, rz0, curve0, rel0)
+    )
+    return x, k, curve, rel
+
+
+def gmres_run(cols, vals, b, x0, prec, tol, *, restart, maxcycles):
+    """Left-preconditioned GMRES(restart) core.  Returns
+    ``(x, cycles, curve, rel)``; ``curve`` holds the TRUE relative
+    residual once per restart cycle, shaped (maxcycles+1,).  Peak state
+    is the (restart+1, n) basis — the O(n * restart) acceptance bound."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = b.shape[0]
+    mv = lambda u: spmv_ell(cols, vals, u)  # noqa: E731
+    bnorm = jnp.maximum(jnp.linalg.norm(b), _TINY)
+    rel0 = jnp.linalg.norm(b - mv(x0)) / bnorm
+    curve0 = jnp.zeros(maxcycles + 1, b.dtype).at[0].set(rel0)
+
+    def arnoldi(j, carry):
+        V, H = carry
+        w = apply_precond(prec, mv(V[j]))
+        # CGS2: project against the whole basis twice; unfilled rows of V
+        # are zero so they contribute nothing to either pass.
+        h1 = V @ w
+        w = w - V.T @ h1
+        h2 = V @ w
+        w = w - V.T @ h2
+        hnorm = jnp.linalg.norm(w)
+        V = V.at[j + 1].set(jnp.where(hnorm > _TINY, w / hnorm, 0.0))
+        H = H.at[:, j].set(h1 + h2)
+        H = H.at[j + 1, j].set(hnorm)
+        return V, H
+
+    def cycle(state):
+        c, x, curve, _ = state
+        r = b - mv(x)
+        z = apply_precond(prec, r)
+        beta = jnp.linalg.norm(z)
+        V0 = jnp.zeros((restart + 1, n), b.dtype).at[0].set(
+            z / jnp.maximum(beta, _TINY)
+        )
+        H0 = jnp.zeros((restart + 1, restart), b.dtype)
+        V, H = lax.fori_loop(0, restart, arnoldi, (V0, H0))
+        g = jnp.zeros(restart + 1, b.dtype).at[0].set(beta)
+        y = jnp.linalg.lstsq(H, g)[0]
+        x = x + V[:restart].T @ y
+        rel = jnp.linalg.norm(b - mv(x)) / bnorm
+        curve = curve.at[c + 1].set(rel)
+        return c + 1, x, curve, rel
+
+    def cond(state):
+        c, _, _, rel = state
+        return (c < maxcycles) & (rel > tol)
+
+    c, x, curve, rel = lax.while_loop(cond, cycle, (0, x0, curve0, rel0))
+    return x, c, curve, rel
+
+
+def bicgstab_run(cols, vals, b, x0, prec, tol, *, maxiter):
+    """Preconditioned BiCGStab core with breakdown-guarded denominators.
+    Returns ``(x, iterations, curve, rel)``; ``curve`` (maxiter+1,)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    mv = lambda u: spmv_ell(cols, vals, u)  # noqa: E731
+    bnorm = jnp.maximum(jnp.linalg.norm(b), _TINY)
+    r0 = b - mv(x0)
+    rel0 = jnp.linalg.norm(r0) / bnorm
+    curve0 = jnp.zeros(maxiter + 1, b.dtype).at[0].set(rel0)
+    one = jnp.asarray(1.0, b.dtype)
+    zeros = jnp.zeros_like(b)
+
+    def cond(state):
+        k, _, _, _, _, _, _, _, _, rel = state
+        return (k < maxiter) & (rel > tol)
+
+    def body(state):
+        k, x, r, p, v, rho, alpha, omega, curve, _ = state
+        rho_new = r0 @ r
+        beta = _safe_div(rho_new, rho) * _safe_div(alpha, omega)
+        p = r + beta * (p - omega * v)
+        phat = apply_precond(prec, p)
+        v = mv(phat)
+        alpha = _safe_div(rho_new, r0 @ v)
+        s = r - alpha * v
+        shat = apply_precond(prec, s)
+        t = mv(shat)
+        omega = _safe_div(t @ s, t @ t)
+        x = x + alpha * phat + omega * shat
+        r = s - omega * t
+        rel = jnp.linalg.norm(r) / bnorm
+        curve = curve.at[k + 1].set(rel)
+        return k + 1, x, r, p, v, rho_new, alpha, omega, curve, rel
+
+    k, x, _, _, _, _, _, _, curve, rel = lax.while_loop(
+        cond, body, (0, x0, r0, zeros, zeros, one, one, one, curve0, rel0)
+    )
+    return x, k, curve, rel
+
+
+# ---------------------------------------------------------------------------
+# Host wrappers: stage ELL arrays in f64, run the core under enable_x64,
+# verify the TRUE residual, raise typed on stagnation.
+# ---------------------------------------------------------------------------
+
+_CORES = {}
+
+
+def _core(method: str, static):
+    import jax
+
+    key = method
+    if key not in _CORES:
+        fn = {"cg": cg_run, "gmres": gmres_run, "bicgstab": bicgstab_run}[method]
+        _CORES[key] = jax.jit(fn, static_argnames=static)
+    return _CORES[key]
+
+
+def _resolve_precond(a: CsrMatrix, precond, block):
+    if precond is None:
+        precond = "none"
+    if isinstance(precond, str):
+        return build_preconditioner(a, precond, block=block), precond
+    return precond, precond.kind
+
+
+def _run_columns(a, b, run_one):
+    """Apply a single-RHS solver columnwise for (n, k) b; returns the
+    stacked x plus the worst column's (iterations, curve, rel)."""
+    b = np.asarray(b, dtype=np.float64)
+    if b.ndim == 1:
+        return run_one(b)
+    xs, worst = [], None
+    for j in range(b.shape[1]):
+        x, iters, curve, rel = run_one(b[:, j])
+        xs.append(x)
+        if worst is None or rel > worst[2]:
+            worst = (iters, curve, rel)
+    return np.stack(xs, axis=1), worst[0], worst[1], worst[2]
+
+
+def _finish(a, b, x, iters, curve, method, pname, tol, raise_on_stagnation):
+    b = np.asarray(b, dtype=np.float64)
+    true_res = np.linalg.norm(b - a.matvec(x))
+    rel = float(true_res / max(np.linalg.norm(b), _TINY))
+    curve = np.asarray(curve, dtype=np.float64)
+    # Trim trailing unreached entries (zeros past the iteration count).
+    curve = curve[: int(iters) + 1]
+    res = SparseSolveResult(
+        x=np.asarray(x, dtype=np.float64),
+        method=method,
+        precond=pname,
+        iterations=int(iters),
+        residuals=curve,
+        converged=rel <= tol,
+        rel_residual=rel,
+    )
+    if not res.converged and raise_on_stagnation:
+        raise IterativeStagnationError(
+            f"{method} stagnated: rel_residual={rel:.3e} > gate={tol:g} "
+            f"after {res.iterations} iterations",
+            method=method,
+            iterations=res.iterations,
+            rel_residual=rel,
+            result=res,
+        )
+    return res
+
+
+def _stage(a: CsrMatrix):
+    import jax.numpy as jnp
+
+    cols, vals = a.ell()
+    return jnp.asarray(cols), jnp.asarray(vals, jnp.float64)
+
+
+def solve_cg(
+    a: CsrMatrix,
+    b,
+    *,
+    precond="jacobi",
+    block: Optional[int] = None,
+    tol: float = DEFAULT_TOL,
+    maxiter: int = DEFAULT_MAXITER,
+    x0=None,
+    raise_on_stagnation: bool = True,
+) -> SparseSolveResult:
+    """Conjugate gradients on a Gershgorin-CERTIFIED SPD CsrMatrix.
+    Raises typed ``NotSPDError`` when the certificate fails (the ladder's
+    demotion signal) and ``IterativeStagnationError`` on budget
+    exhaustion above ``tol``."""
+    import jax
+
+    from gauss_tpu.structure.cholesky import NotSPDError
+
+    if not a.gershgorin_spd():
+        raise NotSPDError(
+            "solve_cg requires the Gershgorin SPD certificate (symmetric, "
+            "positive strictly dominant diagonal); route general systems "
+            "to GMRES/BiCGStab"
+        )
+    with jax.experimental.enable_x64():
+        prec, pname = _resolve_precond(a, precond, block)
+        cols, vals = _stage(a)
+        run = _core("cg", ("maxiter",))
+
+        def run_one(b1):
+            import jax.numpy as jnp
+
+            x0j = (
+                jnp.zeros(a.n, jnp.float64)
+                if x0 is None
+                else jnp.asarray(x0, jnp.float64)
+            )
+            x, it, curve, rel = run(
+                cols, vals, jnp.asarray(b1, jnp.float64), x0j, prec,
+                jnp.asarray(tol, jnp.float64), maxiter=maxiter,
+            )
+            return np.asarray(x), int(it), np.asarray(curve), float(rel)
+
+        x, iters, curve, _ = _run_columns(a, b, run_one)
+    return _finish(a, b, x, iters, curve, "cg", pname, tol, raise_on_stagnation)
+
+
+def solve_gmres(
+    a: CsrMatrix,
+    b,
+    *,
+    precond="jacobi",
+    block: Optional[int] = None,
+    tol: float = DEFAULT_TOL,
+    restart: int = SPARSE_RESTART_SEED,
+    maxiter: int = DEFAULT_MAXITER,
+    x0=None,
+    raise_on_stagnation: bool = True,
+) -> SparseSolveResult:
+    """GMRES(restart) for general systems; ``maxiter`` bounds total inner
+    iterations (cycles = ceil(maxiter / restart)).  Reported iterations
+    count inner steps (cycles * restart)."""
+    import jax
+
+    restart = max(1, min(int(restart), a.n))
+    maxcycles = max(1, -(-int(maxiter) // restart))
+    with jax.experimental.enable_x64():
+        prec, pname = _resolve_precond(a, precond, block)
+        cols, vals = _stage(a)
+        run = _core("gmres", ("restart", "maxcycles"))
+
+        def run_one(b1):
+            import jax.numpy as jnp
+
+            x0j = (
+                jnp.zeros(a.n, jnp.float64)
+                if x0 is None
+                else jnp.asarray(x0, jnp.float64)
+            )
+            x, cyc, curve, rel = run(
+                cols, vals, jnp.asarray(b1, jnp.float64), x0j, prec,
+                jnp.asarray(tol, jnp.float64), restart=restart,
+                maxcycles=maxcycles,
+            )
+            return np.asarray(x), int(cyc) * restart, np.asarray(curve), float(rel)
+
+        x, iters, curve, _ = _run_columns(a, b, run_one)
+    # Curve rows are per-cycle; trim to cycles actually run.
+    curve = np.asarray(curve)[: iters // restart + 1]
+    return _finish(
+        a, b, x, iters, curve, "gmres", pname, tol, raise_on_stagnation
+    )
+
+
+def solve_bicgstab(
+    a: CsrMatrix,
+    b,
+    *,
+    precond="jacobi",
+    block: Optional[int] = None,
+    tol: float = DEFAULT_TOL,
+    maxiter: int = DEFAULT_MAXITER,
+    x0=None,
+    raise_on_stagnation: bool = True,
+) -> SparseSolveResult:
+    """BiCGStab for general systems (two matvecs per iteration)."""
+    import jax
+
+    with jax.experimental.enable_x64():
+        prec, pname = _resolve_precond(a, precond, block)
+        cols, vals = _stage(a)
+        run = _core("bicgstab", ("maxiter",))
+
+        def run_one(b1):
+            import jax.numpy as jnp
+
+            x0j = (
+                jnp.zeros(a.n, jnp.float64)
+                if x0 is None
+                else jnp.asarray(x0, jnp.float64)
+            )
+            x, it, curve, rel = run(
+                cols, vals, jnp.asarray(b1, jnp.float64), x0j, prec,
+                jnp.asarray(tol, jnp.float64), maxiter=maxiter,
+            )
+            return np.asarray(x), int(it), np.asarray(curve), float(rel)
+
+        x, iters, curve, _ = _run_columns(a, b, run_one)
+    return _finish(
+        a, b, x, iters, curve, "bicgstab", pname, tol, raise_on_stagnation
+    )

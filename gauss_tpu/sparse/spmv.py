@@ -1,0 +1,117 @@
+"""Sparse matrix-vector kernels over CSR staging forms.
+
+Two jit-clean (callback-free) implementations back the Krylov solvers,
+both registered in ``core/entrypoints.py`` for the gauss-lint jaxpr
+audit:
+
+- ``spmv_ell`` — padded-row (ELLPACK) form: a gather + row reduction
+  over dense ``(n, k)`` arrays, which XLA vectorizes well and which the
+  while_loop solver bodies can close over with static shapes.  Also
+  accepts an ``(n, m)`` multivector for SpMM.
+- ``spmv_coo`` — ``jax.ops.segment_sum`` over row-sorted COO triplets:
+  the fallback when the padded-row form would waste memory (a few rows
+  far denser than the rest).
+
+``spmv_ell_pallas`` is the TPU row-block kernel behind the same
+auto-interpret routing as every other Pallas engine here (interpret mode
+everywhere that is not a real TPU): one program per block of ``bm``
+rows, the operand vector resident in VMEM, the gather and row reduction
+fused in-core.  Guide: /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["spmv_coo", "spmv_ell", "spmv_ell_pallas", "PALLAS_MIN_N"]
+
+#: Below this order the XLA forms win (kernel launch + padding overheads
+#: dominate); ``spmv`` routing prefers the Pallas path at or above it on
+#: real TPUs only.
+PALLAS_MIN_N = 4096
+
+
+@jax.jit
+def spmv_ell(cols, vals, x):
+    """``y = A @ x`` from padded-row staging ``cols``/``vals`` of shape
+    ``(n, k)`` (padding: column 0, value 0).  ``x`` may be ``(n,)`` or an
+    ``(n, m)`` multivector (SpMM)."""
+    if x.ndim == 1:
+        return (vals * x[cols]).sum(axis=1)
+    return jnp.einsum("rk,rkm->rm", vals, x[cols])
+
+
+@partial(jax.jit, static_argnames=("n",))
+def spmv_coo(rows, cols, vals, x, *, n):
+    """``y = A @ x`` from row-sorted COO triplets via ``segment_sum``.
+    ``n`` is static (the output segment count)."""
+    contrib = vals * x[cols] if x.ndim == 1 else vals[:, None] * x[cols]
+    return jax.ops.segment_sum(
+        contrib, rows, num_segments=n, indices_are_sorted=True
+    )
+
+
+def _auto_interpret(interpret):
+    if interpret is None:
+        # Same routing as kernels/matmul_pallas: anything that is not a
+        # real TPU runs the Pallas interpreter.
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _spmv_kernel(cols_ref, vals_ref, x_ref, o_ref):
+    # One program per bm-row block: gather the operand entries for every
+    # stored column in the block and reduce along the padded-row axis.
+    # The padding (column 0, value 0) contributes exactly zero.
+    o_ref[:] = jnp.sum(vals_ref[:] * x_ref[:][cols_ref[:]], axis=1)
+
+
+@partial(jax.jit, static_argnames=("bm", "interpret"))
+def spmv_ell_pallas(cols, vals, x, *, bm: int = 512, interpret=None):
+    """Pallas row-block ELL SpMV: grid over ``ceil(n / bm)`` row blocks,
+    ``x`` resident in VMEM (n * 4 bytes at f32 — well under the ~16 MB
+    VMEM budget for every order this plane serves).  1-D ``x`` only."""
+    n, k = vals.shape
+    grid = (n + bm - 1) // bm
+    npad = grid * bm - n
+    if npad:
+        cols = jnp.pad(cols, ((0, npad), (0, 0)))
+        vals = jnp.pad(vals, ((0, npad), (0, 0)))
+    y = pl.pallas_call(
+        _spmv_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((bm,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((grid * bm,), vals.dtype),
+        interpret=_auto_interpret(interpret),
+    )(cols, vals, x)
+    return y[:n]
+
+
+def spmv(a, x, *, impl: str = "auto"):
+    """Host convenience: ``A @ x`` for a ``CsrMatrix``, routing between
+    the staging forms (``auto`` prefers ELL; the Pallas path engages only
+    on a real TPU at ``n >= PALLAS_MIN_N``)."""
+    import numpy as np
+
+    if impl == "coo":
+        rows, cols, vals = a.coo()
+        return np.asarray(spmv_coo(rows, cols, vals, jnp.asarray(x), n=a.n))
+    cols, vals = a.ell()
+    xj = jnp.asarray(x)
+    if impl == "pallas" or (
+        impl == "auto"
+        and jax.default_backend() == "tpu"
+        and a.n >= PALLAS_MIN_N
+        and xj.ndim == 1
+    ):
+        return np.asarray(spmv_ell_pallas(jnp.asarray(cols), jnp.asarray(vals), xj))
+    return np.asarray(spmv_ell(jnp.asarray(cols), jnp.asarray(vals), xj))

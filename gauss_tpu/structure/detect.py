@@ -24,10 +24,13 @@ before anything is densified — produces a :class:`StructureInfo`:
   pretend to solve) — it classifies dense and takes general LU.
 - **density**: nnz / n^2.
 
-``kind`` is the routing class with precedence blockdiag > banded > spd >
-dense: a block-diagonal matrix is also banded and possibly SPD, but the
-batched small-block solve beats both; a banded SPD matrix takes the O(n b^2)
-band engine over the O(n^3/3) Cholesky.
+``kind`` is the routing class with precedence blockdiag > banded > sparse
+> spd > dense: a block-diagonal matrix is also banded and possibly SPD,
+but the batched small-block solve beats both; a banded SPD matrix takes
+the O(n b^2) band engine over the O(n^3/3) Cholesky; and a matrix at or
+below :data:`SPARSE_MAX_DENSITY` (with ``n >= SPARSE_MIN_N``) routes to
+the matrix-free Krylov plane (``gauss_tpu.sparse``) whether or not it is
+SPD — the certificate only picks WHICH Krylov head (CG vs GMRES).
 """
 
 from __future__ import annotations
@@ -38,8 +41,9 @@ from typing import Optional, Tuple
 import numpy as np
 
 #: routing classes, in router/inject tag order (inject kind="mistag" indexes
-#: this tuple via its float ``param``)
-STRUCTURE_KINDS = ("spd", "banded", "blockdiag", "dense")
+#: this tuple via its float ``param``; "sparse" appended LAST so historical
+#: mistag indices stay stable)
+STRUCTURE_KINDS = ("spd", "banded", "blockdiag", "dense", "sparse")
 
 #: a matrix is routed banded only when its bandwidth is at most n // this —
 #: past that the n*b^2 band solve loses its margin over blocked LU (and the
@@ -48,6 +52,16 @@ BANDED_MAX_DIVISOR = 8
 
 #: minimum number of contiguous diagonal blocks for the batched route
 BLOCKDIAG_MIN_BLOCKS = 2
+
+# Density at or below which a system routes to the sparse Krylov plane
+# (gauss_tpu.sparse). Sourced from the declared tune axis so the routing
+# boundary and the tuner's "sparse" op can never drift apart.
+from gauss_tpu.tune.space import SPARSE_DENSITY_SEED as SPARSE_MAX_DENSITY  # noqa: E402
+
+#: below this order the dense engines win outright (one small dispatch vs
+#: staging + iteration), so low density alone never routes sparse — which
+#: also keeps every historical small-n classification byte-stable.
+SPARSE_MIN_N = 256
 
 
 class StructureMismatchError(RuntimeError):
@@ -70,7 +84,11 @@ class StructureInfo:
 
     @property
     def kind(self) -> str:
-        """Routing class: blockdiag > banded > spd > dense."""
+        """Routing class: blockdiag > banded > sparse > spd > dense.
+        Sparse sits below the exact-structure classes (a sparse banded
+        matrix still wants the O(n b^2) direct factor over iteration)
+        and above spd (a certified-SPD matrix at sparse density wants CG,
+        not an n^3/3 Cholesky it cannot even allocate at scale)."""
         n = self.n
         if n <= 1:
             return "dense"  # trivial systems route straight through
@@ -78,6 +96,8 @@ class StructureInfo:
             return "blockdiag"
         if self.bandwidth <= max(1, n // BANDED_MAX_DIVISOR):
             return "banded"
+        if n >= SPARSE_MIN_N and 0.0 < self.density <= SPARSE_MAX_DENSITY:
+            return "sparse"
         if self.spd_likely:
             return "spd"
         return "dense"

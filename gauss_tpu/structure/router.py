@@ -42,7 +42,8 @@ from gauss_tpu.structure.detect import (
 #: which ladder rung counts as "the structured engine" per tag (anything
 #: else that serves the solution means the route DEMOTED)
 ENGINE_FOR_TAG = {"spd": "cholesky", "banded": "banded",
-                  "blockdiag": "blockdiag", "dense": "blocked"}
+                  "blockdiag": "blockdiag", "dense": "blocked",
+                  "sparse": "cg"}
 
 
 def routed_tag(info: StructureInfo,
@@ -128,6 +129,12 @@ def solve_auto(a, b, *, structure: Optional[str] = None,
         # tuned (its internal dtype demotion already ends at the same f32
         # path "blocked" is); only a rung BELOW the heads counts demoted.
         honest.add("lowered")
+    elif tag == "sparse":
+        # Any Krylov rung serving IS the sparse route working: CG heads
+        # the ladder only for Gershgorin-certified operands, and the
+        # general-system rungs under it (gmres, bicgstab) are the same
+        # iterative lane — method selection, not a densified demotion.
+        honest.update(("gmres", "bicgstab"))
     demoted = res.rung not in honest and n > 1
     obs.counter("structure.solves")
     if demoted:

@@ -111,6 +111,20 @@ OUTOFCORE_CT_SEED = 4096
 OUTOFCORE_CHUNK_SEED = 16
 OUTOFCORE_DEVICE_FRAC_SEED = 0.25
 
+#: sparse Krylov plane (gauss_tpu.sparse; docs/STRUCTURE.md sparse
+#: section): GMRES restart length — the resident Krylov basis, i.e. the
+#: O(nnz + n*restart) peak-memory bound the acceptance gate asserts —
+#: and the block size the block-Jacobi / blocked incomplete (ILU0/IC0)
+#: preconditioners partition on.
+SPARSE_RESTART_SEED = 32
+SPARSE_BLOCK_SEED = 16
+
+#: density at or below which the structure tagger classifies "sparse"
+#: (structure.detect.SPARSE_MAX_DENSITY re-exports it). A routing-policy
+#: bound, not a timing knob: declared so operators can recalibrate the
+#: sparse/dense boundary, never swept by default.
+SPARSE_DENSITY_SEED = 1.0 / 32.0
+
 #: host-f64 refinement rounds per batched serve dispatch
 #: (serve.admission.ServeConfig.refine_steps).
 SERVE_REFINE_SEED = 1
@@ -203,6 +217,16 @@ SPACES: Dict[str, Tuple[Axis, ...]] = {
         Axis("chunk", OUTOFCORE_CHUNK_SEED, (8, 32)),
         Axis("device_frac", OUTOFCORE_DEVICE_FRAC_SEED, (),
              sweep_default=False),
+    ),
+    # the sparse Krylov plane (gauss_tpu.sparse): restart length trades
+    # convergence per cycle against the resident-basis memory bound;
+    # block sizes the incomplete-factor partitions; the density threshold
+    # is the declared routing boundary (structure.detect), operator-set
+    # only.
+    "sparse": (
+        Axis("restart", SPARSE_RESTART_SEED, (16, 64)),
+        Axis("block", SPARSE_BLOCK_SEED, (8, 32)),
+        Axis("density", SPARSE_DENSITY_SEED, (), sweep_default=False),
     ),
     # serve-layer knobs consulted at warmup (bucket growth is declared for
     # operators; the pow2 ladder stays the only implemented policy)

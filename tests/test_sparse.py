@@ -1,0 +1,480 @@
+"""gauss_tpu.sparse: CSR assembly, SpMV kernels, Krylov solvers,
+preconditioners, routing/recovery integration, and the duplicate-semantics
+and density-boundary contracts the ISSUE pins.
+
+The detector boundary tests assert the sparse/dense threshold EXACTLY —
+density == SPARSE_MAX_DENSITY classifies sparse, one entry more does not,
+n == SPARSE_MIN_N - 1 never does — and that the coordinate-stream
+classifier agrees with the dense-scan classifier byte for byte at the
+boundary. The datfile tests pin the three duplicate conventions side by
+side: strict rejects, non-strict densify is last-wins (fscanf parity),
+non-strict sparse assembly sums.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from gauss_tpu.io import datfile, synthetic
+from gauss_tpu.sparse import (
+    CsrMatrix,
+    IterativeStagnationError,
+    build_preconditioner,
+    solve_bicgstab,
+    solve_cg,
+    solve_gmres,
+    solve_sparse,
+    spmv_coo,
+    spmv_ell,
+    spmv_ell_pallas,
+)
+from gauss_tpu.sparse.precond import PRECOND_KINDS, apply_precond
+from gauss_tpu.structure.cholesky import NotSPDError
+from gauss_tpu.structure.detect import (
+    SPARSE_MAX_DENSITY,
+    SPARSE_MIN_N,
+    StructureMismatchError,
+    detect_structure,
+    detect_structure_coords,
+)
+
+GATE = 1e-4
+
+
+def _system(n=200, nnz_per_row=6, seed=1, symmetric=True):
+    rows, cols, vals = synthetic.sparse_coords(
+        n, nnz_per_row, seed=seed, symmetric=symmetric)
+    a = CsrMatrix.from_coords(n, rows, cols, vals)
+    rng = np.random.default_rng(np.random.SeedSequence((seed, n, 7)))
+    return a, rng.standard_normal(n)
+
+
+# -- CSR assembly ----------------------------------------------------------
+
+class TestCsrMatrix:
+    def test_duplicates_are_summed(self):
+        a = CsrMatrix.from_coords(
+            3, [0, 0, 1, 2, 0], [0, 0, 1, 2, 2], [1.0, 2.5, 4.0, 5.0, -1.0])
+        dense = a.to_dense()
+        assert dense[0, 0] == 3.5 and dense[0, 2] == -1.0
+        assert a.nnz == 4  # the duplicate pair collapsed to one entry
+
+    def test_exact_zeros_dropped_by_default(self):
+        a = CsrMatrix.from_coords(2, [0, 1], [1, 0], [0.0, 2.0])
+        assert a.nnz == 1
+        kept = CsrMatrix.from_coords(2, [0, 1], [1, 0], [0.0, 2.0],
+                                     drop_zeros=False)
+        assert kept.nnz == 2
+
+    def test_cancelling_duplicates_drop(self):
+        a = CsrMatrix.from_coords(2, [0, 0], [1, 1], [3.0, -3.0])
+        assert a.nnz == 0
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            CsrMatrix.from_coords(2, [0, 2], [0, 0], [1.0, 1.0])
+        with pytest.raises(ValueError):
+            CsrMatrix.from_coords(2, [0, -1], [0, 0], [1.0, 1.0])
+
+    def test_from_dense_round_trip(self):
+        rng = np.random.default_rng(3)
+        d = np.where(rng.random((40, 40)) < 0.1, rng.standard_normal((40, 40)), 0.0)
+        a = CsrMatrix.from_dense(d)
+        assert np.array_equal(a.to_dense(), d)
+        assert a.nnz == int((d != 0).sum())
+
+    def test_densify_limit_refuses(self):
+        a, _ = _system(n=64)
+        big = CsrMatrix(n=10_000, indptr=np.zeros(10_001, np.int64),
+                        indices=np.zeros(0, np.int32),
+                        data=np.zeros(0, np.float64))
+        with pytest.raises(ValueError, match="densif"):
+            big.to_dense()
+        assert a.to_dense().shape == (64, 64)  # under the limit: fine
+
+    def test_gershgorin_certificate(self):
+        a, _ = _system(symmetric=True)
+        assert a.is_symmetric() and a.gershgorin_spd()
+        g, _ = _system(symmetric=False)
+        assert not g.gershgorin_spd()
+
+    def test_ell_and_coo_match_dense_matvec(self):
+        a, b = _system(n=150, nnz_per_row=5)
+        dense = a.to_dense()
+        np.testing.assert_allclose(a.matvec(b), dense @ b, rtol=1e-12)
+        cols, vals = a.ell()
+        assert cols.shape == vals.shape == (150, a.max_row_nnz)
+        np.testing.assert_allclose(
+            np.asarray(spmv_ell(cols, vals, b)), dense @ b, rtol=1e-5)
+        rows, ccols, cvals = a.coo()
+        np.testing.assert_allclose(
+            np.asarray(spmv_coo(rows, ccols, cvals, b, n=150)),
+            dense @ b, rtol=1e-5)
+
+    def test_pallas_spmv_matches(self):
+        a, b = _system(n=130, nnz_per_row=5)
+        cols, vals = a.ell()
+        got = np.asarray(spmv_ell_pallas(cols, vals, b, bm=32))
+        np.testing.assert_allclose(got, a.to_dense() @ b, rtol=1e-5)
+
+
+# -- streaming .dat reader + duplicate semantics ---------------------------
+
+class TestDatStreaming:
+    def _text(self, n=120, nnz_per_row=5, seed=4):
+        rows, cols, vals = synthetic.sparse_coords(n, nnz_per_row, seed=seed)
+        buf = io.StringIO()
+        datfile.write_dat(buf, n=n, rows=rows, cols=cols, vals=vals)
+        return buf.getvalue(), (rows, cols, vals)
+
+    def test_iter_coords_round_trip_exact(self):
+        text, (rows, cols, vals) = self._text()
+        st = datfile.iter_coords(io.StringIO(text), strict=True, chunk=37)
+        assert st.n == 120 and st.declared_nnz == len(vals)
+        got_r, got_c, got_v = [], [], []
+        nchunks = 0
+        for r, c, v in st:
+            assert len(r) <= 37
+            got_r.append(r), got_c.append(c), got_v.append(v)
+            nchunks += 1
+        assert nchunks > 1  # actually chunked
+        # %.17g round trip is EXACT, not approximately equal
+        assert np.array_equal(np.concatenate(got_r), rows)
+        assert np.array_equal(np.concatenate(got_c), cols)
+        assert np.array_equal(np.concatenate(got_v), vals)
+
+    def test_from_dat_matches_read_dat_densify(self):
+        text, _ = self._text()
+        a = CsrMatrix.from_dat(io.StringIO(text), strict=True)
+        n, rows, cols, vals = datfile.read_dat(io.StringIO(text))
+        assert np.array_equal(a.to_dense(),
+                              datfile.densify(n, rows, cols, vals))
+
+    def test_duplicate_three_conventions(self):
+        dup = "2 2 3\n1 1 1.5\n1 1 2.5\n2 2 1\n0 0 0\n"
+        # strict: typed rejection, naming both lines
+        with pytest.raises(datfile.DatFormatError, match="duplicate"):
+            for _ in datfile.iter_coords(io.StringIO(dup), strict=True):
+                pass
+        with pytest.raises(datfile.DatFormatError, match="line 2"):
+            datfile.read_dat(io.StringIO(dup), strict=True)
+        # non-strict densify: fscanf last-wins parity
+        n, r, c, v = datfile.read_dat(io.StringIO(dup), strict=False)
+        assert datfile.densify(n, r, c, v)[0, 0] == 2.5
+        # non-strict sparse assembly: summed
+        a = CsrMatrix.from_dat(io.StringIO(dup), strict=False)
+        assert a.to_dense()[0, 0] == 4.0
+
+    def test_stream_validation(self):
+        with pytest.raises(datfile.DatFormatError, match="promised"):
+            for _ in datfile.iter_coords(io.StringIO("2 2 2\n1 1 1\n")):
+                pass
+        with pytest.raises(datfile.DatFormatError, match="terminator"):
+            for _ in datfile.iter_coords(
+                    io.StringIO("1 1 1\n1 1 2\n"), strict=True):
+                pass
+        # EOF-terminated is fine non-strict
+        st = datfile.iter_coords(io.StringIO("1 1 1\n1 1 2\n"), strict=False)
+        (r, c, v), = list(st)
+        assert v[0] == 2.0
+        with pytest.raises(datfile.DatFormatError, match="out of bounds"):
+            for _ in datfile.iter_coords(io.StringIO("2 2 1\n3 1 1\n0 0 0\n")):
+                pass
+        with pytest.raises(datfile.DatFormatError, match="header"):
+            datfile.iter_coords(io.StringIO("2 3 1\n"))
+
+    def test_single_pass(self):
+        text, _ = self._text()
+        st = datfile.iter_coords(io.StringIO(text), strict=False)
+        list(st)
+        with pytest.raises(RuntimeError, match="single-pass"):
+            iter(st)
+
+
+# -- detector density boundary ---------------------------------------------
+
+class TestSparseBoundary:
+    def _boundary_coords(self, n, nnz):
+        """Exactly ``nnz`` entries: the diagonal plus symmetric off-diagonal
+        pairs far from the diagonal (so bandwidth stays > n // 8 and the
+        banded/blockdiag classes cannot win)."""
+        rows = list(range(n))
+        cols = list(range(n))
+        vals = [float(n)] * n
+        k = nnz - n
+        assert k >= 0 and k % 2 == 0
+        pairs = 0
+        for i in range(n):
+            for j in range(i + n // 2, n):
+                if pairs * 2 >= k:
+                    break
+                rows += [i, j]
+                cols += [j, i]
+                vals += [-1.0, -1.0]
+                pairs += 1
+            if pairs * 2 >= k:
+                break
+        return (np.array(rows), np.array(cols), np.array(vals))
+
+    def test_density_threshold_exact(self):
+        n = 256
+        at = int(SPARSE_MAX_DENSITY * n * n)  # nnz AT the threshold
+        rows, cols, vals = self._boundary_coords(n, at)
+        info = detect_structure_coords(n, rows, cols, vals)
+        assert info.density == SPARSE_MAX_DENSITY
+        assert info.kind == "sparse"
+        # one entry past the threshold: no longer sparse
+        rows2, cols2, vals2 = self._boundary_coords(n, at + 2)
+        info2 = detect_structure_coords(n, rows2, cols2, vals2)
+        assert info2.density > SPARSE_MAX_DENSITY
+        assert info2.kind != "sparse"
+
+    def test_min_n_floor(self):
+        n = SPARSE_MIN_N - 1
+        rows, cols, vals = self._boundary_coords(n, n + 2)
+        info = detect_structure_coords(n, rows, cols, vals)
+        assert info.density < SPARSE_MAX_DENSITY
+        assert info.kind != "sparse"  # small systems stay on dense engines
+
+    def test_coords_and_dense_classifiers_agree_at_boundary(self):
+        n = 256
+        for nnz in (int(SPARSE_MAX_DENSITY * n * n),
+                    int(SPARSE_MAX_DENSITY * n * n) + 2):
+            rows, cols, vals = self._boundary_coords(n, nnz)
+            ci = detect_structure_coords(n, rows, cols, vals)
+            di = detect_structure(datfile.densify(n, rows, cols, vals))
+            assert ci == di  # byte-for-byte StructureInfo equality
+            assert ci.kind == di.kind
+
+    def test_exact_structure_beats_sparse(self):
+        # A sparse-density banded matrix still routes banded: the O(n b^2)
+        # direct factor beats iteration.
+        a = synthetic.banded_matrix(512, 1)
+        info = detect_structure(a)
+        assert info.density <= SPARSE_MAX_DENSITY
+        assert info.kind == "banded"
+
+
+# -- Krylov solvers --------------------------------------------------------
+
+class TestKrylov:
+    def test_all_methods_converge_certified(self):
+        a, b = _system(n=220)
+        dense = a.to_dense()
+        for fn in (solve_cg, solve_gmres, solve_bicgstab):
+            res = fn(a, b, tol=GATE)
+            assert res.converged and res.rel_residual <= GATE
+            rel = np.linalg.norm(dense @ res.x - b) / np.linalg.norm(b)
+            assert rel <= GATE
+            assert res.iterations > 0
+            assert len(res.residuals) >= 1
+            assert np.isfinite(res.residuals).all()
+
+    def test_cg_refuses_uncertified(self):
+        a, b = _system(symmetric=False)
+        with pytest.raises(NotSPDError):
+            solve_cg(a, b)
+
+    def test_gmres_bicgstab_handle_nonsymmetric(self):
+        a, b = _system(n=220, symmetric=False)
+        dense = a.to_dense()
+        for fn in (solve_gmres, solve_bicgstab):
+            res = fn(a, b, tol=GATE)
+            rel = np.linalg.norm(dense @ res.x - b) / np.linalg.norm(b)
+            assert res.converged and rel <= GATE
+
+    def test_stagnation_is_typed_and_carries_result(self):
+        a, b = _system(n=220)
+        with pytest.raises(IterativeStagnationError) as ei:
+            solve_cg(a, b, tol=1e-30, maxiter=3)
+        err = ei.value
+        assert err.method == "cg" and err.iterations == 3
+        assert err.result is not None and err.result.x.shape == b.shape
+        # raise_on_stagnation=False returns the partial result instead
+        res = solve_cg(a, b, tol=1e-30, maxiter=3,
+                       raise_on_stagnation=False)
+        assert not res.converged
+
+    def test_multiple_rhs(self):
+        a, _ = _system(n=180)
+        rng = np.random.default_rng(9)
+        B = rng.standard_normal((180, 3))
+        res = solve_cg(a, B, tol=GATE)
+        r = a.to_dense() @ res.x - B
+        assert (np.linalg.norm(r, axis=0)
+                <= GATE * np.linalg.norm(B, axis=0)).all()
+
+
+# -- preconditioners -------------------------------------------------------
+
+class TestPreconditioners:
+    def test_each_kind_converges(self):
+        a, b = _system(n=240)
+        dense = a.to_dense()
+        for kind in PRECOND_KINDS:
+            prec = build_preconditioner(a, kind) if kind != "none" else None
+            res = solve_cg(a, b, precond=prec, tol=GATE)
+            rel = np.linalg.norm(dense @ res.x - b) / np.linalg.norm(b)
+            assert res.converged and rel <= GATE, kind
+
+    def test_apply_is_jit_consistent(self):
+        a, b = _system(n=96)
+        for kind in ("jacobi", "block_jacobi", "tridiag", "ilu0"):
+            prec = build_preconditioner(a, kind, block=16)
+            out = np.asarray(apply_precond(prec, b))
+            assert out.shape == b.shape and np.isfinite(out).all()
+
+    def test_ic0_requires_certificate(self):
+        g, _ = _system(symmetric=False)
+        with pytest.raises(StructureMismatchError):
+            build_preconditioner(g, "ic0")
+
+    def test_unknown_kind_rejected(self):
+        a, _ = _system(n=64)
+        with pytest.raises(ValueError):
+            build_preconditioner(a, "spai")
+
+
+# -- solve_sparse front door + obs -----------------------------------------
+
+class TestSolveSparse:
+    def test_auto_certified_uses_cg(self, tmp_path):
+        from gauss_tpu import obs
+        from gauss_tpu.obs import registry
+
+        a, b = _system(n=260)
+        out = tmp_path / "sparse.jsonl"
+        with obs.run(metrics_out=str(out)):
+            res = solve_sparse(a, b)
+        assert res.method == "cg" and res.converged
+        events = registry.read_events(str(out))
+        evs = [e for e in events if e.get("type") == "sparse_solve"]
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["method"] == "cg" and ev["converged"]
+        assert ev["certified_spd"] and ev["n"] == 260
+        assert isinstance(ev["residuals"], list)
+
+    def test_auto_uncertified_skips_cg(self):
+        a, b = _system(n=260, symmetric=False)
+        res = solve_sparse(a, b)
+        assert res.method in ("gmres", "bicgstab") and res.converged
+
+    def test_dense_input_accepted(self):
+        a, b = _system(n=128)
+        res = solve_sparse(a.to_dense(), b)
+        assert res.converged
+
+    def test_summary_and_regress_ingest(self, tmp_path):
+        from gauss_tpu.obs import regress, summarize
+        from gauss_tpu.sparse.check import history_records
+
+        summary = {
+            "kind": "sparse_solve", "gate": GATE,
+            "methods": {"cg": {"s_per_solve": 0.01, "iterations": 7}},
+            "giant": {"s_per_solve": 1.5, "peak_rss_bytes": 4.5e8},
+        }
+        recs = dict(
+            ((m, u), v) for m, v, u in history_records(summary))
+        assert recs[("sparse:cg/s_per_solve", "s")] == 0.01
+        assert recs[("sparse:giant/peak_rss_bytes", "bytes")] == 4.5e8
+        p = tmp_path / "summary.json"
+        p.write_text(json.dumps(summary))
+        ingested = regress.ingest_file(str(p))
+        assert {r["metric"] for r in ingested} == {
+            "sparse:cg/s_per_solve", "sparse:cg/iterations",
+            "sparse:giant/s_per_solve", "sparse:giant/peak_rss_bytes"}
+        assert all(r["kind"] == "sparse" for r in ingested)
+        # the summarize section folds sparse_solve events
+        evs = [{"run": "r1", "type": "run_start"},
+               {"run": "r1", "type": "sparse_solve", "method": "cg",
+                "precond": "jacobi", "converged": True, "iterations": 7,
+                "certified_spd": True, "n": 100, "nnz": 500,
+                "rel_residual": 5e-5, "wall_s": 0.01}]
+        sp = summarize.sparse_summary(evs)
+        assert sp["methods"]["cg"]["converged"] == 1
+        assert "sparse (Krylov) solves:" in summarize.summarize_run(evs, "r1")
+
+
+# -- routing + recovery integration ----------------------------------------
+
+class TestRoutingIntegration:
+    def test_solve_auto_routes_sparse(self):
+        from gauss_tpu.structure import solve_auto
+
+        a, b = _system(n=300)
+        res = solve_auto(a.to_dense(), b, gate=GATE)
+        assert res.rung == "cg" and res.rung_index == 0
+        rel = np.linalg.norm(a.to_dense() @ res.x - b) / np.linalg.norm(b)
+        assert rel <= GATE
+
+    def test_uncertified_demotes_typed_to_gmres(self):
+        from gauss_tpu.structure import solve_auto
+
+        a, b = _system(n=300, symmetric=False)
+        res = solve_auto(a.to_dense(), b, gate=GATE)
+        assert res.rung == "gmres"
+        assert ("cg", "exception:NotSPDError") in [
+            tuple(e) for e in res.escalations]
+
+    def test_structured_rungs_sparse_head(self):
+        from gauss_tpu.resilience import recover
+
+        rungs = recover.structured_rungs("sparse")
+        assert rungs[:3] == ("cg", "gmres", "bicgstab")
+        assert "blocked" in rungs  # the dense chain still backstops
+
+    def test_loadgen_sparse_token(self):
+        from gauss_tpu.serve.loadgen import materialize, parse_mix
+
+        (spec, w), = parse_mix("sparse:300/6")
+        assert spec.kind == "sparse"
+        a, b = materialize(spec, np.random.default_rng(0))
+        info = detect_structure(a)
+        assert info.kind == "sparse"
+        for bad in ("sparse:0", "sparse:8192", "sparse:64/0"):
+            with pytest.raises(ValueError):
+                parse_mix(bad)
+
+    def test_matrix_gen_sparse_writes_coords(self, capsys):
+        from gauss_tpu.cli.matrix_gen import main
+
+        assert main(["90", "--structure", "sparse:5", "--python"]) == 0
+        text = capsys.readouterr().out
+        a = CsrMatrix.from_dat(io.StringIO(text), strict=True)
+        rows, cols, vals = synthetic.sparse_coords(90, nnz_per_row=5)
+        dense = np.zeros((90, 90))
+        dense[rows, cols] = vals
+        assert np.array_equal(a.to_dense(), dense)
+        assert main(["10", "--structure", "sparse:0", "--python"]) == 1
+
+
+# -- generator determinism --------------------------------------------------
+
+class TestSyntheticSparse:
+    def test_deterministic_and_dominant(self):
+        r1 = synthetic.sparse_coords(500, 8, seed=11)
+        r2 = synthetic.sparse_coords(500, 8, seed=11)
+        for x, y in zip(r1, r2):
+            assert np.array_equal(x, y)
+        a = CsrMatrix.from_coords(500, *r1)
+        assert a.gershgorin_spd()
+        assert a.nnz <= 500 * 8 + 500
+
+    def test_nonsymmetric_still_dominant(self):
+        rows, cols, vals = synthetic.sparse_coords(200, 8, seed=2,
+                                                   symmetric=False)
+        a = CsrMatrix.from_coords(200, rows, cols, vals)
+        assert not a.is_symmetric()
+        d = np.abs(a.diagonal())
+        off = np.zeros(200)
+        rr = a.row_ids()
+        mask = rr != a.indices
+        np.add.at(off, rr[mask], np.abs(a.data[mask]))
+        assert (d > off).all()  # invertible by dominance
+
+    def test_sparse_matrix_densify_cap(self):
+        with pytest.raises(ValueError, match="densifies"):
+            synthetic.sparse_matrix(5000)

@@ -366,7 +366,9 @@ def test_chaos_structure_phase():
 
     out = run_structure_phase(seed=2584580, gate=GATE)
     assert out["violations"] == 0
-    assert out["injected"] == len(out["cases"]) == 12
+    # 4 true structures x (len(STRUCTURE_KINDS) - 1) wrong tags; grew
+    # from 12 when "sparse" joined the kind enumeration.
+    assert out["injected"] == len(out["cases"]) == 16
     assert out["demotions"] >= 4  # every truly-wrong engine demoted
 
 
