@@ -17,11 +17,12 @@ LINT_SMOKE ?= /tmp/gauss_lint_check
 FLIGHT_SMOKE ?= /tmp/gauss_flight_check
 PROF_SMOKE ?= /tmp/gauss_prof_check
 SPARSE_SMOKE ?= /tmp/gauss_sparse_check
+REPLICA_SMOKE ?= /tmp/gauss_replica_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
 	structure-check sparse-check tune-check live-check abft-check \
 	durable-check outofcore-check mesh-serve-check lint-check flight-check \
-	prof-check clean
+	prof-check replica-check clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -412,6 +413,36 @@ prof-check:
 	print('prof-check: reconcile %.6f s vs matrix %.6f s (tol %.6f s); named phase: %s' \
 	  % (r['request_device_s'], r['matrix_device_s'], r['tolerance_s'], \
 	     a['named_phase']))"
+
+# The replica gate (CI-callable): the network tier's kill-any-replica
+# contract. A ≥30-case chaos campaign (SIGKILL mid-load, SIGTERM drain,
+# SIGSTOP stall, torn journal tail, expired-during-failover, router
+# restarts of the assignment log) plus three live fleet legs: SIGKILL
+# each of 3 replicas in turn under load, a budget-free drain, and a
+# heartbeat-stall detection — every kill captures a post-mortem bundle
+# that passes gauss-debug --check. The invariant is the union journal
+# audit: every admitted request reaches exactly ONE terminal across the
+# victim+adopter journals (ok results re-verified at the 1e-4 gate from
+# journaled operands), zero duplicate solves under resubmission storms
+# (exit 2 on any violation). The throughput phase proves horizontal
+# scaling: 3 replicas behind the router must clear >= 2x the single-
+# replica request rate under an injected per-dispatch delay (nproc-
+# independent). replica:s_per_request and replica:failover_recovery_s are
+# regress-gated against the committed epochs. Timing-gated: honor the
+# serial-ordering note above.
+replica-check:
+	rm -rf $(REPLICA_SMOKE) && mkdir -p $(REPLICA_SMOKE)
+	timeout -k 10 840 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.serve.replicacheck --cases 30 --seed 190733 \
+	  --tmpdir $(REPLICA_SMOKE) \
+	  --metrics-out $(REPLICA_SMOKE)/replica.jsonl \
+	  --summary-json $(REPLICA_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(REPLICA_SMOKE)/replica.jsonl \
+	  --json | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	rp=[r['replica'] for r in runs.values() if r.get('replica')]; \
+	assert rp and rp[0]['campaign'].get('invariant_ok') \
+	  and rp[0]['campaign'].get('case_violations') == 0, rp; \
+	print('replica-check: campaign summary ok:', rp[0]['campaign'])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -61,6 +61,8 @@ DEFAULT_FILES = (
     "gauss_tpu/serve/cache.py",
     "gauss_tpu/serve/admission.py",
     "gauss_tpu/serve/durable.py",
+    "gauss_tpu/serve/net.py",
+    "gauss_tpu/serve/router.py",
     "gauss_tpu/resilience/inject.py",
 )
 

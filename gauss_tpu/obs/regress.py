@@ -351,6 +351,23 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "replica_campaign":
+        # A kill-the-replica campaign summary (python -m gauss_tpu.serve
+        # .replicacheck --summary-json): the 3-replica per-request serving
+        # cost and the SIGKILL failover recovery latency enter history —
+        # the network tier getting slower to serve or slower to fail over
+        # gates exactly like a perf regression (the exactly-once ledger
+        # INVARIANT itself is a hard exit-2, not a band). Derivation lives
+        # with the campaign runner (single source); lazy import keeps jax
+        # out of this module.
+        from gauss_tpu.serve.replicacheck import history_records as \
+            replica_hist
+
+        for metric, value, unit in replica_hist(doc):
+            rec = _record(metric, value, path, "replica", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "flight_check":
         # A flight-recorder gate summary (python -m gauss_tpu.obs
         # .flightcheck --summary-json): the measured ring-on overhead

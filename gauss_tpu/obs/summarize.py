@@ -778,6 +778,89 @@ def _fleet_lines(fs: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def replica_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the network replica tier's events (``router`` lifecycle from
+    gauss_tpu.serve.router, per-replica ``replica`` spawn/listen/drain,
+    ``replica_adopt`` journal adoptions, ``replica_failover`` handoff
+    reports, and ``replica_campaign`` chaos-audit verdicts) into one
+    report. Empty dict when the run served no replica fleet."""
+    router = [ev for ev in events if ev.get("type") == "router"]
+    replicas = [ev for ev in events if ev.get("type") == "replica"]
+    adopts = [ev for ev in events if ev.get("type") == "replica_adopt"]
+    fails = [ev for ev in events if ev.get("type") == "replica_failover"]
+    camps = [ev for ev in events if ev.get("type") == "replica_campaign"]
+    if not (router or replicas or fails or camps):
+        return {}
+    revents: Dict[str, int] = {}
+    for ev in router:
+        k = str(ev.get("event", "?"))
+        revents[k] = revents.get(k, 0) + 1
+    fail_causes: Dict[str, int] = {}
+    recoveries = []
+    for ev in fails:
+        cause = str(ev.get("cause", "?"))
+        fail_causes[cause] = fail_causes.get(cause, 0) + 1
+        if isinstance(ev.get("recovery_s"), (int, float)):
+            recoveries.append(float(ev["recovery_s"]))
+    out: Dict[str, Any] = {
+        "router_events": revents,
+        "replica_events": len(replicas),
+        "failovers": {
+            "count": len(fails),
+            "by_cause": fail_causes,
+            "pins_moved": sum(int(ev.get("pins_moved", 0) or 0)
+                              for ev in fails),
+            "replayed": sum(int(ev.get("replayed", 0) or 0)
+                            for ev in fails),
+            "imported": sum(int(ev.get("imported", 0) or 0)
+                            for ev in fails),
+            "expired": sum(int(ev.get("expired", 0) or 0) for ev in fails),
+            "max_recovery_s": max(recoveries) if recoveries else None,
+        },
+        "adoptions": len(adopts),
+    }
+    if camps:
+        last = camps[-1]
+        out["campaign"] = {k: last.get(k)
+                           for k in ("cases", "admitted", "case_violations",
+                                     "replayed_on_peer",
+                                     "expired_in_failover",
+                                     "invariant_ok")
+                           if last.get(k) is not None}
+        cv = out["campaign"].get("case_violations")
+        if isinstance(cv, list):
+            # The campaign event carries the violating cases themselves;
+            # the summary only needs how many there were.
+            out["campaign"]["case_violations"] = len(cv)
+    return out
+
+
+def _replica_lines(rp: Dict[str, Any]) -> List[str]:
+    lines = []
+    re_ = ", ".join(f"{k} x{v}"
+                    for k, v in sorted(rp["router_events"].items()))
+    lines.append(f"  router: {re_ or '-'}; "
+                 f"{rp['replica_events']} replica event(s)")
+    fo = rp["failovers"]
+    if fo["count"]:
+        causes = ", ".join(f"{k} x{v}"
+                           for k, v in sorted(fo["by_cause"].items()))
+        tail = (f"  failovers: {fo['count']}  ({causes}); "
+                f"{fo['pins_moved']} pin(s) moved, "
+                f"{fo['replayed']} replayed, {fo['imported']} imported, "
+                f"{fo['expired']} expired-in-failover")
+        if isinstance(fo["max_recovery_s"], (int, float)):
+            tail += f"; worst recovery {_fmt(fo['max_recovery_s'])} s"
+        lines.append(tail)
+    if rp["adoptions"]:
+        lines.append(f"  adoptions: {rp['adoptions']} journal(s) adopted")
+    camp = rp.get("campaign")
+    if camp:
+        kv = " ".join(f"{k}={_fmt(v)}" for k, v in camp.items())
+        lines.append(f"  campaign: {kv}")
+    return lines
+
+
 def tuning_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the autotuner's events into one report: store consults with
     their provenance (``tune`` events: source=store|seed, reason on
@@ -898,6 +981,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "sdc": sdc_summary(evs),
         "postmortems": postmortem_summary(evs),
         "fleet": fleet_summary(evs),
+        "replica": replica_summary(evs),
         "tuning": tuning_summary(evs),
         "comms": comms_summary(evs),
         "compile": [_strip(ev) for ev in evs
@@ -1003,6 +1087,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("fleet:")
         out.extend(_fleet_lines(fleet))
+
+    replica = replica_summary(evs)
+    if replica:
+        out.append("")
+        out.append("replica tier (network serving):")
+        out.extend(_replica_lines(replica))
 
     tuning = tuning_summary(evs)
     if tuning:

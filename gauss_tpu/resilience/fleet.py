@@ -64,10 +64,47 @@ ENV_LEASE = "GAUSS_FLEET_LEASE"
 #: a worker's own watchdog fired (peer dead/stalled): the worker is healthy
 #: but cannot make progress; its respawn is free (bounded separately).
 PEER_LOST_EXIT = 117
+#: a child exited from a GRACEFUL drain (SIGTERM -> drain -> this code):
+#: an operator-initiated shutdown, not a failure. Supervisors respawn it
+#: WITHOUT charging the bounded restart budget — before this code existed
+#: a rolling drain was indistinguishable from a crash loop and could
+#: exhaust max_restarts (ISSUE 19 satellite).
+DRAIN_EXIT = 116
 #: unrecoverable configuration/checkpoint mismatch inside a worker.
 CONFIG_EXIT = 115
 
 RUNGS = ("supervised", "restart", "shrink", "local_finish")
+
+#: death causes whose respawn does not consume the restart budget:
+#: peer_lost is a secondary casualty (bounded separately), drained is an
+#: operator-initiated graceful exit.
+FREE_RESPAWN_CAUSES = ("peer_lost", "drained")
+
+
+def exit_cause(rc: Optional[int]) -> str:
+    """Classify a supervised child's exit code into the shared cause
+    vocabulary: ``"clean"`` (0), ``"killed"`` (the fault injector's
+    os._exit), ``"drained"`` (graceful SIGTERM drain — :data:`DRAIN_EXIT`),
+    ``"peer_lost"``, ``"config"``, or ``"crashed"`` (anything else,
+    including signal deaths, where ``rc`` is negative). Both the fleet
+    supervisor and the serve replica router classify deaths through this
+    one function, so the drain-vs-crash accounting can never diverge
+    between them."""
+    if rc == 0:
+        return "clean"
+    return {_inject.KILL_EXIT_CODE: "killed",
+            DRAIN_EXIT: "drained",
+            PEER_LOST_EXIT: "peer_lost",
+            CONFIG_EXIT: "config"}.get(rc, "crashed")
+
+
+def counts_against_restart_budget(cause: str) -> bool:
+    """Does a death with this :func:`exit_cause` consume the bounded
+    restart budget? Real failures (crash / injected kill / stall-kill /
+    config) do; graceful drains and peer-lost watchdog exits respawn
+    free, so a rolling drain or one fault's secondary casualties cannot
+    exhaust ``max_restarts``."""
+    return cause not in FREE_RESPAWN_CAUSES and cause != "clean"
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -499,20 +536,19 @@ def _supervise(cfg: FleetConfig, jobdir: str, a64, b64):
                     _reap(w)
                     continue
                 _reap(w)
-                cause = {_inject.KILL_EXIT_CODE: "killed",
-                         PEER_LOST_EXIT: "peer_lost",
-                         CONFIG_EXIT: "config"}.get(rc, "crashed")
-                if cause != "peer_lost":
+                cause = exit_cause(rc)
+                if counts_against_restart_budget(cause):
                     # A peer_lost exit is a secondary casualty of a death
                     # already bundled — bundling it too would storm one
-                    # bundle per surviving worker per fault.
+                    # bundle per surviving worker per fault. A drained
+                    # exit is not a failure at all; neither gets a bundle.
                     capture("fleet_worker_dead", w, rc=rc, exit_cause=cause)
                 if cause == "config":
                     raise FleetError(
                         f"worker {w.id} exited with a configuration/"
                         f"checkpoint mismatch (exit {rc}); see "
                         f"{jobdir}/logs/")
-                kills += cause != "peer_lost"
+                kills += counts_against_restart_budget(cause)
                 obs.counter("fleet.worker_deaths")
                 obs.emit("fleet", event="worker_dead", worker=w.id, rc=rc,
                          cause=cause)
@@ -520,9 +556,16 @@ def _supervise(cfg: FleetConfig, jobdir: str, a64, b64):
                 replace.append(w)
 
             for w in replace:
-                if w.proc.returncode == PEER_LOST_EXIT \
+                dead_cause = exit_cause(w.proc.returncode)
+                if dead_cause == "peer_lost" \
                         and peer_respawns < cfg.max_peer_respawns:
                     peer_respawns += 1
+                elif dead_cause == "drained":
+                    # Graceful drain: the replacement is free — an
+                    # operator rolling workers must not spend the crash
+                    # budget (the stall path killed via SIGKILL, so a
+                    # stalled worker still lands in the bounded branch).
+                    pass
                 elif restarts < cfg.max_restarts:
                     restarts += 1
                     rung_index = max(rung_index, 1)

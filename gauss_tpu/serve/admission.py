@@ -415,6 +415,22 @@ class ServeRequest:
                     f"was cancelled")
         return self._result  # lockset: ok — read after the done event; _done.set() under the lock is the happens-before edge
 
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request resolves WITHOUT cancelling on timeout.
+
+        The server-side wait: the network tier parks an HTTP handler here
+        while the client may retry/poll on other connections — a timeout
+        means "respond 202 and keep serving", not "the client gave up", so
+        cancelling (what :meth:`result` does) would be wrong. Returns True
+        when the request holds a terminal."""
+        return self._done.wait(timeout)
+
+    def peek(self) -> Optional[ServeResult]:
+        """The terminal result if resolved, else None (never blocks)."""
+        if not self._done.is_set():
+            return None
+        return self._result  # lockset: ok — read after the done event; _done.set() under the lock is the happens-before edge
+
     @property
     def done(self) -> bool:
         return self._done.is_set()

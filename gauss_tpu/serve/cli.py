@@ -199,6 +199,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "every result, and the cost section in the report "
                         "(docs/OBSERVABILITY.md 'Attribution & roofline'); "
                         "off = byte-identical pre-attribution traces")
+    # -- the network tier (gauss_tpu.serve.net / serve.router) -------------
+    p.add_argument("--net", default=None, metavar="URL",
+                   help="drive the load over HTTP against a running "
+                        "request endpoint (a replica or a router front) "
+                        "instead of an in-process server; same mix tokens "
+                        "and verification gate, history metrics tagged "
+                        "serve:net:<mode> (docs/SERVING.md network tier)")
+    p.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="spawn N journaled replica processes behind a "
+                        "consistent-hash router front and drive the load "
+                        "through it; a replica killed mid-load fails its "
+                        "journal over to a surviving peer with zero lost "
+                        "requests (the replica-check invariant)")
+    p.add_argument("--port", type=int, default=0, metavar="P",
+                   help="with --replicas: the router front's listen port "
+                        "(default 0 = ephemeral)")
+    p.add_argument("--fleet-dir", default=None, metavar="DIR",
+                   help="with --replicas: fleet state root (per-replica "
+                        "journal/flight/heartbeat dirs + the router's "
+                        "assign log; default: a fresh temp dir)")
     # -- live telemetry plane ---------------------------------------------
     p.add_argument("--live-port", type=int, default=None, metavar="PORT",
                    help="embed the live telemetry endpoint on PORT "
@@ -280,20 +300,55 @@ def main(argv=None) -> int:
         nrhs=args.nrhs, seed=args.seed, deadline_s=args.deadline,
         request_ids=args.request_ids, serve=serve_cfg)
 
+    if args.net and args.replicas:
+        print("gauss-serve: --net and --replicas are exclusive (--net "
+              "targets an endpoint that already exists)", file=sys.stderr)
+        return 2
     with obs.run(metrics_out=args.metrics_out, tool="gauss_serve",
                  mode=args.mode, mix=args.mix, requests=args.requests):
-        with SolverServer(serve_cfg) as server:
-            if args.journal:
-                # Graceful drain: SIGTERM -> stop admitting, flush
-                # in-flight batches, journal the clean-shutdown marker,
-                # exit 0 — the next start replays nothing.
-                _install_drain_handler(server)
-            if server.live_url:
-                print(f"live telemetry: {server.live_url}/metrics "
-                      f"(watch with: gauss-top --url {server.live_url})")
-            if args.journal and server.last_resume:
-                print(f"journal: {args.journal} resume={server.last_resume}")
-            summary = run_load(server, cfg)
+        if args.net or args.replicas:
+            # The network tier: the same loadgen plan through
+            # serve.net.SolveClient — against an existing endpoint
+            # (--net) or a freshly spawned replica fleet (--replicas).
+            import tempfile
+
+            from gauss_tpu.serve.net import SolveClient
+            from gauss_tpu.serve.router import Router, RouterConfig
+
+            router = None
+            try:
+                if args.replicas:
+                    fleet_dir = (args.fleet_dir
+                                 or tempfile.mkdtemp(prefix="gauss_fleet-"))
+                    router = Router(RouterConfig(
+                        replicas=args.replicas, port=args.port,
+                        dir=fleet_dir, ladder=tuple(ladder),
+                        max_batch=args.max_batch, max_queue=args.max_queue,
+                        linger_s=args.linger, dtype=args.dtype)).start()
+                    url = router.url
+                    print(f"replica fleet: {args.replicas} replica(s) "
+                          f"behind {url} (state: {fleet_dir})")
+                else:
+                    url = args.net
+                summary = run_load(SolveClient(url), cfg)
+            finally:
+                if router is not None:
+                    out = router.stop(drain=True)
+                    print(f"fleet drained: {out['causes']}")
+        else:
+            with SolverServer(serve_cfg) as server:
+                if args.journal:
+                    # Graceful drain: SIGTERM -> stop admitting, flush
+                    # in-flight batches, journal the clean-shutdown marker,
+                    # exit 0 — the next start replays nothing.
+                    _install_drain_handler(server)
+                if server.live_url:
+                    print(f"live telemetry: {server.live_url}/metrics "
+                          f"(watch with: gauss-top --url {server.live_url})")
+                if args.journal and server.last_resume:
+                    print(f"journal: {args.journal} "
+                          f"resume={server.last_resume}")
+                summary = run_load(server, cfg)
     print(format_summary(summary))
     if args.metrics_out:
         print(f"metrics: {args.metrics_out}")

@@ -392,6 +392,13 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
                      if k in ("entries", "capacity", "evictions")}},
         "verify_gate": cfg.verify_gate,
     }
+    if getattr(server, "url", None) is not None:
+        # The "server" is a network client (gauss_tpu.serve.net.SolveClient
+        # — the --net mode): record the endpoint, and history_records tags
+        # the metrics ``serve:net:...`` so wire-path epochs band separately
+        # from the in-process serve bands while keeping the same metric
+        # family and verification gate.
+        summary["net"] = server.url
     mesh = server.lane_stats() if hasattr(server, "lane_stats") else None
     if mesh is not None:
         # The mesh serving plane was on: the lane-set report (lane count /
@@ -452,6 +459,10 @@ def history_records(summary: Dict) -> List[Tuple[str, float]]:
     epochs never pollute each other's baselines — and LANE-qualified, so a
     mesh run's throughput never drags the single-lane serve-check band)."""
     tag = f"serve:{summary.get('mode', 'closed')}"
+    if summary.get("net"):
+        # Wire-path runs pay HTTP/codec overhead on top of serving — they
+        # get their own band instead of dragging the in-process one.
+        tag = f"serve:net:{summary.get('mode', 'closed')}"
     mesh = summary.get("mesh")
     if mesh:
         tag += f":l{mesh.get('lanes')}"

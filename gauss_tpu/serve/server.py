@@ -648,10 +648,20 @@ class SolverServer:
         # request is either enqueued strictly before the close (stop's
         # drain/flush owns it) or rejected here — there is no window where
         # an accepted request can miss both and hang its client.
+        dup = None
         with self._depth_lock:
             closed = self._closed
-            full = not closed and self._depth >= bound
-            if not closed and not full:
+            if not closed and jr is not None and request_id:
+                # Re-check the pending map INSIDE the critical section: the
+                # lock-free check above and this insert are not atomic, and
+                # both a concurrent double-submit and a failover adoption
+                # (net.adopt_journal inserts pending entries under this
+                # same lock) can land the key between them. Losing the race
+                # here would journal a second admit for one logical request
+                # — two solves, two terminals.
+                dup = self._rid_pending.get(request_id)
+            full = (not closed and dup is None and self._depth >= bound)
+            if not closed and dup is None and not full:
                 if jr is not None:
                     # Write-ahead: the admit is journaled (and the
                     # terminal hook installed) INSIDE the admission
@@ -672,6 +682,11 @@ class SolverServer:
                 self._depth += 1
                 if lanes is None:
                     self._queue.put(req)
+        if dup is not None:
+            obs.counter("serve.deduped_pending")
+            obs.emit("serve_dedup", request_id=request_id,
+                     trace=dup.trace_id, pending=True, raced=True)
+            return dup
         if not closed and not full and lanes is not None:
             # Lane placement happens OUTSIDE the depth lock (it takes
             # per-lane locks; the worker threads take those and then the

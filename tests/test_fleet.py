@@ -332,6 +332,33 @@ def test_supervised_elastic_shrink_and_local_finish(rng):
     np.testing.assert_array_equal(clean.x, local.x)
 
 
+def test_exit_cause_vocabulary_and_restart_budget():
+    """Regression (ISSUE 19 satellite): a graceful SIGTERM drain must be
+    DISTINGUISHED from a crash and must not spend max_restarts — before
+    the fix every nonzero rc was 'killed' and a rolling drain could
+    exhaust the budget."""
+    from gauss_tpu.resilience import inject as _inject
+
+    assert fleet.exit_cause(0) == "clean"
+    assert fleet.exit_cause(fleet.DRAIN_EXIT) == "drained"
+    assert fleet.exit_cause(fleet.PEER_LOST_EXIT) == "peer_lost"
+    assert fleet.exit_cause(fleet.CONFIG_EXIT) == "config"
+    assert fleet.exit_cause(_inject.KILL_EXIT_CODE) == "killed"
+    assert fleet.exit_cause(1) == "crashed"
+    assert fleet.exit_cause(-9) == "crashed"  # signal death
+
+    # budget accounting: real failures spend it, drains/peer-lost don't
+    assert fleet.counts_against_restart_budget("killed")
+    assert fleet.counts_against_restart_budget("crashed")
+    assert fleet.counts_against_restart_budget("stalled")
+    assert not fleet.counts_against_restart_budget("drained")
+    assert not fleet.counts_against_restart_budget("peer_lost")
+    assert not fleet.counts_against_restart_budget("clean")
+    # the three sentinel codes never collide
+    assert len({fleet.DRAIN_EXIT, fleet.PEER_LOST_EXIT, fleet.CONFIG_EXIT,
+                _inject.KILL_EXIT_CODE, 0}) == 5
+
+
 def test_fleet_bad_request_and_config():
     with pytest.raises(ValueError):
         fleet.solve_supervised(np.ones((4, 3)), np.ones(4))

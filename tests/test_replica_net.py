@@ -1,0 +1,427 @@
+"""Network-tier tests: the wire codec (result docs, slab framing, the
+full-jitter backoff policy), the replica HTTP endpoint end to end
+(solve, idempotent resubmission, chunked upload, Retry-After on
+overload), journal adoption on a surviving peer (typed STATUS_EXPIRED
+for deadline-dead admits, resubmit-racing-replay dedupe), the router's
+consistent-hash ring and durable assignment log, and the obs wiring
+(``kind: replica_campaign`` regress ingest, the summarizer's replica
+section, the loadgen ``serve:net:`` history tag).
+
+All CPU (conftest pins the platform); servers share one module-scoped
+executable cache so the jitted batch executables compile once.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gauss_tpu.obs import regress, summarize
+from gauss_tpu.serve import (
+    STATUS_EXPIRED,
+    STATUS_OK,
+    ServeConfig,
+    SolverServer,
+    durable,
+    loadgen,
+    net,
+)
+from gauss_tpu.serve.cache import ExecutableCache
+from gauss_tpu.serve.router import AssignLog, HashRing
+from gauss_tpu.verify import checks
+
+GATE = 1e-4
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(64)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(190733)
+
+
+def _system(rng, n):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _config(journal_dir=None, **over):
+    kw = dict(ladder=(16, 32), max_batch=4, panel=16, refine_steps=1,
+              verify_gate=GATE, journal_dir=journal_dir,
+              journal_fsync_batch=4)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+# -- wire codec ------------------------------------------------------------
+
+def test_result_doc_roundtrip(rng):
+    x = rng.standard_normal(7)
+    from gauss_tpu.serve.admission import ServeResult
+
+    original = ServeResult(status=STATUS_OK, x=x, lane="batched",
+                           bucket_n=16, trace="t-1", latency_s=0.5,
+                           queue_s=0.1, rel_residual=1e-9,
+                           device_s=0.01, compile_s=0.2)
+    doc = net.result_doc(original)
+    assert doc["schema"] == net.WIRE_SCHEMA
+    back = net.doc_result(json.loads(json.dumps(doc)))  # through the wire
+    assert back.status == STATUS_OK and back.lane == "batched"
+    assert back.bucket_n == 16 and back.trace == "t-1"
+    assert back.rel_residual == pytest.approx(1e-9)
+    assert back.device_s == pytest.approx(0.01)
+    np.testing.assert_allclose(back.x, x)
+
+    none_doc = net.result_doc(ServeResult(status="rejected",
+                                          retry_after_s=0.4))
+    assert "x" not in none_doc
+    assert net.doc_result(none_doc).x is None
+
+
+def test_full_jitter_backoff_bounds():
+    r = random.Random(7)
+    for attempt in range(12):
+        ceiling = min(30.0, 0.05 * 2 ** attempt)
+        for _ in range(20):
+            v = net.full_jitter_backoff(0.05, attempt, rng=r)
+            assert 0.0 <= v <= ceiling
+    # the cap bounds late attempts
+    assert all(net.full_jitter_backoff(1.0, 50, rng=r, cap_s=2.0) <= 2.0
+               for _ in range(50))
+
+
+def test_slab_framing_covers_and_counts(rng):
+    a = rng.standard_normal((37, 5))
+    target = 200  # bytes — forces many slabs at this shape
+    slabs = list(net.iter_slabs(a, target_bytes=target))
+    assert [s[0] for s in slabs] == list(range(len(slabs)))
+    assert len(slabs) == net.slab_count(37, 5, a.dtype.itemsize,
+                                        target_bytes=target)
+    rebuilt = np.vstack([rows for _, _, _, rows in slabs])
+    np.testing.assert_array_equal(rebuilt, a)
+    # slab boundaries tile [0, n) without gap or overlap
+    edges = [(r0, r1) for _, r0, r1, _ in slabs]
+    assert edges[0][0] == 0 and edges[-1][1] == 37
+    assert all(p[1] == q[0] for p, q in zip(edges, edges[1:]))
+
+
+def test_matrix_digest_is_content_keyed(rng):
+    a = rng.standard_normal((6, 6))
+    assert net.matrix_digest(a) == net.matrix_digest(a.copy())
+    assert net.matrix_digest(a) != net.matrix_digest(a + 1e-9)
+
+
+# -- the replica HTTP endpoint --------------------------------------------
+
+def test_http_solve_e2e_idempotent_resubmit(tmp_path, rng, shared_cache):
+    srv = SolverServer(_config(str(tmp_path / "journal")),
+                       cache=shared_cache)
+    srv.start()
+    api = net.RequestApi(net.ReplicaApp(srv)).start()
+    try:
+        client = net.SolveClient(api.url, seed=3)
+        a, b = _system(rng, 12)
+        res = client.solve(a, b, request_id="e2e-1", timeout=60)
+        assert res.status == STATUS_OK
+        assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+        served = srv.requests_served
+        # resubmitting the SAME idempotency key resolves from the journal
+        # without a second solve (a fresh trace is minted — the dedupe is
+        # a new client interaction — but the solve count must not move)
+        res2 = client.solve(a, b, request_id="e2e-1", timeout=60)
+        assert res2.status == STATUS_OK
+        assert srv.requests_served == served
+        np.testing.assert_allclose(res2.x, res.x)
+        # the async handle path
+        h = client.submit(a, b, request_id="e2e-2")
+        assert h.result(60).status == STATUS_OK
+    finally:
+        api.stop()
+        srv.stop(drain=True)
+
+
+def test_http_chunked_upload_solve(tmp_path, rng, shared_cache):
+    srv = SolverServer(_config(str(tmp_path / "journal")),
+                       cache=shared_cache)
+    srv.start()
+    api = net.RequestApi(net.ReplicaApp(srv)).start()
+    try:
+        # threshold 0: every operand goes through POST /v1/upload slabs
+        client = net.SolveClient(api.url, upload_threshold=0, seed=5)
+        a, b = _system(rng, 24)
+        res = client.solve(a, b, timeout=60)
+        assert res.status == STATUS_OK
+        assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+    finally:
+        api.stop()
+        srv.stop(drain=True)
+
+
+def test_queue_full_503_carries_retry_after(rng):
+    srv = SolverServer(_config(max_queue=1))  # worker NOT started
+    api = net.RequestApi(net.ReplicaApp(srv)).start()
+    try:
+        a, b = _system(rng, 8)
+        body = json.dumps({
+            "schema": net.WIRE_SCHEMA, "wait_s": 0,
+            "a": durable.encode_array(a),
+            "b": durable.encode_array(b)}).encode()
+
+        def _post():
+            req = urllib.request.Request(
+                api.url + "/v1/solve", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, dict(resp.headers)
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code, dict(e.headers or {})
+
+        codes = [_post() for _ in range(3)]
+        # queued admits park (202); the over-bound one is shed with the
+        # drain hint surfaced as an integer Retry-After header
+        assert any(code == 202 for code, _ in codes)
+        shed = [(code, hdrs) for code, hdrs in codes if code == 503]
+        assert shed
+        assert int(shed[-1][1]["Retry-After"]) >= 1
+    finally:
+        api.stop()
+        srv.stop(drain=False)
+
+
+def test_bad_schema_and_unknown_rid(rng):
+    srv = SolverServer(_config(max_queue=4))
+    app = net.ReplicaApp(srv)
+    code, payload = app.handle_solve({"schema": 99})
+    assert code == 400 and "schema" in payload["error"]
+    assert app.lookup("no-such-rid") == (None, None)
+    srv.stop(drain=False)
+
+
+# -- journal adoption (failover replay on a surviving peer) ----------------
+
+def test_adopt_expired_yields_typed_terminal(tmp_path, rng, shared_cache):
+    """An admit whose deadline died during the failover window must
+    resolve as STATUS_EXPIRED on the adopter — never a silent drop."""
+    victim_dir = str(tmp_path / "victim")
+    victim = SolverServer(_config(victim_dir))  # worker NOT started
+    a, b = _system(rng, 10)
+    victim.submit(a, b, deadline_s=0.05, request_id="dead-rid")
+    victim.submit(a, b, request_id="live-rid")
+    victim._crash()
+    time.sleep(0.1)  # the 50 ms deadline expires before adoption
+
+    survivor = SolverServer(_config(str(tmp_path / "survivor")),
+                            cache=shared_cache)
+    survivor.start()
+    try:
+        out = net.adopt_journal(survivor, victim_dir)
+        assert out["expired"] == 1 and out["replayed"] == 1
+        t0 = time.monotonic()
+        while (time.monotonic() - t0 < 60
+               and not {"dead-rid", "live-rid"}
+               <= set(survivor._rid_terminals)):
+            time.sleep(0.01)
+        dead = survivor._rid_terminals["dead-rid"]
+        assert dead["status"] == STATUS_EXPIRED
+        live = survivor._rid_terminals["live-rid"]
+        assert live["status"] == STATUS_OK
+        x = durable.decode_array(live["x"]).reshape(-1)
+        assert checks.residual_norm(a, x, b, relative=True) <= GATE
+    finally:
+        survivor.stop(drain=True)
+
+
+def test_resubmit_racing_replay_dedupes(tmp_path, rng, shared_cache):
+    """A client resubmission that lands on the adopter BEFORE the replay
+    folds the victim's journal must end with exactly one terminal for the
+    rid — the replay skips the already-owned key."""
+    victim_dir = str(tmp_path / "victim")
+    victim = SolverServer(_config(victim_dir))  # worker NOT started
+    a, b = _system(rng, 10)
+    victim.submit(a, b, request_id="raced-rid")
+    victim._crash()
+
+    survivor_dir = str(tmp_path / "survivor")
+    survivor = SolverServer(_config(survivor_dir), cache=shared_cache)
+    survivor.start()
+    try:
+        # the storm side wins the race: resubmit before adoption
+        h = survivor.submit(a, b, request_id="raced-rid")
+        out = net.adopt_journal(survivor, victim_dir)
+        assert out["skipped"] == 1 and out["replayed"] == 0
+        assert h.result(60).status == STATUS_OK
+    finally:
+        survivor.stop(drain=True)
+    # exactly one terminal for the rid across the survivor's raw records
+    terminals = []
+    for seg in durable.segment_paths(survivor_dir):
+        with open(seg, "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                doc = durable.decode_line(line + b"\n")
+                if (doc and doc.get("rec") == "terminal"
+                        and doc.get("rid") == "raced-rid"):
+                    terminals.append(doc)
+    assert len(terminals) == 1 and terminals[0]["status"] == STATUS_OK
+
+
+def test_adopt_concurrent_resubmit_storm(tmp_path, rng, shared_cache):
+    """Resubmits racing the replay FROM THREADS: one terminal per rid,
+    no double solve (the depth-lock critical section both sides admit
+    under)."""
+    victim_dir = str(tmp_path / "victim")
+    victim = SolverServer(_config(victim_dir))
+    systems = [_system(rng, 10) for _ in range(4)]
+    for j, (a, b) in enumerate(systems):
+        victim.submit(a, b, request_id=f"storm-{j}")
+    victim._crash()
+
+    survivor = SolverServer(_config(str(tmp_path / "survivor")),
+                            cache=shared_cache)
+    survivor.start()
+    results = {}
+
+    def _storm(j):
+        a, b = systems[j]
+        results[j] = survivor.solve(a, b, request_id=f"storm-{j}",
+                                    timeout=60)
+
+    try:
+        threads = [threading.Thread(target=_storm, args=(j,))
+                   for j in range(4)]
+        adopter = threading.Thread(
+            target=net.adopt_journal, args=(survivor, victim_dir))
+        for t in threads + [adopter]:
+            t.start()
+        for t in threads + [adopter]:
+            t.join(120)
+        assert all(results[j].status == STATUS_OK for j in range(4))
+    finally:
+        survivor.stop(drain=True)
+    counts = {f"storm-{j}": 0 for j in range(4)}
+    for seg in durable.segment_paths(str(tmp_path / "survivor")):
+        with open(seg, "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                doc = durable.decode_line(line + b"\n")
+                if (doc and doc.get("rec") == "terminal"
+                        and doc.get("rid") in counts):
+                    counts[doc["rid"]] += 1
+    assert all(v == 1 for v in counts.values()), counts
+
+
+# -- the router's ring and assignment log ----------------------------------
+
+def test_hashring_stability_under_death():
+    ring = HashRing(["r0", "r1", "r2"])
+    keys = [f"key-{i}" for i in range(300)]
+    before = {k: ring.lookup(k) for k in keys}
+    assert set(before.values()) == {"r0", "r1", "r2"}
+    survivors = {"r0", "r2"}
+    after = {k: ring.lookup(k, live=survivors) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # only the dead node's arc moves (~1/3), and it moves ENTIRELY
+    assert all(after[k] in survivors for k in keys)
+    assert all(before[k] == after[k] for k in keys
+               if before[k] != "r1")
+    assert moved == sum(1 for k in keys if before[k] == "r1")
+    assert 0 < moved < len(keys)
+    # the adopter choice is the dead node's ring successor, in survivors
+    assert ring.lookup("r1", live=survivors) in survivors
+
+
+def test_assignlog_replay_failover_torn_tail(tmp_path):
+    path = str(tmp_path / "assign.log")
+    log = AssignLog(path)
+    for i in range(12):
+        log.assign(f"rid-{i}", f"r{i % 3}")
+    moved = log.failover("r1", "r2")
+    assert moved == 4
+    pins = log.pins()
+    log.close()
+    assert set(pins.values()) == {"r0", "r2"}
+
+    # reopen replays to the identical map
+    log2 = AssignLog(path)
+    assert log2.pins() == pins
+    log2.close()
+
+    # a torn tail drops ONLY the damaged record
+    with open(path, "ab") as f:
+        f.write(durable.encode_record(
+            {"rec": "assign", "rid": "torn-rid", "node": "r0"})[:-4])
+    log3 = AssignLog(path)
+    got = log3.pins()
+    log3.close()
+    assert "torn-rid" not in got
+    assert {k: v for k, v in got.items() if k != "torn-rid"} == pins
+
+
+# -- obs wiring ------------------------------------------------------------
+
+def test_loadgen_history_tag_net_qualified():
+    base = {"mode": "closed", "throughput_rps": 10.0}
+    plain = dict(loadgen.history_records(base))
+    assert "serve:closed/s_per_request" in plain
+    wired = dict(loadgen.history_records(dict(base, net="http://x")))
+    assert "serve:net:closed/s_per_request" in wired
+    assert "serve:closed/s_per_request" not in wired
+
+
+def test_regress_ingests_replica_campaign(tmp_path):
+    summary = {
+        "kind": "replica_campaign", "seed": 1, "cases": 30,
+        "tput": {"replicas_1": {"s_per_request": 0.12},
+                 "replicas_3": {"s_per_request": 0.05}},
+        "legs": [{"leg": "kill3", "recovery_s": [1.0, 2.0, 3.0]},
+                 {"leg": "drain_free", "recovery_s": []}],
+    }
+    path = tmp_path / "summary.json"
+    path.write_text(json.dumps(summary))
+    recs = regress.ingest_file(str(path))
+    by_metric = {r["metric"]: r for r in recs}
+    assert by_metric["replica:s_per_request"]["value"] == \
+        pytest.approx(0.05)
+    assert by_metric["replica:failover_recovery_s"]["value"] == \
+        pytest.approx(2.0)
+    assert all(r["unit"] == "s" for r in recs)
+
+
+def test_summarize_replica_section():
+    evs = [
+        {"type": "router", "event": "listening", "replicas": 3},
+        {"type": "router", "event": "restart", "charged": True},
+        {"type": "replica", "event": "listening"},
+        {"type": "replica_failover", "cause": "killed", "pins_moved": 4,
+         "replayed": 2, "imported": 3, "expired": 1, "recovery_s": 1.5},
+        # case_violations carries the violating cases themselves on the
+        # wire; the summary folds the list to a count.
+        {"type": "replica_campaign", "cases": 30, "admitted": 200,
+         "case_violations": [], "invariant_ok": True},
+    ]
+    rp = summarize.replica_summary(evs)
+    assert rp["router_events"] == {"listening": 1, "restart": 1}
+    fo = rp["failovers"]
+    assert fo["count"] == 1 and fo["by_cause"] == {"killed": 1}
+    assert fo["pins_moved"] == 4 and fo["replayed"] == 2
+    assert fo["max_recovery_s"] == pytest.approx(1.5)
+    camp = rp["campaign"]
+    assert camp["invariant_ok"] and camp["case_violations"] == 0
+    lines = summarize._replica_lines(rp)
+    assert any("failovers: 1" in ln for ln in lines)
+    # no replica traffic -> no section (the empty-dict contract)
+    assert summarize.replica_summary(
+        [{"type": "serve_request"}]) == {}
