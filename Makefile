@@ -18,11 +18,12 @@ FLIGHT_SMOKE ?= /tmp/gauss_flight_check
 PROF_SMOKE ?= /tmp/gauss_prof_check
 SPARSE_SMOKE ?= /tmp/gauss_sparse_check
 REPLICA_SMOKE ?= /tmp/gauss_replica_check
+POISON_SMOKE ?= /tmp/gauss_poison_check
 
 .PHONY: all native test bench datasets obs-check serve-check faults-check \
 	structure-check sparse-check tune-check live-check abft-check \
 	durable-check outofcore-check mesh-serve-check lint-check flight-check \
-	prof-check replica-check clean
+	prof-check replica-check poison-check clean
 
 # The timing-gated gates (obs/serve/structure/tune/faults/live/abft/
 # durable-check)
@@ -443,6 +444,34 @@ replica-check:
 	assert rp and rp[0]['campaign'].get('invariant_ok') \
 	  and rp[0]['campaign'].get('case_violations') == 0, rp; \
 	print('replica-check: campaign summary ok:', rp[0]['campaign'])"
+
+# The poison gate (CI-callable): one bad request must never take down a
+# good one. A ≥30-case seeded campaign feeds poison (NaN/Inf operands,
+# exactly-singular systems, batch-tripping pills, torn wire payloads)
+# next to innocent traffic across in-process servers, a mesh lane, a
+# 3-replica router tier, and crash-loop/supervised subprocess legs where
+# a journaled admit kills the worker on dispatch. The invariant: every
+# innocent is served and re-verified at the 1e-4 gate, every culprit
+# draws exactly ONE typed poison terminal (exit 2 on any violation), a
+# restart replaying the journal never re-triggers the crash (the blame
+# journal quarantines the implicated request), and quarantined deaths
+# don't charge the supervisor's restart budget. poison:s_per_case is
+# regress-gated against the committed epochs. Timing-gated: honor the
+# serial-ordering note above.
+poison-check:
+	rm -rf $(POISON_SMOKE) && mkdir -p $(POISON_SMOKE)
+	timeout -k 10 840 env JAX_PLATFORMS=cpu $(PYTHON) -m \
+	  gauss_tpu.serve.poisoncheck --cases 28 --seed 777201 \
+	  --tmpdir $(POISON_SMOKE) \
+	  --metrics-out $(POISON_SMOKE)/poison.jsonl \
+	  --summary-json $(POISON_SMOKE)/summary.json --regress-check
+	$(PYTHON) -m gauss_tpu.obs.summarize $(POISON_SMOKE)/poison.jsonl \
+	  --json | $(PYTHON) -c "import json,sys; runs=json.load(sys.stdin); \
+	po=[r['poison'] for r in runs.values() if r.get('poison')]; \
+	assert po and po[0]['campaign'].get('invariant_ok') \
+	  and po[0]['campaign'].get('violations') == 0 \
+	  and po[0]['campaign'].get('crash_loops') == 0, po; \
+	print('poison-check: campaign summary ok:', po[0]['campaign'])"
 
 datasets:
 	$(PYTHON) -m gauss_tpu.cli.datasets

@@ -376,6 +376,7 @@ POSTMORTEM_OWNERS = {
     "unclean_resume": "gauss_tpu/serve/server.py::_replay",
     "slo_alert": "gauss_tpu/obs/live.py::observe_slo",
     "sdc_detected": "gauss_tpu/resilience/recover.py::solve_resilient",
+    "poison_quarantine": "gauss_tpu/serve/durable.py::supervise",
     "manual": "gauss_tpu/obs/debug.py::main",
 }
 

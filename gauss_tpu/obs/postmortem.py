@@ -56,6 +56,7 @@ KNOWN_CAUSES = (
     "unclean_resume",      # server start() found unterminated admits
     "slo_alert",           # a burn-rate alert transitioned to firing
     "sdc_detected",        # SDCDetectedError escalated past repair
+    "poison_quarantine",   # death blamed on a poison request (uncharged)
     "manual",              # gauss-debug capture / tests
 )
 

@@ -351,6 +351,23 @@ def ingest_file(path) -> List[Dict[str, Any]]:
             if rec:
                 records.append(rec)
         return records
+    if isinstance(doc, dict) and doc.get("kind") == "poison_campaign":
+        # A poison-isolation campaign summary (python -m gauss_tpu.serve
+        # .poisoncheck --summary-json): per-case isolation cost and the
+        # bisection re-dispatch overhead enter history — poison isolation
+        # getting more expensive gates exactly like a perf regression (the
+        # innocents-verified / exactly-one-typed-terminal / no-crash-loop
+        # INVARIANTS are hard exit-2s, not bands). Derivation lives with
+        # the campaign runner (single source); lazy import keeps jax out
+        # of this module.
+        from gauss_tpu.serve.poisoncheck import history_records as \
+            poison_hist
+
+        for metric, value, unit in poison_hist(doc):
+            rec = _record(metric, value, path, "poison", unit=unit)
+            if rec:
+                records.append(rec)
+        return records
     if isinstance(doc, dict) and doc.get("kind") == "replica_campaign":
         # A kill-the-replica campaign summary (python -m gauss_tpu.serve
         # .replicacheck --summary-json): the 3-replica per-request serving

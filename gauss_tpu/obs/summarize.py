@@ -861,6 +861,78 @@ def _replica_lines(rp: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def poison_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the poison-isolation plane's events into one report: typed
+    poison terminals (``serve.poisoned``), batch bisections
+    (``serve_bisect``), quarantine decisions (``quarantine`` events /
+    ``serve.quarantined``), non-finite batch-member rescues, uncharged
+    quarantined deaths, and the latest ``poison_campaign`` verdict.
+    Empty dict when the run saw no poison activity."""
+    counters = {ev.get("name"): ev.get("value") for ev in events
+                if ev.get("type") == "metric"
+                and ev.get("kind") == "counter"}
+    quar = [ev for ev in events if ev.get("type") == "quarantine"]
+    bisects = [ev for ev in events if ev.get("type") == "serve_bisect"]
+    camps = [ev for ev in events if ev.get("type") == "poison_campaign"]
+
+    def _c(name: str) -> int:
+        return int(counters.get(name, 0) or 0)
+
+    poisoned = _c("serve.poisoned")
+    quarantined = _c("serve.quarantined")
+    rescues = _c("serve.nonfinite_rescues")
+    free_deaths = (_c("serve.quarantined_respawns")
+                   + _c("router.quarantined_deaths"))
+    if not (poisoned or quarantined or rescues or free_deaths
+            or quar or bisects or camps):
+        return {}
+    out: Dict[str, Any] = {
+        "poisoned": poisoned,
+        "quarantined": quarantined,
+        "bisections": {
+            "count": len(bisects),
+            "requests": sum(int(ev.get("requests", 0) or 0)
+                            for ev in bisects),
+        },
+        "nonfinite_rescues": rescues,
+        "quarantined_deaths_uncharged": free_deaths,
+        "quarantine_events": [
+            {k: ev.get(k) for k in ("id", "rid", "trace", "deaths",
+                                    "action", "adopted")
+             if ev.get(k) is not None}
+            for ev in quar],
+    }
+    if camps:
+        last = camps[-1]
+        out["campaign"] = {k: last.get(k)
+                           for k in ("cases", "innocents_verified",
+                                     "culprits_typed", "violations",
+                                     "crash_loops", "invariant_ok")
+                           if last.get(k) is not None}
+    return out
+
+
+def _poison_lines(po: Dict[str, Any]) -> List[str]:
+    bi = po["bisections"]
+    lines = [
+        f"  typed rejects: {po['poisoned']} poisoned, "
+        f"{po['quarantined']} quarantined, "
+        f"{po['nonfinite_rescues']} non-finite batch-member rescue(s)",
+        f"  bisections: {bi['count']} split(s) over "
+        f"{bi['requests']} batched request(s)",
+    ]
+    if po["quarantined_deaths_uncharged"]:
+        lines.append(f"  deaths reclassified quarantined (budget "
+                     f"uncharged): {po['quarantined_deaths_uncharged']}")
+    for ev in po["quarantine_events"]:
+        lines.append("  quarantine: " + _event_kv(ev))
+    camp = po.get("campaign")
+    if camp:
+        kv = " ".join(f"{k}={_fmt(v)}" for k, v in camp.items())
+        lines.append(f"  campaign: {kv}")
+    return lines
+
+
 def tuning_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the autotuner's events into one report: store consults with
     their provenance (``tune`` events: source=store|seed, reason on
@@ -973,6 +1045,7 @@ def run_summary(events: List[Dict[str, Any]], run_id: str) -> Dict[str, Any]:
         "health": [_strip(ev) for ev in evs if ev.get("type") == "health"],
         "serving": serving_summary(evs),
         "durability": durability_summary(evs),
+        "poison": poison_summary(evs),
         "slo": slo_summary(evs),
         "structure": structure_summary(evs),
         "sparse": sparse_summary(evs),
@@ -1039,6 +1112,12 @@ def summarize_run(events: List[Dict[str, Any]], run_id: str) -> str:
         out.append("")
         out.append("durability (request journal):")
         out.extend(_durability_lines(durability))
+
+    poison = poison_summary(evs)
+    if poison:
+        out.append("")
+        out.append("poison isolation:")
+        out.extend(_poison_lines(poison))
 
     slo = slo_summary(evs)
     if slo:

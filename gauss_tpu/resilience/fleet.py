@@ -77,8 +77,11 @@ RUNGS = ("supervised", "restart", "shrink", "local_finish")
 
 #: death causes whose respawn does not consume the restart budget:
 #: peer_lost is a secondary casualty (bounded separately), drained is an
-#: operator-initiated graceful exit.
-FREE_RESPAWN_CAUSES = ("peer_lost", "drained")
+#: operator-initiated graceful exit, quarantined is a poison-request
+#: death whose blame evidence GREW (the journal-replay quarantine ladder
+#: is converging — solo at K deaths, typed reject past K — so these
+#: respawns are finite by construction and must not spend the budget).
+FREE_RESPAWN_CAUSES = ("peer_lost", "drained", "quarantined")
 
 
 def exit_cause(rc: Optional[int]) -> str:

@@ -95,6 +95,20 @@ class UnrecoverableSolveError(RuntimeError):
         self.attempts = list(attempts or ())
 
 
+class SingularSystemError(UnrecoverableSolveError):
+    """The system is exactly singular (rank-deficient): a VERDICT about
+    the operands, not a fault in any engine. Raised by the numpy_f64 rung
+    when host LAPACK reports ``LinAlgError`` — the ground-truth rung has
+    spoken, so the ladder re-raises immediately instead of burning the
+    remaining rungs on a system no factorization can solve. The serving
+    layer maps this to ``STATUS_POISON`` (a typed reject, not a failure)."""
+
+    def __init__(self, message: str,
+                 attempts: Optional[List[Tuple[str, str]]] = None):
+        super().__init__(message, trigger="singular_matrix",
+                         attempts=attempts)
+
+
 @dataclasses.dataclass
 class ResilientResult:
     """A gated solve: the solution plus how hard the ladder worked for it."""
@@ -215,7 +229,16 @@ def _rung_rank1(a64, b64, panel, iters):
 
 
 def _rung_numpy(a64, b64, panel, iters):
-    return np.linalg.solve(a64, b64), None
+    try:
+        return np.linalg.solve(a64, b64), None
+    except np.linalg.LinAlgError as e:
+        # Host LAPACK is the ground-truth rung: its LinAlgError means the
+        # system is EXACTLY singular, a verdict about the operands that no
+        # other rung can overturn. Surface it typed so the ladder (and the
+        # serving layer's STATUS_POISON mapping) can short-circuit instead
+        # of exhausting into a generic unrecoverable error.
+        raise SingularSystemError(
+            f"exactly singular system: host LAPACK reports {e}") from e
 
 
 def _rung_outofcore(a64, b64, panel, iters):
@@ -484,6 +507,17 @@ def solve_resilient(a, b, *, gate: float = DEFAULT_GATE,
             x, fac = _RUNG_FNS[rung](a64, b64, panel, refine_iters)
             ok, trigger, rel = _gate(a64, b64, x, factors=fac, gate=gate)
             _collect_sdc(rung)
+        except SingularSystemError as e:
+            # A singular verdict from the ground-truth rung is terminal for
+            # EVERY rung — the system itself is rank-deficient — so re-raise
+            # immediately instead of burning the remaining ladder.
+            _collect_sdc(rung)
+            escalations.append((rung, "singular_matrix"))
+            obs.counter("resilience.unrecoverable")
+            obs.emit("recovery", trigger="singular_matrix", rung=rung,
+                     rung_index=i, attempt=i + 1, outcome="unrecoverable")
+            e.attempts = list(escalations)
+            raise
         except Exception as e:  # noqa: BLE001 — a rung failing IS the signal
             ok, trigger, rel = False, f"exception:{type(e).__name__}", None
             _collect_sdc(rung)

@@ -28,11 +28,13 @@ from gauss_tpu.serve.admission import (  # noqa: F401
     STATUS_EXPIRED,
     STATUS_FAILED,
     STATUS_OK,
+    STATUS_POISON,
     STATUS_REJECTED,
     LaneHealth,
     ServeConfig,
     ServeRequest,
     ServeResult,
+    poison_scan,
 )
 from gauss_tpu.serve.buckets import (  # noqa: F401
     DEFAULT_LADDER,

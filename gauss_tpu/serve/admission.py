@@ -34,6 +34,34 @@ STATUS_REJECTED = "rejected"      # queue full — never entered the queue
 STATUS_EXPIRED = "expired"        # deadline passed before compute
 STATUS_FAILED = "failed"          # lane error after retries
 STATUS_CANCELLED = "cancelled"    # client gave up waiting; worker skips it
+STATUS_POISON = "poison"          # the REQUEST is the fault: non-finite
+#                                   operands, a singular system, or a
+#                                   payload implicated in repeated worker
+#                                   deaths — typed blame, never a 500
+
+
+def poison_scan(a, b) -> Optional[str]:
+    """Admission-time operand scan: the reason string when ``(a, b)`` can
+    never be served (non-finite values, non-numeric dtype), else None.
+
+    This is the STATUS_POISON front door — every operand path (submit, the
+    wire decode, journal replay) runs it so a poisoned request is rejected
+    with typed blame before it can reach a batch, a device, or a journal
+    record that a restart would faithfully replay. Shape/conformability
+    errors stay plain ValueError (programming errors, not poison); this
+    scan owns the *values*. O(n²) reads, no allocation beyond the
+    reduction.
+    """
+    for name, arr in (("a", a), ("b", b)):
+        arr = np.asarray(arr)
+        if not np.issubdtype(arr.dtype, np.number):
+            return f"non-numeric {name} (dtype {arr.dtype})"
+        if np.issubdtype(arr.dtype, np.complexfloating):
+            return f"complex {name} unsupported"
+        if not np.isfinite(arr).all():
+            bad = "nan" if np.isnan(arr).any() else "inf"
+            return f"non-finite operand {name} ({bad})"
+    return None
 
 
 @dataclasses.dataclass
@@ -240,6 +268,36 @@ class ServeConfig:
     min_lanes: int = 1              # autoscale floor (and starting count)
     autoscale_interval_s: float = 0.25  # min seconds between scale steps
     autoscale_quiet_s: float = 2.0  # alert-free seconds before a shrink
+    # -- poison isolation (admission scan / bisection / quarantine) --------
+    poison_scan: bool = True        # scan every operand path (submit, wire
+    #                                 decode, journal replay) for
+    #                                 non-finite/non-numeric operands and
+    #                                 reject with a typed STATUS_POISON
+    #                                 terminal BEFORE the journal admit —
+    #                                 a poisoned submit can never enter a
+    #                                 batch, crash a worker, or leave a
+    #                                 journal record a restart would
+    #                                 replay. False = the pre-poison
+    #                                 trusting path (tests)
+    bisect_batches: bool = True     # when a batched dispatch fails
+    #                                 NON-transiently, bisect the batch
+    #                                 (O(log B) re-dispatches) to isolate
+    #                                 the culprit member(s): innocents
+    #                                 re-serve under their original
+    #                                 journal/trace ids, culprits get a
+    #                                 typed STATUS_POISON terminal. False
+    #                                 = the whole batch fails together
+    #                                 (the pre-bisection behavior)
+    quarantine_deaths: int = 2      # journaled replay quarantines any rid
+    #                                 whose blame records implicate it in
+    #                                 at least this many DISTINCT prior
+    #                                 process deaths: solo-executed on the
+    #                                 host recovery ladder (finite
+    #                                 operands) or typed-rejected
+    #                                 (poisoned operands), never
+    #                                 re-batched — replay cannot
+    #                                 re-trigger the crash. 0 = quarantine
+    #                                 off
 
 
 @dataclasses.dataclass
@@ -349,6 +407,12 @@ class ServeRequest:
         #: handoff moves the whole object), read at _finish.
         self.cost_device_s = 0.0  # lockset: ok — owned by the dispatching worker
         self.cost_compile_s = 0.0  # lockset: ok — owned by the dispatching worker
+        #: poison quarantine flag (blame-journal replay, adopt import): a
+        #: quarantined request is solo-executed on the host recovery
+        #: ladder — never co-batched, never the device lane. Set before
+        #: the request is visible to any worker (replay/adopt), read by
+        #: the dispatch path.
+        self.quarantine = False  # lockset: ok — set before queue insertion, read-only after
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -78,8 +78,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="weighted workload tokens kind:arg[*weight] "
                         "(kinds: random:<n>, internal:<n>, dat:<path>, "
                         "dataset:<name>, spd:<n>, banded:<n>/<b>, "
-                        "blockdiag:<n>/<k>, dtype:<dt>/<n> — the last "
-                        "drives the lowered bf16/bf16x3 batched lanes)")
+                        "blockdiag:<n>/<k>, dtype:<dt>/<n> — drives the "
+                        "lowered bf16/bf16x3 batched lanes — and "
+                        "poison:<nan|inf|singular>/<n> — deliberately bad "
+                        "operands at a controlled rate; typed poison "
+                        "rejects are reported separately from failures)")
     p.add_argument("--requests", type=int, default=50,
                    help="measured request count (default 50)")
     p.add_argument("--warmup", type=int, default=8,

@@ -159,15 +159,34 @@ class JournalState:
         self.terminals: Dict[int, Dict[str, Any]] = {}  # id -> terminal
         #: client idempotency key -> terminal record (the dedupe map)
         self.by_rid: Dict[str, Dict[str, Any]] = {}
+        #: pre-dispatch blame records (the quarantine evidence)
+        self.blames: List[Dict[str, Any]] = []
         self.clean_shutdown = False
         self.records = 0
         self.torn_dropped = 0
         self.max_id = 0
+        self.max_boot = 0
 
     def live_admits(self) -> List[Dict[str, Any]]:
         """Admit records with no terminal, in admission order — the replay
         set."""
         return [self.admits[i] for i in self.order if i not in self.terminals]
+
+    def death_counts(self) -> Dict[int, int]:
+        """For each admit still owed a terminal, the number of DISTINCT
+        boots whose blame records implicate it — the quarantine evidence a
+        replay consults. An id blamed twice in the SAME incarnation (two
+        dispatch attempts before one crash) counts once: deaths, not
+        dispatches. Terminated admits are excluded — a request that reached
+        its terminal can no longer be the crash trigger being hunted."""
+        boots: Dict[int, set] = {}
+        for doc in self.blames:
+            boot = doc.get("boot")
+            for rid in doc.get("ids") or ():
+                if isinstance(rid, int):
+                    boots.setdefault(rid, set()).add(boot)
+        return {i: len(boots[i]) for i in self.order
+                if i not in self.terminals and i in boots}
 
     def apply(self, doc: Dict[str, Any]) -> None:
         rec = doc.get("rec")
@@ -189,6 +208,11 @@ class JournalState:
             key = doc.get("rid")
             if key:
                 self.by_rid.setdefault(str(key), doc)
+        elif rec == "blame":
+            self.blames.append(doc)
+            boot = doc.get("boot")
+            if isinstance(boot, int):
+                self.max_boot = max(self.max_boot, boot)
         elif rec == "shutdown":
             self.clean_shutdown = True
 
@@ -253,6 +277,10 @@ class RequestJournal:
         #: the state recovered from segments present at open (what a
         #: restart replays); live appends do NOT update it.
         self.recovered = scan(self.dir)
+        #: this incarnation's boot number — monotone per journal open, so
+        #: blame records from distinct incarnations are distinguishable and
+        #: death_counts() counts deaths, not dispatch attempts.
+        self.boot = self.recovered.max_boot + 1
         segs = segment_paths(self.dir)
         if segs:
             last = os.path.basename(segs[-1])
@@ -347,6 +375,25 @@ class RequestJournal:
         self._append(doc)
         return doc
 
+    def append_blame(self, *, ids: List[int],
+                     rids: Optional[List[str]] = None,
+                     boot: Optional[int] = None) -> None:
+        """The pre-dispatch blame record: names every journal id (and
+        client rid) the next dispatch puts at risk. A crash between this
+        append and the batch's terminals leaves the ids implicated in this
+        boot — the evidence ``JournalState.death_counts`` folds into the
+        replay-time quarantine decision.
+
+        ``boot`` overrides this incarnation's boot number; journal ADOPTION
+        uses negative synthetic boots (never colliding with real boots,
+        which start at 1) to carry a dead replica's death counts onto the
+        adopter's journal id."""
+        self._append({"rec": "blame", "schema": JOURNAL_SCHEMA,
+                      "boot": int(self.boot if boot is None else boot),
+                      "ids": [int(i) for i in ids],
+                      "rids": [str(r) for r in (rids or [])],
+                      "t_unix": time.time()})
+
     def append_shutdown(self) -> None:
         """The clean-shutdown marker: the next start replays nothing. Always
         fsynced — this is the record whose absence means 'crashed'."""
@@ -374,6 +421,11 @@ class RequestJournal:
             keyed = [t for t in state.terminals.values() if t.get("rid")]
             keyed.sort(key=lambda t: t.get("t_unix") or 0.0)
             keep += keyed[-IDEMPOTENCY_KEEP:]
+            # Blame evidence follows the admits it implicates: a rotation
+            # must not amnesty a poison request's death history.
+            live_ids = {d["id"] for d in state.live_admits()}
+            keep += [bl for bl in state.blames
+                     if live_ids.intersection(bl.get("ids") or ())]
             old = segment_paths(self.dir)
             self._seq += 1
             self._path = self._segment_path(self._seq)
@@ -444,6 +496,19 @@ def terminal_to_result(doc: Dict[str, Any]):
                        error=doc.get("error"))
 
 
+def quarantinable_ids(dirpath: str, k: int = 1) -> Dict[int, int]:
+    """Scan a (possibly dead) journal directory for live admit ids
+    implicated in at least ``k`` prior worker deaths: ``{id: deaths}``.
+    The router/fleet reclassification and journal-adoption paths use this
+    to recognize a poison-driven death without owning a journal handle.
+    Never raises on a damaged directory — no evidence means no quarantine."""
+    try:
+        counts = scan(dirpath).death_counts()
+    except (JournalError, OSError):
+        return {}
+    return {i: c for i, c in counts.items() if c >= k}
+
+
 # -- the supervisor --------------------------------------------------------
 
 def supervise(child_argv: List[str], *, heartbeat_path: str,
@@ -452,6 +517,7 @@ def supervise(child_argv: List[str], *, heartbeat_path: str,
               env: Optional[Dict[str, str]] = None,
               flight_dir: Optional[str] = None,
               journal_dir: Optional[str] = None,
+              quarantine_deaths: int = 2,
               log=print) -> int:
     """Run ``child_argv`` under the PR-5 fleet watchdog pattern and restart
     it — against the same journal — when it dies or stalls.
@@ -502,6 +568,21 @@ def supervise(child_argv: List[str], *, heartbeat_path: str,
     restarts = 0
     draining = {"flag": False}
     child: Dict[str, Optional[subprocess.Popen]] = {"proc": None}
+    # Quarantine growth guard: a death is only "free" (uncharged) when some
+    # live id's death count GREW TO the quarantine threshold or past it —
+    # exactly when the next replay changes behavior for that suspect (solo
+    # at K deaths, typed reject past K), so the respawn is the ladder
+    # converging rather than a crash loop. Growth alone is not enough:
+    # EVERY mid-dispatch crash blames its in-flight batch once, and an
+    # environmental crasher under load would otherwise respawn for free
+    # forever. Counts are bounded per id (past K the replay rejects
+    # terminally), so free respawns are finite by construction; a crash
+    # whose suspects stay under the threshold (innocent workload, broken
+    # build) charges the budget as before. ``quarantine_deaths`` must
+    # match the child server's ``ServeConfig.quarantine_deaths`` (both
+    # default 2); 0 disables free respawns along with the policy.
+    prev_deaths: Dict[int, int] = (
+        quarantinable_ids(journal_dir) if journal_dir else {})
 
     def _forward_term(signum, frame):  # pragma: no cover — signal timing
         draining["flag"] = True
@@ -557,20 +638,39 @@ def supervise(child_argv: List[str], *, heartbeat_path: str,
                 obs.emit("serve_supervisor", event="drained", rc=rc)
                 return rc if rc is not None else 0
             cause = "stalled" if stalled else f"died rc={rc}"
-            _capture("supervisor_stall" if stalled else "supervisor_death",
+            quarantined = False
+            if journal_dir and quarantine_deaths > 0:
+                cur = quarantinable_ids(journal_dir)
+                quarantined = any(c >= quarantine_deaths
+                                  and c > prev_deaths.get(i, 0)
+                                  for i, c in cur.items())
+                prev_deaths = cur
+            _capture("poison_quarantine" if quarantined
+                     else "supervisor_stall" if stalled
+                     else "supervisor_death",
                      rc=rc, restarts=restarts, pid=proc.pid)
-            if restarts >= max_restarts:
+            if quarantined:
+                obs.counter("serve.quarantined_respawns")
+                obs.emit("serve_supervisor", event="restart",
+                         cause="quarantined", underlying=cause,
+                         restarts=restarts)
+                log(f"supervise: child {cause} with a suspect at the "
+                    f"quarantine threshold — quarantined death; restarting "
+                    f"without charging the budget "
+                    f"({restarts}/{max_restarts} spent)")
+            elif restarts >= max_restarts:
                 obs.emit("serve_supervisor", event="gave_up", cause=cause,
                          restarts=restarts)
                 log(f"supervise: {cause}; restart budget "
                     f"({max_restarts}) spent — giving up")
                 return rc if rc else 1
-            restarts += 1
-            obs.counter("serve.supervisor_restarts")
-            obs.emit("serve_supervisor", event="restart", cause=cause,
-                     restarts=restarts)
-            log(f"supervise: child {cause}; restarting against the same "
-                f"journal ({restarts}/{max_restarts})")
+            else:
+                restarts += 1
+                obs.counter("serve.supervisor_restarts")
+                obs.emit("serve_supervisor", event="restart", cause=cause,
+                         restarts=restarts)
+                log(f"supervise: child {cause}; restarting against the same "
+                    f"journal ({restarts}/{max_restarts})")
             # One-off-crash contract: injected fault plans die with the
             # incarnation they killed.
             spawn_env = {k: v for k, v in base_env.items()

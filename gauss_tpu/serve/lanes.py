@@ -75,8 +75,10 @@ def compat_sig(req, ladder) -> Optional[Tuple]:
     steals all key on: (bucket, dtype, structure) — exactly the fields of
     the CacheKey a batch compiles against, so two requests with equal
     sigs can always share one executable dispatch. None = oversized for
-    the ladder (handoff lane; dispatches solo, never co-batched)."""
-    if req.n > ladder[-1]:
+    the ladder (handoff lane; dispatches solo, never co-batched) — and
+    QUARANTINED requests take the same solo path: a rid blamed for prior
+    worker deaths must never share a forming slot with innocents."""
+    if req.n > ladder[-1] or req.quarantine:
         return None
     return (buckets.bucket_for(req.n, ladder), req.dtype, req.structure)
 

@@ -127,8 +127,23 @@ def parse_mix(mix: str) -> List[Tuple[WorkloadSpec, float]]:
             raise ValueError(f"workload token {token!r} needs kind:arg")
         kind, arg = token.split(":", 1)
         if kind not in ("random", "internal", "dat", "dataset",
-                        "spd", "banded", "blockdiag", "sparse", "dtype"):
+                        "spd", "banded", "blockdiag", "sparse", "dtype",
+                        "poison"):
             raise ValueError(f"unknown workload kind {kind!r} in {token!r}")
+        if kind == "poison":
+            # poison:<kind>/<n> — a deliberately bad operand at a
+            # controlled rate: nan/inf (non-finite entries the admission
+            # scan rejects) or singular (finite but exactly rank-deficient
+            # — the recovery ladder's typed singular verdict). Typed
+            # rejects are counted separately from failures in the report.
+            p_part, _, n_part = arg.partition("/")
+            if p_part not in ("nan", "inf", "singular"):
+                raise ValueError(
+                    f"bad poison kind in workload token {token!r}; "
+                    f"options: ('nan', 'inf', 'singular')")
+            if not n_part or int(n_part) < 2:
+                raise ValueError(f"bad size in workload token {token!r} "
+                                 f"(poison needs n >= 2)")
         dtype = None
         if kind == "dtype":
             # dtype:<dt>/<n> — a random dominant system served at the
@@ -212,6 +227,22 @@ def materialize(spec: WorkloadSpec, rng: np.random.Generator, nrhs: int = 1,
             n_i = int(n_s)
             a = synthetic.blockdiag_matrix(
                 n_i, int(k_s) if k_s else max(1, n_i // 8))
+    elif spec.kind == "poison":
+        p_kind, _, n_s = spec.arg.partition("/")
+        n = int(n_s)
+        a = rng.standard_normal((n, n))
+        a[np.arange(n), np.arange(n)] += float(n)
+        if p_kind == "nan":
+            a[0, 0] = np.nan
+        elif p_kind == "inf":
+            a[0, 0] = np.inf
+        else:  # singular: zero a full row — exactly rank-deficient, but
+            # finite, so it sails past the admission scan and must be
+            # caught by the ladder's typed singular verdict instead. (A
+            # zero row, not a duplicated one: elimination of a duplicate
+            # leaves a rounding-level pivot and a finite garbage answer,
+            # which is a generic gate failure, not the typed verdict.)
+            a[n // 2, :] = 0.0
     elif spec.kind == "dataset":
         with _dat_lock:
             a = _dat_cache.get("dataset:" + spec.arg)
@@ -338,7 +369,8 @@ def run_load(server: SolverServer, cfg: LoadgenConfig) -> Dict:
     wall_s = time.perf_counter() - t_start
 
     # -- fold the per-request outcomes ------------------------------------
-    counts = {"ok": 0, "rejected": 0, "expired": 0, "failed": 0}
+    counts = {"ok": 0, "rejected": 0, "expired": 0, "failed": 0,
+              "poison": 0}
     incorrect = 0
     lanes: Dict[str, int] = {}
     lat = []
@@ -507,6 +539,7 @@ def format_summary(summary: Dict) -> str:
         f"  requests {summary['requests']} (+{summary['warmup']} warmup): "
         f"{c.get('ok', 0)} ok, {c.get('rejected', 0)} rejected, "
         f"{c.get('expired', 0)} expired, {c.get('failed', 0)} failed, "
+        f"{c.get('poison', 0)} poison-rejected, "
         f"{summary['incorrect']} INCORRECT",
         f"  lanes: " + (", ".join(f"{k}={v}" for k, v in
                                   sorted(summary['lanes'].items())) or "-"),

@@ -87,9 +87,11 @@ from gauss_tpu.serve import durable
 from gauss_tpu.serve.admission import (
     STATUS_EXPIRED,
     STATUS_FAILED,
+    STATUS_POISON,
     STATUS_REJECTED,
     ServeRequest,
     ServeResult,
+    poison_scan,
 )
 
 #: wire schema version; bumped on incompatible body changes.
@@ -222,7 +224,10 @@ def adopt_journal(server, dirpath: str) -> Dict[str, Any]:
         if rid and rid not in server._rid_terminals:
             server._rid_terminals[rid] = doc
             imported += 1
-    replayed = expired = skipped = 0
+    replayed = expired = skipped = poisoned = quarantined = 0
+    cfg = server.config
+    k_deaths = int(cfg.quarantine_deaths or 0)
+    deaths = st.death_counts() if k_deaths else {}
     now = time.time()
     for doc in st.live_admits():
         try:
@@ -248,6 +253,18 @@ def adopt_journal(server, dirpath: str) -> Dict[str, Any]:
         if doc.get("trace"):
             req.trace_id = str(doc["trace"])
         is_expired = remaining is not None and remaining <= 0
+        # The dead replica's quarantine evidence crosses the failover: a
+        # rid implicated in K prior deaths stays quarantined (solo) on the
+        # adopter, past K it is typed-rejected — a naive re-replay here
+        # would re-trigger the very crash that killed the donor.
+        reason = (poison_scan(a, b) if cfg.poison_scan else None)
+        implicated = deaths.get(doc.get("id"), 0)
+        poison_reject = (not is_expired
+                         and (reason is not None
+                              or (k_deaths and implicated > k_deaths)))
+        if (not is_expired and not poison_reject
+                and k_deaths and implicated >= k_deaths):
+            req.quarantine = True
         admitted = False
         duplicate = False
         with server._depth_lock:
@@ -263,11 +280,22 @@ def adopt_journal(server, dirpath: str) -> Dict[str, Any]:
                         a=req.a, b=req.b, was_vector=req.was_vector,
                         deadline_unix=doc.get("deadline_unix"),
                         dtype=req.dtype, structure=req.structure)
+                    if implicated and not poison_reject:
+                        # Re-journal the donor's death count against the
+                        # ADOPTER's fresh journal id (synthetic negative
+                        # boots: distinct from each other and from real
+                        # boots), so a further crash or failover still
+                        # sees the full history.
+                        for d in range(implicated):
+                            server.journal.append_blame(
+                                ids=[req.id],
+                                rids=[rid] if rid else None,
+                                boot=-(d + 1))
                     req._on_terminal = server._journal_terminal
                     if rid:
                         server._rid_pending[rid] = req
                 admitted = True
-                if not is_expired:
+                if not is_expired and not poison_reject:
                     server._depth += 1
                     if server._lanes is None:
                         server._queue.put(req)
@@ -296,6 +324,23 @@ def adopt_journal(server, dirpath: str) -> Dict[str, Any]:
                          trace=req.trace_id, status=STATUS_EXPIRED,
                          replayed=True, adopted=True)
             continue
+        if poison_reject:
+            poisoned += 1
+            err = (f"poisoned operands: {reason}" if reason is not None
+                   else f"quarantined: implicated in {implicated} worker "
+                        f"deaths (threshold {k_deaths})")
+            if req.resolve(ServeResult(status=STATUS_POISON, error=err)):
+                obs.counter("serve.poisoned")
+                obs.emit("serve_request", id=req.id, n=req.n,
+                         trace=req.trace_id, status=STATUS_POISON,
+                         reason="adopt_replay", deaths=implicated,
+                         replayed=True, adopted=True)
+            continue
+        if req.quarantine:
+            quarantined += 1
+            obs.counter("serve.quarantined")
+            obs.emit("quarantine", id=req.id, rid=rid, trace=req.trace_id,
+                     deaths=implicated, action="solo", adopted=True)
         lanes = server._lanes  # lockset: ok — snapshot read, same as submit
         if lanes is not None and not lanes.place(req):
             server._depth_add(-1)
@@ -312,8 +357,8 @@ def adopt_journal(server, dirpath: str) -> Dict[str, Any]:
         obs.emit("serve_admit", id=req.id, trace=req.trace_id, n=req.n,
                  k=req.k, replayed=True, adopted=True)
     out = {"dir": dirpath, "imported": imported, "replayed": replayed,
-           "expired": expired, "skipped": skipped,
-           "torn_dropped": st.torn_dropped}
+           "expired": expired, "skipped": skipped, "poisoned": poisoned,
+           "quarantined": quarantined, "torn_dropped": st.torn_dropped}
     obs.emit("replica_adopt", **out)
     return out
 
@@ -420,6 +465,10 @@ class ReplicaApp:
             if out.get("retry_after_s") is None:
                 out["retry_after_s"] = self.server.retry_after_hint()
             return 503, out
+        if res.status == STATUS_POISON:
+            # A typed verdict about the REQUEST, not the replica: 400, not
+            # 500/503 — the client must not retry a poisoned operand.
+            return 400, result_doc(res)
         return 200, result_doc(res)
 
     def lookup(self, rid: str) -> Tuple[Optional[ServeRequest],
@@ -838,6 +887,10 @@ class SolveClient:
                 attempt += 1
                 continue
             # 4xx and anything else: deterministic — retrying replays it.
+            if payload.get("status") == STATUS_POISON:
+                # The replica's typed poison verdict survives the wire:
+                # the client sees STATUS_POISON, not a generic HTTP failure.
+                return doc_result(payload)
             return ServeResult(
                 status=STATUS_FAILED,
                 error=f"HTTP {code}: {payload.get('error')}")

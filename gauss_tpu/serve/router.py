@@ -214,6 +214,12 @@ class RouterConfig:
     fsync_batch: int = 4
     # -- supervision -------------------------------------------------------
     max_restarts: int = 3           # crash-restart budget (drains are free)
+    #: blame-journal quarantine threshold for the free-respawn guard; must
+    #: match the replicas' ``ServeConfig.quarantine_deaths`` (both default
+    #: 2). A death is uncharged only when it pushed a suspect's death
+    #: count TO this threshold or past it — the point where the adoption-
+    #: side replay solos or typed-rejects the suspect. 0 disables.
+    quarantine_deaths: int = 2
     stall_after_s: float = 30.0     # heartbeat staleness that calls a stall
     poll_s: float = 0.25            # watch-loop cadence
     spawn_timeout_s: float = 180.0  # endpoint.json publish deadline
@@ -311,6 +317,9 @@ class Router:
         self.failovers = 0                        # guarded by: self._rlock
         self._retired_dirs: List[str] = []        # guarded by: self._rlock
         self._failover_seq = 0                    # guarded by: self._rlock
+        #: stable request key (rid / trace) -> max deaths seen across
+        #: failovers — the quarantine growth guard. guarded by: self._rlock
+        self._blame_seen: Dict[str, int] = {}
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         self._api: Optional["RouterFront"] = None
@@ -447,6 +456,38 @@ class Router:
             obs.emit("router", event="capture_failed", replica=rp.name,
                      error=f"{type(e).__name__}: {e}"[:200])
 
+    def _blame_grew(self, retired: Optional[str]) -> bool:
+        """Did the retired journal push a suspect's death count TO the
+        quarantine threshold (or past it) — higher than anything seen for
+        its stable key (rid, else trace) across prior failovers AND at
+        least ``config.quarantine_deaths``? That is the point where the
+        adoption-side replay changes behavior (solo at K deaths, typed
+        reject past K), so the death is the ladder CONVERGING, not a crash
+        loop. Growth below the threshold does NOT qualify: every mid-
+        dispatch kill blames its in-flight batch once, and charging
+        nothing for first deaths would let an environmental crasher under
+        load respawn for free forever. Counts are bounded per request
+        (past K deaths the replay rejects it terminally), so free
+        respawns are finite."""
+        k = self.config.quarantine_deaths
+        if not retired or k <= 0:
+            return False
+        try:
+            st = durable.scan(retired)
+            counts = st.death_counts()
+        except (durable.JournalError, OSError):
+            return False
+        grew = False
+        with self._rlock:
+            for jid, c in counts.items():
+                adm = st.admits.get(jid) or {}
+                key = str(adm.get("rid") or adm.get("trace") or jid)
+                if c > self._blame_seen.get(key, 0):
+                    self._blame_seen[key] = c
+                    if c >= k:
+                        grew = True
+        return grew
+
     def _on_death(self, name: str, rp: ReplicaProc, cause: str,
                   rc: Optional[int] = None, **detail) -> None:
         t0 = time.perf_counter()
@@ -458,7 +499,17 @@ class Router:
             seq = self._failover_seq
         charged = _fleet.counts_against_restart_budget(cause)
         retired = rp.retire_journal(seq)
-        if charged:
+        if charged and self._blame_grew(retired):
+            # Poison-implicated death: reclassify through the shared
+            # fleet cause vocabulary so the respawn stops charging the
+            # restart budget — the journal adoption below quarantines or
+            # rejects the suspects, which is what actually ends the loop.
+            obs.counter("router.quarantined_deaths")
+            detail = {**detail, "underlying_cause": cause}
+            cause = "quarantined"
+            charged = _fleet.counts_against_restart_budget(cause)
+            self._capture(rp, "poison_quarantine", retired, rc=rc, **detail)
+        elif charged:
             self._capture(rp, "supervisor_stall" if cause == "stalled"
                           else "supervisor_death", retired, rc=rc, **detail)
         rp.close_log()
@@ -495,6 +546,8 @@ class Router:
                  imported=adopt_out.get("imported"),
                  expired=adopt_out.get("expired"),
                  skipped=adopt_out.get("skipped"),
+                 poisoned=adopt_out.get("poisoned"),
+                 quarantined=adopt_out.get("quarantined"),
                  recovery_s=round(recovery_s, 4), **detail)
         # -- respawn accounting (fleet.exit_cause vocabulary): drains and
         # -- peer-lost respawn free; crashes/kills/stalls spend the budget.

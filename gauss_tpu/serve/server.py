@@ -75,12 +75,14 @@ from gauss_tpu.serve.admission import (
     STATUS_EXPIRED,
     STATUS_FAILED,
     STATUS_OK,
+    STATUS_POISON,
     STATUS_REJECTED,
     LaneHealth,
     ServeConfig,
     ServeRequest,
     ServeResult,
     is_transient_device_error,
+    poison_scan,
     retry_backoff,
 )
 from gauss_tpu.serve.cache import CacheKey, ExecutableCache
@@ -367,7 +369,15 @@ class SolverServer:
             except Exception:  # noqa: BLE001 — capture never blocks recovery
                 obs.counter("postmortem.capture_errors")
         dec = self._durable.decode_array
-        replayed = expired = 0
+        replayed = expired = poisoned = quarantined = 0
+        # Blame-journal accounting: for every still-live admit, how many
+        # DISTINCT prior process deaths (journal boots) dispatched it and
+        # never reached its terminal. An id at/over the threshold is
+        # quarantined — replay must not re-trigger the crash that killed
+        # its predecessors.
+        k_deaths = (self.config.quarantine_deaths
+                    if self.config.journal_dir else 0)
+        deaths = st.death_counts() if k_deaths else {}
         now = time.time()
         for doc in st.live_admits():
             try:
@@ -409,6 +419,45 @@ class SolverServer:
                              trace=req.trace_id, status=STATUS_EXPIRED,
                              replayed=True)
                 continue
+            # Poison isolation at replay: the scan runs on every journaled
+            # operand too (an admit journaled by an older/scan-off server,
+            # or adopted from a peer, is exactly the payload a restart
+            # would otherwise faithfully re-crash on).
+            reason = (poison_scan(a, b) if self.config.poison_scan
+                      else None)
+            implicated = deaths.get(int(doc["id"]), 0)
+            if reason is not None or (k_deaths
+                                      and implicated > k_deaths):
+                # Typed terminal instead of a replay: poisoned operands no
+                # rung can repair, or a payload that kept killing workers
+                # even after solo quarantine — either way re-dispatching it
+                # is the crash loop. The terminal is journaled through the
+                # normal hook, so the NEXT restart replays nothing.
+                poisoned += 1
+                err = (f"poisoned operands: {reason}" if reason is not None
+                       else f"quarantined: implicated in {implicated} "
+                            f"worker deaths (threshold {k_deaths})")
+                if req.resolve(ServeResult(status=STATUS_POISON,
+                                           error=err)):
+                    obs.counter("serve.poisoned")
+                    obs.emit("serve_request", id=req.journal_id, n=req.n,
+                             trace=req.trace_id, status=STATUS_POISON,
+                             replayed=True, deaths=implicated,
+                             error=err[:200])
+                continue
+            if k_deaths and implicated >= k_deaths:
+                # Quarantine: replay it, but SOLO on the host recovery
+                # ladder — never co-batched (innocent batch-mates stay
+                # safe), never on the device lane (the thing its deaths
+                # implicate), and with no further blame append. One more
+                # death pushes it over k_deaths into the typed reject
+                # above — the ladder is finite by construction.
+                req.quarantine = True
+                quarantined += 1
+                obs.counter("serve.quarantined")
+                obs.emit("quarantine", id=req.journal_id,
+                         rid=req.request_id, trace=req.trace_id,
+                         deaths=implicated, action="solo")
             replayed += 1
             self._depth_add(1)
             if self._lanes is not None:
@@ -420,6 +469,8 @@ class SolverServer:
                      n=req.n, k=req.k, replayed=True,
                      deadline_s=remaining)
         self.last_resume = {"replayed": replayed, "expired": expired,
+                            "poisoned": poisoned,
+                            "quarantined": quarantined,
                             "clean": False, "resume": True,
                             "torn_dropped": st.torn_dropped}
         obs.emit("serve_resume", **self.last_resume)
@@ -624,6 +675,26 @@ class SolverServer:
                 return req
         if deadline_s is None:
             deadline_s = self.config.deadline_default_s
+        if self.config.poison_scan:
+            # Admission hardening: the operand scan runs BEFORE the journal
+            # admit, so a poisoned submit resolves a typed STATUS_POISON
+            # terminal synchronously and leaves NO journal record — a
+            # restart can never replay it, so a poison submit cannot
+            # crash-loop a replica by construction. Shape errors below stay
+            # plain ValueError (programming errors, not poison).
+            reason = poison_scan(a, b)
+            if reason is not None:
+                req = ServeRequest(a, b, deadline_s=deadline_s,
+                                   request_id=request_id)
+                if req.resolve(ServeResult(
+                        status=STATUS_POISON,
+                        error=f"poisoned operands: {reason}")):
+                    obs.counter("serve.poisoned")
+                    obs.emit("serve_request", id=req.id, n=req.n,
+                             trace=req.trace_id, status=STATUS_POISON,
+                             reason="admission_scan", error=reason,
+                             request_id=request_id)
+                return req
         if self.config.structure_aware and structure is None:
             from gauss_tpu.structure import structure_tag
 
@@ -831,6 +902,7 @@ class SolverServer:
         per-lane stats; None is the single-lane worker."""
         now = time.perf_counter()
         live = []
+        solo = []
         for req in batch:
             if req.done:
                 # Cancelled while queued (result-timeout propagation): the
@@ -844,8 +916,34 @@ class SolverServer:
                     obs.counter("serve.expired")
                     obs.emit("serve_request", id=req.id, n=req.n,
                              trace=req.trace_id, status=STATUS_EXPIRED)
+            elif req.quarantine:
+                solo.append(req)
             else:
                 live.append(req)
+        if (live or solo) and self.journal is not None:
+            # Blame record BEFORE the dispatch: if this process dies while
+            # the batch is in flight, the restart's replay knows exactly
+            # which ids were being executed when the lights went out — the
+            # evidence the quarantine policy counts deaths from (one death
+            # per DISTINCT journal boot). Quarantined solos are blamed too:
+            # a death during solo execution pushes them past the threshold
+            # into the typed reject, so the quarantine ladder is finite.
+            # One compact append per dispatch; a torn blame simply drops at
+            # scan (CRC), costing evidence, never correctness.
+            try:
+                self.journal.append_blame(
+                    ids=[r.journal_id for r in live + solo],
+                    rids=[r.request_id for r in live + solo
+                          if r.request_id])
+            except Exception as e:  # noqa: BLE001 — durability must not break serving
+                obs.counter("journal.errors")
+                obs.emit("journal", event="append_error",
+                         error=f"{type(e).__name__}: {e}"[:200])
+        for req in solo:
+            # Quarantined: solo host-ladder execution — never co-batched
+            # (batch-mates stay innocent), never the device lane (the lane
+            # its deaths implicate).
+            self._serve_numpy(req)
         if not live:
             return len(batch)
         if live[0].n > self.ladder[-1]:
@@ -855,7 +953,7 @@ class SolverServer:
         self._serve_batched(live, lane=lane)
         return len(batch)
 
-    def _serve_batched(self, reqs, lane=None) -> None:
+    def _serve_batched(self, reqs, lane=None, hunt=False) -> None:
         cfg = self.config
         if reqs[0].structure == "sparse":
             # The sparse compat sig keeps these batches homogeneous (drain
@@ -959,15 +1057,44 @@ class SolverServer:
                 for req in reqs:
                     self._serve_numpy(req)
                 return
+            if cfg.bisect_batches and len(reqs) > 1:
+                # Batch bisection: a NON-transient failure of a multi-
+                # member batch names no culprit — never fail the whole
+                # batch for one member. Split and re-dispatch each half
+                # (O(log B) re-dispatches isolate the culprit set):
+                # innocents re-serve through this same path under their
+                # ORIGINAL journal/trace ids and deadlines (exactly one
+                # terminal, resolve's CAS unchanged); a member that still
+                # fails alone is the culprit and is terminal-rejected
+                # typed below.
+                obs.counter("serve.bisections")
+                obs.emit("serve_bisect", bucket_n=bucket_n,
+                         requests=len(reqs), traces=traces,
+                         error=f"{type(err).__name__}: {err}"[:200])
+                mid = len(reqs) // 2
+                self._serve_batched(reqs[:mid], lane=lane, hunt=True)
+                self._serve_batched(reqs[mid:], lane=lane, hunt=True)
+                return
+            # A batch of one failing non-transiently: with bisection on,
+            # the member itself is the fault — a typed poison terminal,
+            # never a worker death and never a batch-mate casualty. The
+            # pre-bisection whole-batch STATUS_FAILED shape is kept for
+            # bisect_batches=False and for top-level singletons (a lone
+            # deterministic error is indistinguishable from a server bug;
+            # only the hunt proves the batch-relative blame).
+            culprit = hunt and cfg.bisect_batches
+            status = STATUS_POISON if culprit else STATUS_FAILED
             for req in reqs:
                 if req.resolve(ServeResult(
-                        status=STATUS_FAILED, lane="batched",
+                        status=status, lane="batched",
                         bucket_n=bucket_n,
-                        error=f"{type(err).__name__}: {err}")):
-                    obs.counter("serve.failed")
+                        error=(("poison batch member: " if culprit else "")
+                               + f"{type(err).__name__}: {err}"))):
+                    obs.counter("serve.poisoned" if culprit
+                                else "serve.failed")
                     obs.emit("serve_request", id=req.id, n=req.n,
-                             trace=req.trace_id, status=STATUS_FAILED,
-                             lane="batched",
+                             trace=req.trace_id, status=status,
+                             lane="batched", bisected=hunt,
                              error=f"{type(err).__name__}: {err}"[:200])
             return
 
@@ -1190,11 +1317,18 @@ class SolverServer:
                     gate=gate, rungs=("numpy_f64", "rank1"))
             x = rr.x
         except Exception as e:  # noqa: BLE001 — lane boundary
-            if req.resolve(ServeResult(status=STATUS_FAILED, lane="numpy",
+            # Typed poison verdicts from the ladder: an exactly-singular
+            # system (the f64 rung's LAPACK zero pivot — a property of the
+            # REQUEST) or non-finite input no rung can repair. Everything
+            # else stays the generic failed terminal.
+            poison = (isinstance(e, recover.SingularSystemError)
+                      or getattr(e, "trigger", None) == "nonfinite_input")
+            status = STATUS_POISON if poison else STATUS_FAILED
+            if req.resolve(ServeResult(status=status, lane="numpy",
                                        error=f"{type(e).__name__}: {e}")):
-                obs.counter("serve.failed")
+                obs.counter("serve.poisoned" if poison else "serve.failed")
                 obs.emit("serve_request", id=req.id, n=req.n,
-                         trace=req.trace_id, status=STATUS_FAILED,
+                         trace=req.trace_id, status=status,
                          lane="numpy",
                          error=f"{type(e).__name__}: {e}"[:200])
             return
@@ -1206,6 +1340,19 @@ class SolverServer:
     def _finish(self, req: ServeRequest, x: np.ndarray, lane: str,
                 bucket_n: Optional[int], sdc_detected: bool = False) -> None:
         rel = None
+        if (lane == "batched" and self.config.poison_scan
+                and not bool(np.isfinite(x).all())):
+            # A NaN/Inf solution out of the batched lane is the member's
+            # own numerics (a singular system survives the finite-operand
+            # admission scan and poisons only its own vmap row) — re-run
+            # it SOLO on the host recovery ladder, which either serves it
+            # verified or returns the typed singular verdict
+            # (STATUS_POISON). Unconditional on `verify_gate`: a
+            # non-finite solution is detectable for free and must never
+            # resolve `ok`, gate or no gate.
+            obs.counter("serve.nonfinite_rescues")
+            self._serve_numpy(req)
+            return
         if self.config.verify_gate is not None:
             from gauss_tpu.verify import checks
 

@@ -1,0 +1,500 @@
+"""Poison-isolation tests: the admission operand scan (typed ``poison``
+rejects BEFORE the journal admit), the recovery ladder's typed
+:class:`SingularSystemError` verdict, batch bisection blame-hunting, the
+blame-journal records (per-boot death counts, rotation carry), replay-time
+quarantine (solo execution at K deaths, typed reject past K), the
+journal-adoption carry of a dead replica's death counts, the supervisor's
+uncharged quarantined respawns, the loadgen ``poison:`` mix token, and the
+regress/summarize ingest for ``kind: poison_campaign``.
+
+All CPU (conftest pins the platform); servers share one module-scoped
+executable cache so the jitted batch executables compile once.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gauss_tpu import obs
+from gauss_tpu.obs import regress
+from gauss_tpu.resilience import recover
+from gauss_tpu.serve import (
+    STATUS_POISON,
+    ServeConfig,
+    SolverServer,
+    durable,
+    net,
+    poison_scan,
+)
+from gauss_tpu.serve.cache import ExecutableCache
+from gauss_tpu.verify import checks
+
+GATE = 1e-4
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(64)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(777201)
+
+
+def _system(rng, n):
+    a = rng.standard_normal((n, n))
+    a[np.arange(n), np.arange(n)] += float(n)
+    return a, rng.standard_normal(n)
+
+
+def _config(journal_dir, **over):
+    kw = dict(ladder=(32,), max_batch=4, panel=16, refine_steps=1,
+              verify_gate=GATE, journal_dir=journal_dir,
+              journal_fsync_batch=2)
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def _journal_with_admit(jd, a, b, *, rid="r1", blame_boots=()):
+    """A dead worker's journal: one live admit, one blame record per boot
+    in ``blame_boots`` — the evidence shape ``death_counts`` folds."""
+    jr = durable.RequestJournal(jd, fsync_batch=1, rotate_records=10_000)
+    jr.append_admit(id=1, request_id=rid, trace="t1", a=a, b=b,
+                    was_vector=True, deadline_unix=None, dtype=None,
+                    structure=None)
+    for boot in blame_boots:
+        jr.append_blame(ids=[1], rids=[rid], boot=boot)
+    jr.close()
+
+
+# -- the admission scan ----------------------------------------------------
+
+def test_poison_scan_typed_reasons(rng):
+    a, b = _system(rng, 8)
+    assert poison_scan(a, b) is None
+    bad = a.copy()
+    bad[2, 3] = np.nan
+    assert "non-finite" in poison_scan(bad, b)
+    bad_b = b.copy()
+    bad_b[0] = np.inf
+    assert "non-finite" in poison_scan(a, bad_b)
+
+
+def test_submit_rejects_nonfinite_before_journal_admit(rng, shared_cache,
+                                                       tmp_path):
+    """The crash-loop-proofing satellite: a non-finite operand draws its
+    typed terminal BEFORE the journal admit — a poison the journal never
+    saw cannot be replayed into a crash loop."""
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 12)
+    a[0, 0] = np.nan
+    with SolverServer(_config(jd), cache=shared_cache) as srv:
+        res = srv.solve(a, b, request_id="nanpill", timeout=60.0)
+        assert res.status == STATUS_POISON
+        assert "poisoned operands" in res.error
+    st = durable.scan(jd)
+    assert "nanpill" not in st.by_rid
+    assert not any(d.get("rid") == "nanpill" for d in st.admits.values())
+
+
+def test_singular_system_typed_verdict(rng):
+    """An exactly-singular system is a VERDICT about the operands: the
+    host rung raises the typed subclass (still an UnrecoverableSolveError
+    for existing callers) with trigger ``singular_matrix``."""
+    a = np.zeros((12, 12))
+    a[0, :] = 1.0
+    with pytest.raises(recover.SingularSystemError) as ei:
+        recover.solve_resilient(a, np.ones(12))
+    assert isinstance(ei.value, recover.UnrecoverableSolveError)
+    assert ei.value.trigger == "singular_matrix"
+    assert ei.value.attempts  # the escalation trail survives the re-raise
+
+
+def test_served_singular_is_poison_not_failure(rng, shared_cache):
+    a, b = _system(rng, 14)
+    a[7, :] = 0.0
+    with SolverServer(_config(None), cache=shared_cache) as srv:
+        res = srv.solve(a, b, timeout=120.0)
+    assert res.status == STATUS_POISON
+    assert "SingularSystemError" in res.error
+
+
+def test_nonfinite_solution_never_resolves_ok_without_gate(rng,
+                                                           shared_cache):
+    """The non-finite rescue is unconditional on ``verify_gate``: with no
+    gate configured, a singular system's NaN/Inf batched solution must
+    still route to the host ladder and draw the typed verdict — never an
+    ``ok`` carrying non-finite x."""
+    a, b = _system(rng, 16)
+    a[8, :] = 0.0
+    cfg = _config(None, verify_gate=None)
+    with obs.run() as rec:
+        with SolverServer(cfg, cache=shared_cache) as srv:
+            res = srv.solve(a, b, timeout=120.0)
+    assert res.status == STATUS_POISON
+    assert "SingularSystemError" in res.error
+    assert rec.counters.get("serve.nonfinite_rescues", 0) >= 1
+
+
+# -- batch bisection -------------------------------------------------------
+
+def test_bisection_isolates_culprit_and_reserves_innocents(
+        rng, shared_cache, tmp_path):
+    from gauss_tpu.serve.poisoncheck import SENTINEL, _TrippingCache
+
+    jd = str(tmp_path / "j")
+    cfg = _config(jd, batch_linger_s=0.25)
+    innocents = {f"i{j}": _system(rng, 8 + 4 * j) for j in range(3)}
+    pa, pb = _system(rng, 16)
+    pa[0, 0] = SENTINEL
+    with obs.run() as rec:
+        with SolverServer(cfg, cache=_TrippingCache(shared_cache)) as srv:
+            handles = [("pill", srv.submit(pa, pb, request_id="pill"))]
+            for rid, (a, b) in innocents.items():
+                handles.append((rid, srv.submit(a, b, request_id=rid)))
+            results = {rid: h.result(timeout=120.0) for rid, h in handles}
+    assert results["pill"].status == STATUS_POISON
+    assert "poison batch member" in results["pill"].error
+    for rid, (a, b) in innocents.items():
+        res = results[rid]
+        assert res.status == "ok", (rid, res.status, res.error)
+        assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+    assert rec.counters.get("serve.bisections", 0) >= 1
+    # innocents re-served under their ORIGINAL journal ids: one terminal
+    # each, no re-admits
+    st = durable.scan(jd)
+    assert st.by_rid["pill"]["status"] == STATUS_POISON
+    for rid in innocents:
+        assert st.by_rid[rid]["status"] == "ok"
+
+
+def test_toplevel_singleton_failure_stays_failed(rng, shared_cache):
+    """Only the bisection hunt proves batch-relative blame: a lone request
+    failing non-transiently keeps the pre-existing ``failed`` shape."""
+    from gauss_tpu.serve.poisoncheck import SENTINEL, _TrippingCache
+
+    a, b = _system(rng, 16)
+    a[0, 0] = SENTINEL
+    with SolverServer(_config(None), cache=_TrippingCache(shared_cache)) \
+            as srv:
+        res = srv.solve(a, b, timeout=120.0)
+    assert res.status == "failed"
+    assert "poison batch member" not in (res.error or "")
+
+
+# -- blame records / death counts ------------------------------------------
+
+def test_blame_records_boot_increments_and_death_counts(rng, tmp_path):
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 6)
+    jr = durable.RequestJournal(jd, fsync_batch=1, rotate_records=10_000)
+    assert jr.boot == 1
+    jr.append_admit(id=1, request_id="r1", trace="t", a=a, b=b,
+                    was_vector=True, deadline_unix=None, dtype=None,
+                    structure=None)
+    jr.append_admit(id=2, request_id="r2", trace="t", a=a, b=b,
+                    was_vector=True, deadline_unix=None, dtype=None,
+                    structure=None)
+    jr.append_blame(ids=[1, 2], rids=["r1", "r2"])
+    jr.append_blame(ids=[1])  # re-dispatch, SAME boot: still one death
+    jr.close()
+    jr2 = durable.RequestJournal(jd, fsync_batch=1, rotate_records=10_000)
+    assert jr2.boot == 2  # restart = next boot
+    jr2.append_blame(ids=[1])
+    jr2.append_terminal(id=2, request_id="r2", trace="t", status="ok",
+                        x=b, lane="batched", rel_residual=1e-9)
+    jr2.close()
+    counts = durable.scan(jd).death_counts()
+    assert counts == {1: 2}  # r1: two distinct boots; r2: terminated
+    assert durable.quarantinable_ids(jd) == {1: 2}
+    assert durable.quarantinable_ids(jd, k=3) == {}
+    assert durable.quarantinable_ids(str(tmp_path / "missing")) == {}
+
+
+def test_rotation_carries_blame_for_live_admits(rng, tmp_path):
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 6)
+    jr = durable.RequestJournal(jd, fsync_batch=1, rotate_records=8)
+    jr.append_admit(id=1, request_id="r1", trace="t", a=a, b=b,
+                    was_vector=True, deadline_unix=None, dtype=None,
+                    structure=None)
+    jr.append_blame(ids=[1], rids=["r1"])
+    for i in range(2, 12):  # push past rotate_records
+        jr.append_admit(id=i, request_id=f"r{i}", trace="t", a=a, b=b,
+                        was_vector=True, deadline_unix=None, dtype=None,
+                        structure=None)
+        jr.append_terminal(id=i, request_id=f"r{i}", trace="t",
+                           status="ok", x=b, lane="batched",
+                           rel_residual=1e-9)
+    jr.close()
+    assert durable.scan(jd).death_counts() == {1: 1}
+
+
+# -- replay-time quarantine ------------------------------------------------
+
+def test_replay_quarantines_at_k_deaths_and_solves_solo(rng, shared_cache,
+                                                        tmp_path):
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 12)
+    _journal_with_admit(jd, a, b, blame_boots=(1, 2))
+    with obs.run() as rec:
+        with SolverServer(_config(jd, quarantine_deaths=2),
+                          cache=shared_cache) as srv:
+            assert srv.last_resume["quarantined"] == 1
+            res = srv.solve(a, b, request_id="r1", timeout=120.0)
+    assert res.status == "ok"
+    assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+    assert any(ev.get("type") == "quarantine" and ev.get("action") == "solo"
+               for ev in rec.events)
+    st = durable.scan(jd)
+    assert st.by_rid["r1"]["status"] == "ok"
+
+
+def test_replay_rejects_typed_past_k_deaths(rng, shared_cache, tmp_path):
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 12)
+    _journal_with_admit(jd, a, b, blame_boots=(1, 2, 3))
+    with SolverServer(_config(jd, quarantine_deaths=2),
+                      cache=shared_cache) as srv:
+        assert srv.last_resume["poisoned"] == 1
+        res = srv.solve(a, b, request_id="r1", timeout=60.0)
+    assert res.status == STATUS_POISON
+    assert "quarantined" in res.error
+    st = durable.scan(jd)
+    assert st.by_rid["r1"]["status"] == STATUS_POISON
+
+
+def test_replay_scans_journaled_operands(rng, shared_cache, tmp_path):
+    """A poisoned admit that somehow reached the journal (older build,
+    scan off) must be typed-rejected at replay, never dispatched."""
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 12)
+    a[3, 3] = np.nan
+    _journal_with_admit(jd, a, b)
+    with SolverServer(_config(jd), cache=shared_cache) as srv:
+        assert srv.last_resume["poisoned"] == 1
+    st = durable.scan(jd)
+    assert st.by_rid["r1"]["status"] == STATUS_POISON
+    assert "poisoned operands" in st.by_rid["r1"]["error"]
+
+
+def test_quarantine_zero_disables_the_policy(rng, shared_cache, tmp_path):
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 12)
+    _journal_with_admit(jd, a, b, blame_boots=(1, 2, 3, 4))
+    with SolverServer(_config(jd, quarantine_deaths=0),
+                      cache=shared_cache) as srv:
+        res = srv.solve(a, b, request_id="r1", timeout=120.0)
+    assert res.status == "ok"
+
+
+# -- journal adoption carries the evidence ---------------------------------
+
+def test_adopt_journal_quarantines_implicated_rid(rng, shared_cache,
+                                                  tmp_path):
+    victim = str(tmp_path / "victim")
+    a, b = _system(rng, 12)
+    _journal_with_admit(victim, a, b, blame_boots=(1, 2))
+    with obs.run() as rec:
+        with SolverServer(_config(str(tmp_path / "survivor"),
+                                  quarantine_deaths=2),
+                          cache=shared_cache) as srv:
+            out = net.adopt_journal(srv, victim)
+            assert out["quarantined"] == 1
+            assert out["poisoned"] == 0
+            res = srv.solve(a, b, request_id="r1", timeout=120.0)
+    assert res.status == "ok"
+    assert checks.residual_norm(a, res.x, b, relative=True) <= GATE
+    assert any(ev.get("type") == "quarantine" and ev.get("adopted")
+               for ev in rec.events)
+    # the death counts crossed journals: the adopter re-journals the
+    # evidence under synthetic negative boots (its own real boots start
+    # at 1 and must never collide)
+    st = durable.scan(str(tmp_path / "survivor"))
+    assert any(bl["boot"] < 0 for bl in st.blames)
+    assert st.by_rid["r1"]["status"] == "ok"
+
+
+def test_adopt_journal_rejects_past_k_and_scans_operands(rng, shared_cache,
+                                                         tmp_path):
+    victim = str(tmp_path / "victim")
+    victim2 = str(tmp_path / "victim2")
+    a, b = _system(rng, 12)
+    _journal_with_admit(victim, a, b, blame_boots=(1, 2, 3))
+    bad = a.copy()
+    bad[0, 0] = np.inf
+    _journal_with_admit(victim2, bad, b, rid="r2")
+    with SolverServer(_config(str(tmp_path / "survivor"),
+                              quarantine_deaths=2),
+                      cache=shared_cache) as srv:
+        out = net.adopt_journal(srv, victim)
+        assert out["poisoned"] == 1 and out["quarantined"] == 0
+        out2 = net.adopt_journal(srv, victim2)
+        assert out2["poisoned"] == 1
+        r1 = srv.solve(a, b, request_id="r1", timeout=60.0)
+        r2 = srv.solve(bad, b, request_id="r2", timeout=60.0)
+    assert r1.status == STATUS_POISON and "quarantined" in r1.error
+    assert r2.status == STATUS_POISON and "poisoned operands" in r2.error
+
+
+# -- the supervisor's growth guard -----------------------------------------
+
+def _blame_growth_child(jd, marker, exit_code=113):
+    """A jax-free supervise child: first incarnation appends pre-encoded
+    blame evidence to the live segment and dies; the respawn exits 0."""
+    seg = durable.segment_paths(jd)[-1]
+    blame = durable.encode_record({
+        "rec": "blame", "schema": durable.JOURNAL_SCHEMA, "boot": 1,
+        "ids": [1], "rids": ["r1"], "t_unix": 0.0})
+    return (
+        "import os, sys\n"
+        "open(os.environ['HB'], 'w').write('beat')\n"
+        f"m = {marker!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x')\n"
+        f"    open({seg!r}, 'ab').write({blame!r})\n"
+        f"    os._exit({exit_code})\n"
+        "sys.exit(0)\n")
+
+
+def test_supervise_free_respawn_at_quarantine_threshold(rng, tmp_path):
+    """A death that pushed a suspect's death count TO the quarantine
+    threshold is quarantined: respawned without charging the budget —
+    max_restarts=0 still comes home."""
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 6)
+    _journal_with_admit(jd, a, b)
+    hb = str(tmp_path / "hb.json")
+    env = dict(os.environ, HB=hb)
+    logs = []
+    with obs.run() as rec:
+        rc = durable.supervise(
+            [sys.executable, "-c",
+             _blame_growth_child(jd, str(tmp_path / "died_once"))],
+            heartbeat_path=hb, max_restarts=0, stall_after_s=60.0,
+            env=env, journal_dir=jd, quarantine_deaths=1, log=logs.append)
+    assert rc == 0
+    assert any("quarantined" in ln for ln in logs)
+    assert rec.counters.get("serve.quarantined_respawns") == 1
+    assert rec.counters.get("serve.supervisor_restarts", 0) == 0
+
+
+def test_supervise_charges_death_without_new_evidence(rng, tmp_path):
+    """The discrimination: the same crash WITHOUT new threshold-reaching
+    evidence charges the budget — max_restarts=0 gives up."""
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 6)
+    _journal_with_admit(jd, a, b, blame_boots=(1,))  # stale, not growing
+    hb = str(tmp_path / "hb.json")
+    script = (
+        "import os\n"
+        "open(os.environ['HB'], 'w').write('beat')\n"
+        "os._exit(113)\n")
+    rc = durable.supervise(
+        [sys.executable, "-c", script], heartbeat_path=hb,
+        max_restarts=0, stall_after_s=60.0,
+        env=dict(os.environ, HB=hb), journal_dir=jd, quarantine_deaths=1,
+        log=lambda _ln: None)
+    assert rc == 113
+
+
+def test_supervise_charges_first_death_below_threshold(rng, tmp_path):
+    """Blame growth BELOW the threshold is not quarantine progress —
+    every mid-dispatch crash blames its in-flight batch once, and those
+    first deaths must still charge the budget (an environmental crasher
+    under load would otherwise respawn for free forever)."""
+    jd = str(tmp_path / "j")
+    a, b = _system(rng, 6)
+    _journal_with_admit(jd, a, b)
+    hb = str(tmp_path / "hb.json")
+    rc = durable.supervise(
+        [sys.executable, "-c",
+         _blame_growth_child(jd, str(tmp_path / "died_once"))],
+        heartbeat_path=hb, max_restarts=0, stall_after_s=60.0,
+        env=dict(os.environ, HB=hb), journal_dir=jd, quarantine_deaths=2,
+        log=lambda _ln: None)
+    assert rc == 113
+
+
+# -- loadgen poison mix ----------------------------------------------------
+
+def test_loadgen_poison_mix_parse_and_materialize():
+    from gauss_tpu.serve import loadgen
+
+    for arg, probe in (("nan/16", np.isnan), ("inf/16", np.isinf)):
+        (spec, w), = loadgen.parse_mix(f"poison:{arg}")
+        a, _b = loadgen.materialize(spec, np.random.default_rng(0))
+        assert probe(a).any() and a.shape == (16, 16)
+    (spec, _w), = loadgen.parse_mix("poison:singular/16")
+    a, _b = loadgen.materialize(spec, np.random.default_rng(0))
+    assert np.isfinite(a).all()
+    assert np.linalg.matrix_rank(a) < 16
+    for bad in ("poison:bogus/16", "poison:nan/1", "poison:nan"):
+        with pytest.raises(ValueError):
+            loadgen.parse_mix(bad)
+
+
+def test_loadgen_counts_poison_separately(rng, shared_cache):
+    from gauss_tpu.serve.loadgen import (LoadgenConfig, format_summary,
+                                         run_load)
+
+    cfg = LoadgenConfig(mix="random:16*3,poison:nan/16", requests=12,
+                        warmup=2, mode="closed", concurrency=2, seed=7,
+                        verify_gate=GATE, serve=_config(None))
+    with SolverServer(cfg.serve, cache=shared_cache) as srv:
+        summary = run_load(srv, cfg)
+    c = summary["counts"]
+    assert c["poison"] >= 1
+    assert c["failed"] == 0 and summary["incorrect"] == 0
+    assert c["ok"] + c["poison"] == 12
+    assert "poison-rejected" in format_summary(summary)
+
+
+# -- campaign runner / ingest ----------------------------------------------
+
+@pytest.mark.slow
+def test_poisoncheck_case_runner_all_kinds(tmp_path, shared_cache):
+    from gauss_tpu.serve import poisoncheck
+
+    cache = poisoncheck._TrippingCache(shared_cache)
+    for i, kind in enumerate(poisoncheck.POISON_KINDS):
+        out = poisoncheck.run_case(i, 99, GATE, str(tmp_path), kind,
+                                   cache=cache)
+        assert out["outcome"] == "ok", out
+
+
+def test_campaign_summary_regress_roundtrip(tmp_path):
+    from gauss_tpu.serve.poisoncheck import history_records
+
+    summary = {"kind": "poison_campaign", "cases": 32, "wall_s": 64.0}
+    recs = history_records(summary)
+    assert {m for m, _v, _u in recs} == {"poison:s_per_case"}
+    path = tmp_path / "poison.json"
+    path.write_text(json.dumps(summary))
+    ingested = regress.ingest_file(path)
+    assert {r["metric"] for r in ingested} == {"poison:s_per_case"}
+    assert all(r["kind"] == "poison" for r in ingested)
+
+
+def test_summarize_poison_section(rng, shared_cache, tmp_path):
+    from gauss_tpu.obs import summarize
+
+    stream = str(tmp_path / "poison_events.jsonl")
+    a, b = _system(rng, 12)
+    a[0, 0] = np.nan
+    with obs.run(metrics_out=stream, run_id="pz0001"):
+        with SolverServer(_config(None), cache=shared_cache) as srv:
+            assert srv.solve(a, b, timeout=60.0).status == STATUS_POISON
+        obs.emit("poison_campaign", cases=32, violations=0,
+                 crash_loops=0, invariant_ok=True)
+    events = obs.read_events(stream)
+    po = summarize.poison_summary(events)
+    assert po["poisoned"] >= 1
+    assert po["campaign"]["invariant_ok"] is True
+    text = summarize.summarize_run(events, "pz0001")
+    assert "poison isolation:" in text

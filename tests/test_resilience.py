@@ -438,10 +438,12 @@ def test_serve_numpy_lane_is_ladder_backed(rng):
         failed = srv.solve(bad, np.ones(12))
     assert ok.status == "ok" and ok.lane == "numpy"
     assert checks.residual_norm(a, ok.x, b, relative=True) <= 1e-4
-    # An unsolvable system through the degraded lane fails TYPED — the
-    # ladder's UnrecoverableSolveError, not a bare LinAlgError.
-    assert failed.status == "failed"
-    assert "UnrecoverableSolveError" in failed.error
+    # An exactly-singular system through the degraded lane is a typed
+    # VERDICT about the request, not a serving failure: the numpy_f64
+    # rung's LinAlgError surfaces as SingularSystemError and the serving
+    # layer maps it to the poison terminal.
+    assert failed.status == "poison"
+    assert "SingularSystemError" in failed.error
 
 
 # -- chaos campaign --------------------------------------------------------
